@@ -1,0 +1,41 @@
+"""distributed_training_tpu — a TPU-native distributed training framework.
+
+A from-scratch JAX/XLA re-design of the capability surface of
+``ppt0011/distributed_training`` (reference: three sibling trainers driving
+PyTorch DDP, DeepSpeed, and ColossalAI on ResNet-18/CIFAR-10 — see
+``/root/reference/resnet/{pytorch_ddp,deepspeed,colossal}``).
+
+Instead of NCCL process groups + per-rank Python processes, this framework is
+built on the single-program-multiple-data model of XLA:
+
+- one jitted train step over a ``jax.sharding.Mesh`` (ICI/DCN),
+- gradient all-reduce as ``lax.psum`` / GSPMD-inserted collectives,
+- ZeRO-style optimizer/parameter sharding as ``NamedSharding`` placement,
+- mixed precision as a dtype policy + traced dynamic loss-scale state,
+- data sharding as per-host slices of a deterministic global permutation.
+
+Public API (stable):
+
+    from distributed_training_tpu import (
+        TrainConfig, Trainer, create_mesh, get_model,
+    )
+"""
+
+__version__ = "0.1.0"
+
+from distributed_training_tpu.config import (  # noqa: F401
+    MoEConfig,
+    OptimizerConfig,
+    PrecisionConfig,
+    SchedulerConfig,
+    TrainConfig,
+    ZeroConfig,
+    from_ds_config,
+)
+from distributed_training_tpu.runtime.mesh import (  # noqa: F401
+    MeshConfig,
+    create_mesh,
+)
+from distributed_training_tpu.runtime.coordinator import Coordinator  # noqa: F401
+from distributed_training_tpu.models import get_model  # noqa: F401
+from distributed_training_tpu.train.trainer import Trainer  # noqa: F401

@@ -1,0 +1,327 @@
+"""Configuration system.
+
+Subsumes the three config styles of the reference
+(SURVEY.md §5 "Config / flag system"):
+
+1. hardcoded constants        — ``resnet/pytorch_ddp/ddp_train.py:108-111``
+2. argparse + ds_config dict  — ``resnet/deepspeed/deepspeed_train.py:27-129,172-220``
+3. argparse plugin selection  — ``resnet/colossal/colossal_train.py:30-50,128-136``
+
+into one dataclass tree with (a) a ``plugin`` strategy enum mirroring the
+ColossalAI choice names and (b) :func:`from_ds_config` ingesting the
+DeepSpeed-style JSON dict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Sequence
+
+# Plugin names mirror resnet/colossal/colossal_train.py:38 choices plus the
+# unreachable 'gemini' (constructed at :133-134 but not selectable) and a
+# 'deepspeed' entry parameterized by --stage (deepspeed_train.py:115-122).
+PLUGINS = (
+    "torch_ddp",        # pure DP, fp32              (ddp_train.py)
+    "torch_ddp_fp16",   # DP + fp16 loss scaling     (colossal_train.py:129-130)
+    "low_level_zero",   # ZeRO-1/2 class             (colossal_train.py:135-136)
+    "gemini",           # ZeRO-3 class               (colossal_train.py:133-134)
+    "deepspeed",        # stage-selected ZeRO        (deepspeed_train.py:210-219)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Adam hyperparameters.
+
+    Defaults follow the DeepSpeed trainer's ds_config optimizer block
+    (``resnet/deepspeed/deepspeed_train.py:175-186``). The DDP/Colossal
+    trainers use torch defaults (betas 0.9/0.999, wd 0) with linear LR
+    scaling ``lr = 1e-3 * world_size`` (``ddp_train.py:110``,
+    ``colossal_train.py:116-122``) — expressed here via ``scale_lr_by_world``.
+    """
+
+    name: str = "adam"
+    lr: float = 1e-3
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    scale_lr_by_world: bool = False
+    # Gradient clipping: ds_config "gradient_clipping": 1.0
+    # (deepspeed_train.py:195). None disables.
+    grad_clip_norm: float | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    """WarmupLR parity (``resnet/deepspeed/deepspeed_train.py:187-194``)."""
+
+    name: str = "constant"  # constant | warmup_lr | cosine
+    warmup_min_lr: float = 0.0
+    warmup_max_lr: float = 1e-3
+    warmup_num_steps: int = 1000
+    total_steps: int | None = None  # for cosine decay
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionConfig:
+    """Mixed-precision policy + dynamic loss scaling.
+
+    ``dtype`` mirrors ``--dtype {bf16,fp16,fp32}``
+    (``resnet/deepspeed/deepspeed_train.py:107-114``). The fp16 loss-scaler
+    defaults replicate the ds_config fp16 block
+    (``deepspeed_train.py:203-207``): dynamic scale (initial 2**15), window
+    500, hysteresis 2, min scale 1. ColossalAI's plugins use
+    ``initial_scale=2**5`` (``colossal_train.py:134,136``) — selected by the
+    plugin presets in :func:`TrainConfig.from_plugin`.
+    """
+
+    dtype: str = "fp32"  # bf16 | fp16 | fp32  (compute dtype)
+    # fp16 dynamic loss scaling (ignored unless dtype == fp16):
+    initial_scale_power: int = 15
+    loss_scale_window: int = 500
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+    # A fixed (non-dynamic) scale; None means dynamic ("loss_scale": 0 in ds).
+    static_loss_scale: float | None = None
+
+    @property
+    def initial_scale(self) -> float:
+        return float(2 ** self.initial_scale_power)
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroConfig:
+    """ZeRO optimizer/gradient/parameter sharding.
+
+    ``stage`` mirrors ``--stage {0,1,2,3}``
+    (``resnet/deepspeed/deepspeed_train.py:115-122``) and the
+    ``zero_optimization`` block (``:210-219``). The bucketing/overlap knobs
+    (``allgather_bucket_size``, ``reduce_bucket_size``, ``overlap_comm``,
+    ``contiguous_gradients``) are accepted for config parity but are
+    deliberate no-ops on TPU: XLA's latency-hiding scheduler buckets and
+    overlaps collectives itself, so there is nothing to tune by hand. They
+    are recorded so ds_config round-trips losslessly.
+    """
+
+    stage: int = 0
+    # Parity-accepted, XLA-scheduled (documented no-ops):
+    allgather_partitions: bool = True
+    reduce_scatter: bool = True
+    allgather_bucket_size: int = 50_000_000
+    reduce_bucket_size: int = 50_000_000
+    overlap_comm: bool = True
+    contiguous_gradients: bool = True
+    cpu_offload: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Mixture-of-experts surface.
+
+    Mirrors the DeepSpeed trainer's MoE CLI flags
+    (``resnet/deepspeed/deepspeed_train.py:61-106``). The reference parses
+    these but never wires them into its (plain ResNet) model. Here they
+    configure the expert-parallel MLP in ``models/moe.py``; Trainer refuses
+    ``enabled=True`` with a non-MoE model rather than silently training
+    dense the way the reference does.
+    """
+
+    enabled: bool = False
+    ep_world_size: int = 1
+    num_experts: Sequence[int] = (1,)
+    mlp_type: str = "standard"  # standard | residual
+    top_k: int = 1
+    min_capacity: int = 0
+    capacity_factor: float = 1.25
+    noisy_gate_policy: str | None = None  # None | RSample | Jitter
+    moe_param_group: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointConfig:
+    """Checkpoint/resume surface (``resnet/colossal/colossal_train.py:40-42``).
+
+    The reference parses ``--resume/--checkpoint/--interval`` but never wires
+    them (``start_epoch = 0`` hardcoded, no save call — SURVEY.md §2.5); here
+    they are functional (orbax; see ``checkpoint.py``).
+    """
+
+    directory: str = "./checkpoint"
+    interval: int = 5          # epochs between saves
+    resume: int = -1           # epoch to resume from; -1 = fresh
+    keep: int = 3              # retained checkpoints
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    dataset: str = "cifar10"   # cifar10 | synthetic_imagenet | synthetic_cifar
+    data_path: str | None = None  # None → $DATA or ../data (ddp_train.py:34)
+    batch_size: int = 100      # per-device (ddp_train.py:111)
+    global_batch_size: int | None = None  # ds-style; overrides batch_size
+    augment: str = "pad_crop_flip"  # pad_crop_flip | normalize_only | none
+    num_workers: int = 4
+    image_size: int = 32
+    num_classes: int = 10
+    drop_last: bool = True
+    synthetic_ok: bool = True  # fall back to synthetic data if not on disk
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh axis sizes; -1 infers from device count."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    expert: int = 1
+    sequence: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    model: str = "resnet18"
+    plugin: str = "torch_ddp"
+    num_epochs: int = 5        # all three trainers (ddp_train.py:108)
+    seed: int = 0
+    log_interval: int = 100    # steps between host-side loss fetches
+    target_acc: float | None = None  # colossal_train.py:43-46, wired here
+    eval_every: int = 1        # epochs between eval passes
+    sync_batchnorm: bool = True
+    optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    precision: PrecisionConfig = dataclasses.field(default_factory=PrecisionConfig)
+    zero: ZeroConfig = dataclasses.field(default_factory=ZeroConfig)
+    moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
+    checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    # Profiling: ds_config "wall_clock_breakdown" (deepspeed_train.py:209).
+    wall_clock_breakdown: bool = False
+    profile_dir: str | None = None
+
+    def replace(self, **kw: Any) -> "TrainConfig":
+        return dataclasses.replace(self, **kw)
+
+    @staticmethod
+    def from_plugin(plugin: str, **overrides: Any) -> "TrainConfig":
+        """Build a config from a ColossalAI-style plugin name.
+
+        Presets encode what each reference plugin actually configures:
+        - torch_ddp       → DP fp32, Adam(lr·world)   (ddp_train.py:95-110)
+        - torch_ddp_fp16  → DP + fp16 booster kwarg   (colossal_train.py:129-130)
+        - low_level_zero  → ZeRO-1, initial_scale 2^5 (colossal_train.py:135-136)
+        - gemini          → ZeRO-3-like, scale 2^5    (colossal_train.py:133-134)
+        - deepspeed       → stage via overrides        (deepspeed_train.py:210-219)
+        """
+        if plugin not in PLUGINS:
+            raise ValueError(f"unknown plugin {plugin!r}; choose from {PLUGINS}")
+        opt = OptimizerConfig(scale_lr_by_world=True)
+        prec = PrecisionConfig()
+        zero = ZeroConfig()
+        if plugin == "torch_ddp_fp16":
+            prec = PrecisionConfig(dtype="fp16")
+        elif plugin == "low_level_zero":
+            prec = PrecisionConfig(dtype="fp16", initial_scale_power=5)
+            zero = ZeroConfig(stage=1)
+        elif plugin == "gemini":
+            prec = PrecisionConfig(dtype="fp16", initial_scale_power=5)
+            zero = ZeroConfig(stage=3)
+        elif plugin == "deepspeed":
+            opt = OptimizerConfig(
+                betas=(0.8, 0.999), eps=1e-8, weight_decay=3e-7,
+                grad_clip_norm=1.0,
+            )
+        cfg = TrainConfig(plugin=plugin, optimizer=opt, precision=prec, zero=zero)
+        return cfg.replace(**overrides) if overrides else cfg
+
+
+def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> TrainConfig:
+    """Ingest a DeepSpeed-style config dict.
+
+    Maps every field of the reference's ds_config
+    (``resnet/deepspeed/deepspeed_train.py:172-220``) onto the dataclass
+    tree. Unknown keys raise, so silent config drift is impossible.
+    """
+    cfg = base or TrainConfig.from_plugin("deepspeed")
+    known = {
+        "train_batch_size", "train_micro_batch_size_per_gpu", "steps_per_print",
+        "optimizer", "scheduler", "gradient_clipping", "prescale_gradients",
+        "bf16", "fp16", "wall_clock_breakdown", "zero_optimization",
+    }
+    unknown = set(ds) - known
+    if unknown:
+        raise ValueError(f"unknown ds_config keys: {sorted(unknown)}")
+
+    opt = cfg.optimizer
+    if "optimizer" in ds:
+        p = ds["optimizer"].get("params", {})
+        opt_type = ds["optimizer"].get("type", "Adam").lower()
+        if opt_type not in ("adam", "adamw"):
+            raise ValueError("only Adam-family optimizers are supported")
+        opt = dataclasses.replace(
+            opt,
+            # 'adamw' selects DECOUPLED weight decay in make_optimizer;
+            # plain 'adam' couples it into the moments (torch semantics).
+            name=opt_type,
+            lr=p.get("lr", opt.lr),
+            betas=tuple(p.get("betas", opt.betas)),
+            eps=p.get("eps", opt.eps),
+            weight_decay=p.get("weight_decay", opt.weight_decay),
+        )
+    if "gradient_clipping" in ds:
+        opt = dataclasses.replace(opt, grad_clip_norm=float(ds["gradient_clipping"]))
+
+    sched = cfg.scheduler
+    if "scheduler" in ds:
+        if ds["scheduler"].get("type") != "WarmupLR":
+            raise ValueError("only WarmupLR scheduler is supported from ds_config")
+        p = ds["scheduler"].get("params", {})
+        sched = SchedulerConfig(
+            name="warmup_lr",
+            warmup_min_lr=p.get("warmup_min_lr", 0.0),
+            warmup_max_lr=p.get("warmup_max_lr", opt.lr),
+            warmup_num_steps=p.get("warmup_num_steps", 1000),
+        )
+
+    prec = cfg.precision
+    if ds.get("bf16", {}).get("enabled"):
+        prec = dataclasses.replace(prec, dtype="bf16")
+    fp16 = ds.get("fp16", {})
+    if fp16.get("enabled"):
+        loss_scale = fp16.get("loss_scale", 0)
+        prec = PrecisionConfig(
+            dtype="fp16",
+            initial_scale_power=fp16.get("initial_scale_power", 15),
+            loss_scale_window=fp16.get("loss_scale_window", 500),
+            hysteresis=fp16.get("hysteresis", 2),
+            min_loss_scale=fp16.get("min_loss_scale", 1.0),
+            static_loss_scale=None if loss_scale == 0 else float(loss_scale),
+        )
+
+    zero = cfg.zero
+    if "zero_optimization" in ds:
+        z = dict(ds["zero_optimization"])
+        zero = ZeroConfig(
+            stage=z.pop("stage", 0),
+            allgather_partitions=z.pop("allgather_partitions", True),
+            reduce_scatter=z.pop("reduce_scatter", True),
+            allgather_bucket_size=z.pop("allgather_bucket_size", 50_000_000),
+            reduce_bucket_size=z.pop("reduce_bucket_size", 50_000_000),
+            overlap_comm=z.pop("overlap_comm", True),
+            contiguous_gradients=z.pop("contiguous_gradients", True),
+            cpu_offload=z.pop("cpu_offload", False),
+        )
+        if z:
+            raise ValueError(f"unknown zero_optimization keys: {sorted(z)}")
+
+    data = cfg.data
+    if "train_batch_size" in ds:
+        data = dataclasses.replace(data, global_batch_size=int(ds["train_batch_size"]))
+    if "train_micro_batch_size_per_gpu" in ds:
+        data = dataclasses.replace(data, batch_size=int(ds["train_micro_batch_size_per_gpu"]))
+
+    return cfg.replace(
+        optimizer=opt, scheduler=sched, precision=prec, zero=zero, data=data,
+        log_interval=int(ds.get("steps_per_print", cfg.log_interval)),
+        wall_clock_breakdown=bool(ds.get("wall_clock_breakdown", cfg.wall_clock_breakdown)),
+    )

@@ -1,0 +1,4 @@
+from distributed_training_tpu.data.pipeline import (  # noqa: F401
+    ShardedDataLoader,
+    build_dataloaders,
+)

@@ -1,0 +1,98 @@
+"""CIFAR-10 dataset loading (no torchvision dependency).
+
+Parity target: ``torchvision.datasets.CIFAR10(root=$DATA or '../data',
+download=True)`` (``resnet/pytorch_ddp/ddp_train.py:33-42``,
+``resnet/colossal/colossal_train.py:64-73``). This environment has no
+network egress, so instead of downloading we read the standard on-disk
+layouts (both the python-pickle batches and the binary version), and fall
+back to a deterministic synthetic stand-in when the dataset is absent so
+smoke tests and benches run anywhere.
+
+Images are returned NHWC uint8 (TPU-native layout; torch uses CHW floats
+after ToTensor).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import warnings
+
+import numpy as np
+
+NUM_CLASSES = 10
+IMAGE_SHAPE = (32, 32, 3)
+
+
+def default_data_path() -> str:
+    # $DATA override with '../data' default — ddp_train.py:34.
+    return os.environ.get("DATA", "../data")
+
+
+def _load_pickle_batches(root: str, train: bool):
+    d = os.path.join(root, "cifar-10-batches-py")
+    if not os.path.isdir(d):
+        return None
+    files = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    images, labels = [], []
+    for f in files:
+        with open(os.path.join(d, f), "rb") as fh:
+            entry = pickle.load(fh, encoding="latin1")
+        images.append(np.asarray(entry["data"], dtype=np.uint8))
+        labels.extend(entry.get("labels", entry.get("fine_labels", [])))
+    x = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(x), np.asarray(labels, dtype=np.int32)
+
+
+def _load_binary_batches(root: str, train: bool):
+    d = os.path.join(root, "cifar-10-batches-bin")
+    if not os.path.isdir(d):
+        return None
+    files = [f"data_batch_{i}.bin" for i in range(1, 6)] if train else ["test_batch.bin"]
+    recs = []
+    for f in files:
+        raw = np.fromfile(os.path.join(d, f), dtype=np.uint8)
+        recs.append(raw.reshape(-1, 3073))
+    raw = np.concatenate(recs)
+    labels = raw[:, 0].astype(np.int32)
+    x = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return np.ascontiguousarray(x), labels
+
+
+def synthetic_cifar10(n: int, train: bool, seed: int = 0):
+    """Deterministic CIFAR-shaped synthetic data.
+
+    Class-conditional Gaussian blobs over pixel space: learnable (a model's
+    loss demonstrably decreases — needed for the convergence smoke tests the
+    reference only supports by eyeballing tqdm loss, SURVEY.md §4) yet
+    generated in milliseconds with no I/O.
+    """
+    rng = np.random.RandomState(seed + (0 if train else 1))
+    labels = rng.randint(0, NUM_CLASSES, size=n).astype(np.int32)
+    class_means = np.linspace(40, 215, NUM_CLASSES)  # distinct mean intensity
+    base = rng.randint(0, 60, size=(n, *IMAGE_SHAPE))
+    images = np.clip(base + class_means[labels][:, None, None, None], 0, 255)
+    return images.astype(np.uint8), labels
+
+
+def load_cifar10(
+    root: str | None = None,
+    train: bool = True,
+    synthetic_ok: bool = True,
+    synthetic_size: int | None = None,
+):
+    """Load CIFAR-10 (images NHWC uint8, labels int32)."""
+    root = root or default_data_path()
+    for loader in (_load_pickle_batches, _load_binary_batches):
+        out = loader(root, train)
+        if out is not None:
+            return out
+    if not synthetic_ok:
+        raise FileNotFoundError(
+            f"CIFAR-10 not found under {root!r} (looked for cifar-10-batches-py "
+            "and cifar-10-batches-bin); no network egress to download")
+    warnings.warn(
+        f"CIFAR-10 not on disk under {root!r}; using deterministic synthetic "
+        "stand-in (set synthetic_ok=False to require the real dataset)")
+    n = synthetic_size or (50_000 if train else 10_000)
+    return synthetic_cifar10(n, train)

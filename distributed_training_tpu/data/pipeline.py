@@ -1,0 +1,175 @@
+"""Sharded input pipeline.
+
+TPU-native replacement for ``DataLoader + DistributedSampler``
+(``resnet/pytorch_ddp/ddp_train.py:46-47``) and
+``plugin.prepare_dataloader`` (``resnet/colossal/colossal_train.py:76-77``):
+
+- a deterministic *global* permutation seeded by ``(seed, epoch)`` —
+  ``sampler.set_epoch(epoch)`` parity (``ddp_train.py:102``);
+- each **process** materializes only its contiguous slice of every global
+  batch (JAX shards per host process, not per device rank — device-level
+  slicing happens when the global array is formed on the mesh);
+- ``drop_last=True`` for train, ragged last batch with a 0/1 ``mask`` for
+  eval (instead of DistributedSampler's pad-by-repeat, which double-counts
+  examples in accuracy);
+- augmentation on whole uint8 batches (``transforms.py``), floats produced
+  host-side, device transfer handled by the jitted step's input shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from distributed_training_tpu.data import cifar10, transforms
+from distributed_training_tpu.data.synthetic import synthetic_imagenet
+
+
+class ShardedDataLoader:
+    """Deterministic sharded loader over in-memory arrays.
+
+    Yields dict batches ``{'image': f32[NHWC], 'label': i32[N]}`` (+ ``mask``
+    when ``drop_last=False``) where N is the *per-process* slice of the
+    global batch size.
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        *,
+        global_batch_size: int,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        augment: str = "none",
+        train: bool = True,
+        seed: int = 0,
+        process_index: int | None = None,
+        process_count: int | None = None,
+    ):
+        self.images = images
+        self.labels = labels
+        self.global_batch_size = global_batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.augment = augment
+        self.train = train
+        self.seed = seed
+        self.epoch = 0
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index)
+        self.process_count = (
+            jax.process_count() if process_count is None else process_count)
+        if global_batch_size % self.process_count:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{self.process_count} processes")
+        self.local_batch_size = global_batch_size // self.process_count
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle — ``sampler.set_epoch`` parity."""
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        n = len(self.labels)
+        if self.drop_last:
+            return n // self.global_batch_size
+        return -(-n // self.global_batch_size)
+
+    def __iter__(self) -> Iterator[dict]:
+        n = len(self.labels)
+        order = np.arange(n)
+        if self.shuffle:
+            # Same permutation on every process — the global batch is a
+            # deterministic function of (seed, epoch), so shards never
+            # overlap and never miss an example.
+            order = np.random.RandomState(
+                (self.seed * 100_003 + self.epoch) % (2 ** 31)).permutation(n)
+        aug_rng = np.random.RandomState(
+            (self.seed * 7 + self.epoch * 13 + self.process_index) % (2 ** 31))
+
+        steps = len(self)
+        for i in range(steps):
+            gstart = i * self.global_batch_size
+            gidx = order[gstart:gstart + self.global_batch_size]
+            # Contiguous per-process slice of the global batch.
+            lstart = self.process_index * self.local_batch_size
+            lidx = gidx[lstart:lstart + self.local_batch_size]
+            images = self.images[lidx]
+            labels = self.labels[lidx]
+            mask = np.ones(len(lidx), dtype=np.float32)
+            if len(lidx) < self.local_batch_size:  # ragged final batch
+                pad = self.local_batch_size - len(lidx)
+                images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
+                labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
+                mask = np.concatenate([mask, np.zeros(pad, np.float32)])
+            if self.train:
+                x = transforms.apply_train_augment(images, self.augment, aug_rng)
+            else:
+                x = transforms.apply_eval_transform(images, self.augment)
+            batch = {"image": x, "label": labels.astype(np.int32)}
+            if not self.drop_last:
+                batch["mask"] = mask
+            yield batch
+
+
+def to_global_batch(batch: dict, mesh: Mesh, shardings: dict) -> dict:
+    """Form global jax.Arrays from per-process numpy shards.
+
+    Single-process: a plain device_put onto the mesh sharding (async).
+    Multi-host: ``make_array_from_process_local_data`` assembles the global
+    logical array from each host's slice without any cross-host transfer.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(batch, shardings)
+    return {
+        k: jax.make_array_from_process_local_data(shardings[k], v)
+        for k, v in batch.items()
+    }
+
+
+def build_dataloaders(cfg, coordinator=None, *, seed: int = 0):
+    """Build (train_loader, eval_loader) per the data config.
+
+    Mirrors the reference's ``build_dataloader(batch_size)`` surface
+    (``resnet/pytorch_ddp/ddp_train.py:25-48``) including the rank-0-first
+    download serialization (here: any expensive materialization) via
+    ``coordinator.priority_execution()``
+    (``resnet/colossal/colossal_train.py:65-73``).
+    """
+    data = cfg.data
+    world = jax.device_count()
+    global_bs = data.global_batch_size or data.batch_size * world
+
+    def _load():
+        if data.dataset == "cifar10":
+            tr = cifar10.load_cifar10(data.data_path, train=True,
+                                      synthetic_ok=data.synthetic_ok)
+            ev = cifar10.load_cifar10(data.data_path, train=False,
+                                      synthetic_ok=data.synthetic_ok)
+        elif data.dataset == "synthetic_cifar":
+            tr = cifar10.synthetic_cifar10(4096, True, seed)
+            ev = cifar10.synthetic_cifar10(1024, False, seed)
+        elif data.dataset == "synthetic_imagenet":
+            tr = synthetic_imagenet(8192, data.image_size, data.num_classes, seed)
+            ev = synthetic_imagenet(1024, data.image_size, data.num_classes, seed + 1)
+        else:
+            raise ValueError(f"unknown dataset {data.dataset!r}")
+        return tr, ev
+
+    if coordinator is not None:
+        with coordinator.priority_execution("dataset_load"):
+            (train_x, train_y), (eval_x, eval_y) = _load()
+    else:
+        (train_x, train_y), (eval_x, eval_y) = _load()
+
+    train_loader = ShardedDataLoader(
+        train_x, train_y, global_batch_size=global_bs, shuffle=True,
+        drop_last=data.drop_last, augment=data.augment, train=True, seed=seed)
+    eval_loader = ShardedDataLoader(
+        eval_x, eval_y, global_batch_size=global_bs, shuffle=False,
+        drop_last=False, augment=data.augment, train=False, seed=seed)
+    return train_loader, eval_loader

@@ -1,0 +1,65 @@
+"""Vectorized numpy augmentations.
+
+Parity targets (SURVEY.md §2.4 "Augmentation"):
+- Pad(4) + RandomHorizontalFlip + RandomCrop(32) + ToTensor — the DDP and
+  ColossalAI train transform (``resnet/pytorch_ddp/ddp_train.py:27-32``,
+  ``resnet/colossal/colossal_train.py:56-61``).
+- ToTensor + Normalize((0.5,)*3, (0.5,)*3) — the DeepSpeed transform
+  (``resnet/deepspeed/deepspeed_train.py:227-230``).
+
+Unlike torchvision's per-sample Python transforms, these operate on whole
+uint8 batches with vectorized gathers — the host must keep ~6000 img/s/chip
+fed (SURVEY.md §7 hard parts), so per-sample Python loops are out.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pad_crop_flip(
+    images: np.ndarray, rng: np.random.RandomState, pad: int = 4,
+) -> np.ndarray:
+    """Batched Pad(pad) → RandomCrop(original) → RandomHorizontalFlip."""
+    n, h, w, c = images.shape
+    padded = np.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant")
+    ys = rng.randint(0, 2 * pad + 1, size=n)
+    xs = rng.randint(0, 2 * pad + 1, size=n)
+    # Gather crops via sliding-window view: windows[i, ys[i], xs[i]] is the
+    # (h, w, c) crop — one fancy-index instead of a Python loop.
+    windows = np.lib.stride_tricks.sliding_window_view(padded, (h, w), axis=(1, 2))
+    crops = windows[np.arange(n), ys, xs]            # (n, c, h, w) after view
+    crops = np.moveaxis(crops, 1, -1)                # back to NHWC
+    flips = rng.rand(n) < 0.5
+    crops[flips] = crops[flips, :, ::-1]
+    return np.ascontiguousarray(crops)
+
+
+def to_float(images: np.ndarray) -> np.ndarray:
+    """ToTensor parity: uint8 [0,255] → float32 [0,1] (layout stays NHWC)."""
+    return images.astype(np.float32) / 255.0
+
+
+def normalize_half(images01: np.ndarray) -> np.ndarray:
+    """Normalize((0.5,0.5,0.5),(0.5,0.5,0.5)) parity → [-1, 1]."""
+    return (images01 - 0.5) / 0.5
+
+
+def apply_train_augment(
+    images: np.ndarray, mode: str, rng: np.random.RandomState,
+) -> np.ndarray:
+    if mode == "pad_crop_flip":
+        return to_float(pad_crop_flip(images, rng))
+    if mode == "normalize_only":
+        return normalize_half(to_float(images))
+    if mode == "none":
+        return to_float(images)
+    raise ValueError(f"unknown augment mode {mode!r}")
+
+
+def apply_eval_transform(images: np.ndarray, mode: str) -> np.ndarray:
+    # Eval uses plain ToTensor in DDP/Colossal; DS normalizes train==eval.
+    if mode == "normalize_only":
+        return normalize_half(to_float(images))
+    return to_float(images)

@@ -1,0 +1,175 @@
+"""Flax ResNet family.
+
+TPU-native re-expression of the reference's model zoo use:
+``torchvision.models.resnet18(num_classes=10)`` in all three trainers
+(``resnet/pytorch_ddp/ddp_train.py:95``,
+``resnet/deepspeed/deepspeed_train.py:223``,
+``resnet/colossal/colossal_train.py:149``), extended to ResNet-50/101/152
+for the ImageNet benchmark configs in ``BASELINE.json``.
+
+Design notes (TPU-first, not a torch translation):
+
+- NHWC layout (XLA's native TPU conv layout; torch is NCHW).
+- Separate ``param_dtype`` (fp32 master params) and ``dtype`` (bf16 compute
+  feeds the MXU at full rate; fp32 accumulation is XLA's default for conv).
+- BatchNorm statistics: when ``axis_name`` is set, per-batch mean/var are
+  reduced across that mesh axis inside the traced step (``lax.pmean``) —
+  SyncBatchNorm parity for the explicit ``shard_map`` path. Under plain
+  ``jit`` over a sharded batch the reduction is global automatically (GSPMD
+  inserts the collective), so ``axis_name=None`` is already "sync" there.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+# torchvision-style kaiming_normal(fan_out) for convs.
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck block (ResNet-50/101/152)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="downsample_conv")(residual)
+            residual = self.norm(name="downsample_bn")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet.
+
+    Attributes:
+      stage_sizes: blocks per stage, e.g. (2, 2, 2, 2) for ResNet-18.
+      block_cls: BasicBlock or BottleneckBlock.
+      num_classes: classifier width (10 for CIFAR parity, 1000 for ImageNet).
+      stem: 'imagenet' (7x7/2 + maxpool — what torchvision applies even to
+        CIFAR in the reference) or 'cifar' (3x3/1, no pool — the standard
+        CIFAR variant, better accuracy on 32x32).
+      axis_name: mesh axis for cross-replica BatchNorm stats (SyncBN); None
+        for local/GSPMD-automatic stats.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 10
+    num_filters: int = 64
+    stem: str = "imagenet"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    axis_name: str | None = None
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv,
+            use_bias=False,
+            padding="SAME",
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=conv_kernel_init,
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            axis_name=self.axis_name if train else None,
+        )
+
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = self.act(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        elif self.stem == "cifar":
+            x = conv(self.num_filters, (3, 3), (1, 1), name="conv_init")(x)
+            x = norm(name="bn_init")(x)
+            x = self.act(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
+
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2 ** i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=self.act,
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(
+            self.num_classes, dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=nn.initializers.variance_scaling(1 / 3, "fan_in", "uniform"),
+        )(x)
+        # Logits in fp32: softmax-CE in low precision loses accuracy.
+        return x.astype(jnp.float32)
+
+
+STAGE_SIZES = {
+    "resnet18": ((2, 2, 2, 2), BasicBlock),
+    "resnet34": ((3, 4, 6, 3), BasicBlock),
+    "resnet50": ((3, 4, 6, 3), BottleneckBlock),
+    "resnet101": ((3, 4, 23, 3), BottleneckBlock),
+    "resnet152": ((3, 8, 36, 3), BottleneckBlock),
+}
+
+
+def make_resnet(name: str, **kwargs) -> ResNet:
+    sizes, block = STAGE_SIZES[name]
+    return ResNet(stage_sizes=sizes, block_cls=block, **kwargs)

@@ -1,0 +1,6 @@
+from distributed_training_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicated,
+    state_shardings,
+    zero_leaf_sharding,
+)

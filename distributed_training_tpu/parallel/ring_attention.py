@@ -1,0 +1,146 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference has no attention model and no sequence parallelism
+(SURVEY.md §5 "Long-context": its only model is torchvision resnet18), but
+long-context support is first-class here. The TPU-native formulation: shard
+the sequence over a ``sequence`` mesh axis and rotate key/value blocks
+around the ring with ``lax.ppermute`` (neighbor hops ride the ICI torus),
+accumulating attention with the online-softmax (flash) recurrence so the
+full [T, T] score matrix never materializes. Compute per hop is a dense
+[T/n, d] x [d, T/n] matmul — MXU-shaped — and XLA overlaps each hop's
+ppermute with the previous block's compute.
+
+Used inside ``shard_map`` (the axis must be bound); the pure math
+:func:`ring_attention` is also exact single-device when ``axis_size == 1``,
+which is what the correctness tests compare against full attention.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _online_block_update(o, m, l, s, v):
+    """One flash-attention accumulation step.
+
+    o: [..., Tq, d] running (unnormalized) output
+    m: [..., Tq]    running row max
+    l: [..., Tq]    running row sum of exp
+    s: [..., Tq, Tk] raw scores for this block
+    v: [..., Tk, d] values for this block
+    """
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp of current block, shifted by the new max
+    p = jnp.exp(s - m_new[..., None])
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + p.sum(axis=-1)
+    o_new = o * correction[..., None] + jnp.einsum("...qk,...kd->...qd", p, v)
+    return o_new, m_new, l_new
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str | None,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Blockwise ring attention over ``axis_name``.
+
+    Args:
+      q, k, v: [batch, heads, T_local, head_dim] — the local sequence shard.
+      axis_name: bound mesh axis to ring over; None = single-block (exact
+        softmax attention, used as the test oracle).
+      causal: apply a causal mask using *global* positions (each shard knows
+        its ring index, so masks are exact across shards).
+
+    Returns [batch, heads, T_local, head_dim].
+    """
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    t_local = q.shape[-2]
+
+    if axis_name is None:
+        s = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+        if causal:
+            qpos = jnp.arange(t_local)[:, None]
+            kpos = jnp.arange(t_local)[None, :]
+            s = jnp.where(kpos > qpos, jnp.finfo(s.dtype).min, s)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+        return jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    # Accumulate in fp32 regardless of compute dtype: the recurrence
+    # subtracts running maxima and sums many exps — bf16 drifts.
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:-1], -jnp.inf, jnp.float32)
+    l = jnp.zeros(q.shape[:-1], jnp.float32)
+    qf = q.astype(jnp.float32)
+
+    def hop(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        # After i hops each device holds the block originating at ring
+        # position (my_idx + i) mod axis_size (ppermute shifts index -1).
+        src = (my_idx + i) % axis_size
+        s = jnp.einsum("...qd,...kd->...qk", qf, k_blk.astype(jnp.float32))
+        s = s * scale
+        if causal:
+            qpos = my_idx * t_local + jnp.arange(t_local)
+            kpos = src * t_local + jnp.arange(k_blk.shape[-2])
+            mask = kpos[None, :] > qpos[:, None]
+            s = jnp.where(mask, -jnp.inf, s)
+        o, m, l = _online_block_update(o, m, l, s, v_blk.astype(jnp.float32))
+        perm = [(j, (j - 1) % axis_size) for j in range(axis_size)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk
+
+    o, m, l, _, _ = lax.fori_loop(0, axis_size, hop, (o, m, l, k, v))
+    # Fully-masked rows (causal, strictly-future shards) have l == 0; the
+    # where avoids 0/0 — their output is defined as 0.
+    out = jnp.where(l[..., None] > 0, o / jnp.maximum(l, 1e-30)[..., None], 0.0)
+    return out.astype(v.dtype)
+
+
+class RingSelfAttention(nn.Module):
+    """Multi-head self-attention with ring-parallel sequence sharding.
+
+    Drop-in for ``nn.MultiHeadDotProductAttention`` inside models whose
+    sequence dimension is sharded over ``axis_name`` (e.g. ViT encoder
+    blocks under a ``sequence`` mesh axis). QKV/out projections are local
+    (position-wise); only K/V blocks travel the ring.
+    """
+
+    num_heads: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    axis_name: str | None = None
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        d = x.shape[-1]
+        if d % self.num_heads:
+            raise ValueError(f"hidden {d} not divisible by {self.num_heads} heads")
+        head_dim = d // self.num_heads
+        dense = functools.partial(
+            nn.DenseGeneral, dtype=self.dtype, param_dtype=self.param_dtype)
+
+        qkv = dense(features=(3, self.num_heads, head_dim), name="qkv")(x)
+        q, k, v = jnp.moveaxis(qkv, -3, 0)
+        # [B, T, H, hd] -> [B, H, T, hd]
+        q, k, v = (jnp.swapaxes(t, -3, -2) for t in (q, k, v))
+
+        out = ring_attention(
+            q, k, v, axis_name=self.axis_name, causal=self.causal)
+
+        out = jnp.swapaxes(out, -3, -2)  # back to [B, T, H, hd]
+        return dense(
+            features=d, axis=(-2, -1), name="out")(out)

@@ -1,0 +1,3 @@
+from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh  # noqa: F401
+from distributed_training_tpu.runtime.coordinator import Coordinator  # noqa: F401
+from distributed_training_tpu.runtime.distributed import initialize_distributed  # noqa: F401

@@ -1,0 +1,88 @@
+"""Multi-process coordination utilities.
+
+TPU-native replacement for ColossalAI's ``DistCoordinator``
+(``resnet/colossal/colossal_train.py:111``): master-rank gating
+(``is_master()`` at ``:88``) and serialized rank-0-first execution
+(``coordinator.priority_execution()`` around the CIFAR-10 download,
+``:65-73``), plus the DDP trainer's implicit rank conventions.
+
+In JAX the unit of coordination is the *process* (one per host), not the
+device rank; ``jax.process_index()`` replaces ``dist.get_rank()`` and a
+global-device barrier replaces the torch store barrier.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+
+def _barrier(tag: str) -> None:
+    """Block until every process reaches this point.
+
+    Implemented as a tiny psum across all devices (the canonical JAX
+    multihost barrier); a no-op in single-process runs.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+class Coordinator:
+    """Process-level coordination facade."""
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index()
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count()
+
+    @property
+    def world_size(self) -> int:
+        """Device count = DP world size analogue (``coordinator.world_size``
+        in ``resnet/colossal/colossal_train.py:122``)."""
+        return jax.device_count()
+
+    def is_master(self) -> bool:
+        return jax.process_index() == 0
+
+    @contextlib.contextmanager
+    def priority_execution(self, tag: str = "priority_execution"):
+        """Master process runs the body first; others wait, then run.
+
+        Mirrors ``DistCoordinator.priority_execution``
+        (``resnet/colossal/colossal_train.py:65-73``): serializes e.g. a
+        dataset download so processes don't race on the filesystem.
+        """
+        if not self.is_master():
+            _barrier(tag + ":enter")
+        try:
+            yield
+        finally:
+            if self.is_master():
+                _barrier(tag + ":enter")
+            _barrier(tag + ":exit")
+
+    def barrier(self, tag: str = "barrier") -> None:
+        _barrier(tag)
+
+    def print(self, *args, **kwargs) -> None:
+        """Master-only print (tqdm-gating parity,
+        ``resnet/colossal/colossal_train.py:88``)."""
+        if self.is_master():
+            print(*args, **kwargs)
+
+    def broadcast_scalar(self, value: float) -> float:
+        """Agree on a host-side scalar across processes (process 0 wins)."""
+        if jax.process_count() == 1:
+            return value
+        from jax.experimental import multihost_utils
+
+        arr = np.asarray([value], dtype=np.float32)
+        return float(multihost_utils.broadcast_one_to_all(arr)[0])

@@ -1,0 +1,72 @@
+"""Multi-host runtime initialization.
+
+TPU-native replacement for the reference's rendezvous layer:
+
+- ``init_process_group(backend="nccl", ...)`` + ``MASTER_ADDR/PORT``
+  (``resnet/pytorch_ddp/ddp_train.py:79-85``)
+- ``deepspeed.init_distributed()`` (``resnet/deepspeed/deepspeed_train.py:168``)
+- ``colossalai.launch_from_torch`` (``resnet/colossal/colossal_train.py:110``)
+
+JAX runs one process per host; ``jax.distributed.initialize`` performs the
+rendezvous (coordinator TCP store, like MASTER_ADDR:MASTER_PORT) after which
+all collectives compile to XLA programs over ICI/DCN — there is no NCCL-style
+communicator object to thread through user code.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize the multi-host JAX runtime (idempotent).
+
+    Args resolve from the environment when omitted, mirroring the launcher
+    env contract (``RANK``/``WORLD_SIZE``/``MASTER_ADDR``/``MASTER_PORT``)
+    that torchrun-style launchers set (``resnet/colossal/run.sh:1``):
+
+    - coordinator_address ← ``$MASTER_ADDR:$MASTER_PORT``
+    - num_processes       ← ``$WORLD_SIZE``
+    - process_id          ← ``$RANK``
+
+    On Cloud TPU pods all three are auto-discovered by JAX and calling with
+    no args is correct. Single-process runs skip initialization entirely.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+
+    if coordinator_address is None and "MASTER_ADDR" in os.environ:
+        port = os.environ.get("MASTER_PORT", "12355")
+        coordinator_address = f"{os.environ['MASTER_ADDR']}:{port}"
+    if num_processes is None and "WORLD_SIZE" in os.environ:
+        num_processes = int(os.environ["WORLD_SIZE"])
+    if process_id is None and "RANK" in os.environ:
+        process_id = int(os.environ["RANK"])
+
+    if num_processes is None or num_processes <= 1:
+        _INITIALIZED = True
+        return
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _INITIALIZED = True
+
+
+def shutdown_distributed() -> None:
+    """``destroy_process_group`` parity (``resnet/pytorch_ddp/ddp_train.py:87-88``)."""
+    global _INITIALIZED
+    if jax.process_count() > 1:
+        jax.distributed.shutdown()
+    _INITIALIZED = False

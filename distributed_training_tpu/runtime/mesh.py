@@ -1,0 +1,110 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's device pinning and process fan-out
+(``CUDA_VISIBLE_DEVICES`` in ``resnet/pytorch_ddp/run.sh:1`` /
+``resnet/colossal/run.sh:1``, ``torch.cuda.set_device(rank)`` at
+``resnet/pytorch_ddp/ddp_train.py:85``, ``mp.spawn`` at ``:112-114``).
+
+On TPU there is no per-rank device pinning: every process sees its local
+chips, topology discovery is automatic, and parallelism is expressed as a
+logical ``jax.sharding.Mesh`` whose axes map onto the ICI torus (intra-slice)
+and DCN (inter-slice). The canonical axes used throughout this framework:
+
+- ``data``     — batch (DP) axis; gradient all-reduce rides here.
+- ``fsdp``     — parameter/optimizer sharding axis (ZeRO-3 / FSDP).
+- ``model``    — tensor-parallel axis (megatron-style layer splits).
+- ``expert``   — expert-parallel axis for MoE all-to-all dispatch.
+- ``sequence`` — sequence/context-parallel axis (ring attention).
+
+A pure-DP mesh is simply ``create_mesh()`` → ``Mesh(devices, ('data',))``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_MODEL = "model"
+AXIS_EXPERT = "expert"
+AXIS_SEQUENCE = "sequence"
+
+# Order matters: outer-to-inner, so `data` varies slowest. On multi-slice
+# topologies the slowest axis lands on DCN and the fast axes stay on ICI,
+# which is where the per-step collectives (psum over `model`/`fsdp`) belong.
+CANONICAL_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_EXPERT, AXIS_SEQUENCE)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical axis sizes. ``-1`` infers the size from the device count."""
+
+    data: int = -1
+    fsdp: int = 1
+    model: int = 1
+    expert: int = 1
+    sequence: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {
+            AXIS_DATA: self.data,
+            AXIS_FSDP: self.fsdp,
+            AXIS_MODEL: self.model,
+            AXIS_EXPERT: self.expert,
+            AXIS_SEQUENCE: self.sequence,
+        }
+
+
+def create_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    axis_names: Sequence[str] | None = None,
+) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` from logical axis sizes.
+
+    Exactly one axis may be ``-1``; its size is inferred so the product of
+    axis sizes equals the device count. Axes of size 1 are kept in the mesh
+    (harmless: a PartitionSpec over a size-1 axis is a no-op shard), so the
+    same sharding annotations work across every topology.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+
+    sizes = config.sizes()
+    names = list(axis_names or CANONICAL_AXES)
+    dims = [sizes[a] for a in names]
+
+    infer = [i for i, d in enumerate(dims) if d == -1]
+    if len(infer) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {names}={dims}")
+    fixed = math.prod(d for d in dims if d != -1)
+    if infer:
+        if n % fixed != 0:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {fixed}")
+        dims[infer[0]] = n // fixed
+    elif fixed != n:
+        raise ValueError(f"mesh {dict(zip(names, dims))} needs {fixed} devices, have {n}")
+
+    mesh_devices = np.asarray(devices).reshape(dims)
+    return Mesh(mesh_devices, tuple(names))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Replica count for DP semantics: product of data-like axes.
+
+    This is the ``world_size`` analogue used for linear LR scaling
+    (``resnet/pytorch_ddp/ddp_train.py:110``,
+    ``resnet/colossal/colossal_train.py:116-122``): the number of distinct
+    data shards, i.e. data × fsdp (fsdp shards the batch too under ZeRO-3).
+    """
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return shape.get(AXIS_DATA, 1) * shape.get(AXIS_FSDP, 1)

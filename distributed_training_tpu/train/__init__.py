@@ -1,0 +1,17 @@
+from distributed_training_tpu.train.precision import (  # noqa: F401
+    LossScaleState,
+    Policy,
+    all_finite,
+)
+from distributed_training_tpu.train.optim import make_optimizer, make_schedule  # noqa: F401
+from distributed_training_tpu.train.step import (  # noqa: F401
+    cross_entropy_loss,
+    make_eval_step,
+    make_shard_map_train_step,
+    make_train_step,
+)
+from distributed_training_tpu.train.train_state import (  # noqa: F401
+    TrainState,
+    init_train_state,
+)
+from distributed_training_tpu.train.trainer import Trainer  # noqa: F401

@@ -1,0 +1,143 @@
+"""Mixed-precision policy and dynamic loss scaling.
+
+Reproduces the DeepSpeed fp16 engine semantics
+(``resnet/deepspeed/deepspeed_train.py:197-208``) as *traced* state — the
+reference updates its scaler in eager Python per step; here the scaler state
+lives in the train state and every transition is a ``jnp.where`` select, so
+the whole train step stays one XLA program with no recompilation
+(SURVEY.md §7 hard parts: "fp16 dynamic loss scaling as traced control flow").
+
+Semantics implemented (DeepSpeed DynamicLossScaler):
+- dynamic scale starting at ``2**initial_scale_power`` (default 2^15);
+- on overflow (non-finite grads): skip the update; if the hysteresis budget
+  is exhausted, halve the scale (floored at ``min_loss_scale``), else just
+  consume one hysteresis credit;
+- after ``loss_scale_window`` consecutive good steps: double the scale and
+  refill the hysteresis budget.
+
+bf16 needs no scaling on TPU (same exponent range as fp32) — policy 'bf16'
+uses scale ≡ 1 and the scaler becomes inert.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from distributed_training_tpu.config import PrecisionConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype policy: params master copy, compute, and output dtypes."""
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+
+    @staticmethod
+    def from_config(cfg: PrecisionConfig) -> "Policy":
+        compute = {
+            "fp32": jnp.float32,
+            "bf16": jnp.bfloat16,
+            "fp16": jnp.float16,
+        }[cfg.dtype]
+        return Policy(param_dtype=jnp.float32, compute_dtype=compute)
+
+    def cast_to_compute(self, tree):
+        return jax.tree.map(lambda x: x.astype(self.compute_dtype), tree)
+
+
+class LossScaleState(struct.PyTreeNode):
+    """Traced dynamic loss-scaler state (a pytree carried in TrainState)."""
+
+    scale: jnp.ndarray            # f32 scalar
+    good_steps: jnp.ndarray       # i32 scalar — consecutive overflow-free steps
+    hysteresis_left: jnp.ndarray  # i32 scalar — overflows tolerated before halving
+    # Static config (not traced):
+    window: int = struct.field(pytree_node=False, default=500)
+    hysteresis: int = struct.field(pytree_node=False, default=2)
+    min_scale: float = struct.field(pytree_node=False, default=1.0)
+    max_scale: float = struct.field(pytree_node=False, default=float(2 ** 24))
+    dynamic: bool = struct.field(pytree_node=False, default=True)
+
+    @staticmethod
+    def create(cfg: PrecisionConfig) -> "LossScaleState":
+        if cfg.dtype != "fp16":
+            # Inert scaler: scale 1, never updated.
+            return LossScaleState(
+                scale=jnp.float32(1.0),
+                good_steps=jnp.int32(0),
+                hysteresis_left=jnp.int32(1),
+                dynamic=False,
+            )
+        if cfg.static_loss_scale is not None:
+            return LossScaleState(
+                scale=jnp.float32(cfg.static_loss_scale),
+                good_steps=jnp.int32(0),
+                hysteresis_left=jnp.int32(cfg.hysteresis),
+                window=cfg.loss_scale_window,
+                hysteresis=cfg.hysteresis,
+                min_scale=cfg.min_loss_scale,
+                dynamic=False,
+            )
+        return LossScaleState(
+            scale=jnp.float32(cfg.initial_scale),
+            good_steps=jnp.int32(0),
+            hysteresis_left=jnp.int32(cfg.hysteresis),
+            window=cfg.loss_scale_window,
+            hysteresis=cfg.hysteresis,
+            min_scale=cfg.min_loss_scale,
+            dynamic=True,
+        )
+
+    def scale_loss(self, loss: jnp.ndarray) -> jnp.ndarray:
+        return loss * self.scale.astype(loss.dtype)
+
+    def unscale_grads(self, grads):
+        inv = (1.0 / self.scale).astype(jnp.float32)
+        return jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+
+    def update(self, grads_finite: jnp.ndarray) -> "LossScaleState":
+        """One traced scaler transition. ``grads_finite``: bool scalar."""
+        if not self.dynamic:
+            return self
+
+        # Good path: count up; double at window boundary, refill hysteresis.
+        good = self.good_steps + 1
+        grow = good >= self.window
+        good_scale = jnp.where(
+            grow, jnp.minimum(self.scale * 2.0, self.max_scale), self.scale)
+        good_steps_next = jnp.where(grow, 0, good)
+        good_hyst = jnp.where(grow, jnp.int32(self.hysteresis), self.hysteresis_left)
+
+        # Overflow path: consume hysteresis; halve only when exhausted.
+        halve = self.hysteresis_left <= 1
+        bad_scale = jnp.where(
+            halve, jnp.maximum(self.scale / 2.0, self.min_scale), self.scale)
+        bad_hyst = jnp.where(
+            halve, jnp.int32(self.hysteresis), self.hysteresis_left - 1)
+
+        return self.replace(
+            scale=jnp.where(grads_finite, good_scale, bad_scale),
+            good_steps=jnp.where(grads_finite, good_steps_next, 0),
+            hysteresis_left=jnp.where(grads_finite, good_hyst, bad_hyst),
+        )
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """True iff every leaf of the tree is finite (overflow detector)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.bool_(True)
+    checks = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(checks).all()
+
+
+def select_tree(pred: jnp.ndarray, on_true, on_false):
+    """Elementwise ``where`` over matching pytrees (skip-step on overflow)."""
+    return jax.tree.map(
+        lambda t, f: jnp.where(pred, t, f), on_true, on_false)

@@ -1,0 +1,247 @@
+"""The jitted train/eval step.
+
+This is the TPU-native rewrite of the reference's shared hot loop
+(SURVEY.md §3): per step the reference does
+``host→device copy → forward → loss → backward (+NCCL all-reduce) →
+optimizer.step() → loss.item() host sync``
+(``resnet/pytorch_ddp/ddp_train.py:61-75``,
+``resnet/deepspeed/deepspeed_train.py:143-158``,
+``resnet/colossal/colossal_train.py:89-105``).
+
+Here the whole transition — forward, loss, backward, gradient all-reduce,
+loss-scale handling, clipping, Adam update, scheduler tick — is ONE XLA
+program: ``(state, batch, rng) -> (state, metrics)`` under ``jax.jit`` over a
+device mesh. Collectives are not written by hand: the batch is sharded over
+the ``data`` axis while params are replicated (or ZeRO-sharded), so GSPMD
+materializes the gradient all-reduce (or reduce-scatter) itself and XLA's
+latency-hiding scheduler overlaps it with the backward pass — the knobs
+DeepSpeed exposes for this (bucket sizes, ``overlap_comm``,
+``deepspeed_train.py:214-216``) have no TPU equivalent because the compiler
+owns the schedule.
+
+Metrics stay on device; the host fetches them every ``log_interval`` steps
+(no per-step ``loss.item()`` sync — SURVEY.md §7 "steady-state step without
+host syncs").
+
+An explicit-collective variant built on ``shard_map`` + ``lax.pmean`` is
+provided for parity demonstration and for tests that pin down the collective
+math (the DDP-equivalence property, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_training_tpu.parallel.sharding import (
+    batch_sharding,
+    replicated,
+    state_shardings,
+)
+from distributed_training_tpu.runtime.mesh import AXIS_DATA
+from distributed_training_tpu.train.precision import all_finite, select_tree
+from distributed_training_tpu.train.train_state import TrainState
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax CE over the (local) batch — ``nn.CrossEntropyLoss`` parity."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def _forward_and_loss(state: TrainState, params, batch, rng, train: bool):
+    variables = {"params": params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    if train:
+        logits, mutated = state.apply_fn(
+            variables, batch["image"], train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": rng},
+        )
+        new_batch_stats = dict(mutated).get("batch_stats", state.batch_stats)
+    else:
+        logits = state.apply_fn(variables, batch["image"], train=False)
+        new_batch_stats = state.batch_stats
+    loss = cross_entropy_loss(logits, batch["label"])
+    return loss, logits, new_batch_stats
+
+
+def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None):
+    """Shared step body for the GSPMD and shard_map paths.
+
+    When ``axis_name`` is set (shard_map path), gradients/metrics are
+    explicitly ``lax.pmean``-ed over that axis — the hand-written analogue of
+    DDP's bucketed NCCL all-reduce. When None (GSPMD path), the same
+    collective is inserted by the partitioner.
+    """
+
+    def loss_fn(params):
+        loss, logits, new_bs = _forward_and_loss(state, params, batch, rng, train=True)
+        return state.loss_scale.scale_loss(loss), (loss, logits, new_bs)
+
+    grads, (loss, logits, new_batch_stats) = jax.grad(
+        loss_fn, has_aux=True)(state.params)
+
+    if axis_name is not None:
+        grads = jax.lax.pmean(grads, axis_name)
+
+    grads = state.loss_scale.unscale_grads(grads)
+
+    if state.loss_scale.dynamic:
+        finite = all_finite(grads)
+        candidate = state.apply_gradients(grads)
+        new_state = select_tree(
+            finite,
+            candidate.replace(loss_scale=state.loss_scale.update(finite)),
+            state.replace(loss_scale=state.loss_scale.update(finite)),
+        )
+        # select_tree ran jnp.where over every leaf incl. step; recompute the
+        # step explicitly so a skipped step doesn't tick the scheduler.
+        # BatchNorm stats from an overflowed forward are non-finite — commit
+        # them only on good steps, or one bad batch would poison the running
+        # mean/var permanently (every later eval would see NaN logits).
+        new_state = new_state.replace(
+            step=state.step + finite.astype(jnp.int32),
+            batch_stats=select_tree(finite, new_batch_stats, state.batch_stats),
+        )
+    else:
+        finite = jnp.bool_(True)
+        new_state = state.apply_gradients(grads)
+        new_state = new_state.replace(batch_stats=new_batch_stats)
+
+    accuracy = jnp.mean(
+        (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
+    if axis_name is not None:
+        loss = jax.lax.pmean(loss, axis_name)
+        accuracy = jax.lax.pmean(accuracy, axis_name)
+    metrics = {
+        "loss": loss.astype(jnp.float32),
+        "accuracy": accuracy,
+        "loss_scale": new_state.loss_scale.scale,
+        "grads_finite": finite.astype(jnp.float32),
+    }
+    return new_state, metrics
+
+
+def make_train_step(
+    mesh: Mesh,
+    *,
+    zero_stage: int = 0,
+    donate: bool = True,
+) -> Callable:
+    """Build the GSPMD jitted train step for a mesh + ZeRO stage.
+
+    Returns ``step(state, batch, rng) -> (state, metrics)``. Shardings are
+    resolved lazily from the first state's structure (abstract eval — no
+    device transfer) and cached on the returned closure.
+    """
+    cache: dict[Any, Callable] = {}
+
+    def step(state: TrainState, batch, rng):
+        treedef = jax.tree.structure((state, batch))
+        fn = cache.get(treedef)
+        if fn is None:
+            sshard = state_shardings(state, mesh, zero_stage)
+            bshard = {
+                "image": batch_sharding(mesh, batch["image"].ndim),
+                "label": batch_sharding(mesh, batch["label"].ndim),
+            }
+            fn = jax.jit(
+                functools.partial(_step_body, axis_name=None),
+                in_shardings=(sshard, bshard, replicated(mesh)),
+                out_shardings=(sshard, replicated(mesh)),
+                donate_argnums=(0,) if donate else (),
+            )
+            cache[treedef] = fn
+        return fn(state, batch, rng)
+
+    return step
+
+
+def make_shard_map_train_step(mesh: Mesh, donate: bool = True) -> Callable:
+    """Explicit-collective DP train step (``shard_map`` + ``lax.pmean``).
+
+    The hand-written formulation of DDP's gradient all-reduce
+    (``resnet/pytorch_ddp/ddp_train.py:70``): each device computes grads on
+    its batch shard, then ``pmean`` over the ``data`` axis; params and
+    optimizer state replicated. Used to pin down collective math in tests
+    and as the template for SyncBN (the model's ``axis_name`` must be
+    ``'data'`` so BatchNorm stats pmean over the same axis).
+    """
+
+    def _smap(fn, in_specs, out_specs):
+        try:
+            return _shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False)
+        except TypeError:  # older jax spells the flag check_rep
+            return _shard_map(
+                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False)
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(state: TrainState, batch, rng):
+        sharded = _smap(
+            functools.partial(_step_body, axis_name=AXIS_DATA),
+            in_specs=(
+                jax.tree.map(lambda _: P(), state),
+                {"image": P(AXIS_DATA), "label": P(AXIS_DATA)},
+                P(),
+            ),
+            out_specs=(jax.tree.map(lambda _: P(), state), P()),
+        )
+        return sharded(state, batch, rng)
+
+    return step
+
+
+def make_eval_step(mesh: Mesh | None = None) -> Callable:
+    """Jitted eval step: per-batch (correct_count, example_count).
+
+    The reference builds a ``test_dataloader`` but never consumes it
+    (SURVEY.md §2.5); this wires the missing eval pass so the
+    ``--target_acc`` gate (``resnet/colossal/colossal_train.py:43-46``) is
+    functional. ``batch['mask']`` (0/1 per example) handles the ragged last
+    batch instead of DistributedSampler's pad-by-repeat.
+    """
+
+    def eval_body(state: TrainState, batch):
+        _, logits, _ = _forward_and_loss(
+            state, state.params, batch, jax.random.PRNGKey(0), train=False)
+        correct = (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones_like(correct)
+        return jnp.sum(correct * mask), jnp.sum(mask)
+
+    if mesh is None:
+        return jax.jit(eval_body)
+
+    # One jitted wrapper per batch key-set (mask present or not), hoisted out
+    # of the per-batch call so eval batches hit jit's C++ fastpath.
+    cache: dict[tuple, Callable] = {}
+
+    def step(state, batch):
+        key = tuple(sorted(batch))
+        fn = cache.get(key)
+        if fn is None:
+            shardings = {k: batch_sharding(mesh, batch[k].ndim) for k in batch}
+            fn = jax.jit(
+                eval_body,
+                in_shardings=(None, shardings),
+                out_shardings=(replicated(mesh), replicated(mesh)),
+            )
+            cache[key] = fn
+        return fn(state, batch)
+
+    return step

@@ -1,0 +1,74 @@
+"""Train state: the single pytree carried through the jitted step.
+
+Replaces the reference's mutable per-rank objects (DDP-wrapped module +
+optimizer + scaler inside ``model_engine`` / ``booster``) with one immutable
+functional state — params, BatchNorm running stats, optimizer state, dynamic
+loss-scale state, and the step counter — so the whole
+fwd → bwd → all-reduce → update transition is a pure function
+``(state, batch) -> (state, metrics)`` compiled once by XLA
+(SURVEY.md §3 "Shared hot loop").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core, struct
+
+from distributed_training_tpu.train.precision import LossScaleState
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: core.FrozenDict | dict
+    batch_stats: core.FrozenDict | dict
+    opt_state: optax.OptState
+    loss_scale: LossScaleState
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx, batch_stats=None, loss_scale=None):
+        return cls(
+            step=jnp.int32(0),
+            params=params,
+            batch_stats=batch_stats if batch_stats is not None else {},
+            opt_state=tx.init(params),
+            loss_scale=loss_scale if loss_scale is not None else
+            LossScaleState(
+                scale=jnp.float32(1.0), good_steps=jnp.int32(0),
+                hysteresis_left=jnp.int32(1), dynamic=False),
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state)
+
+
+def init_train_state(
+    model,
+    rng: jax.Array,
+    input_shape: tuple,
+    tx: optax.GradientTransformation,
+    loss_scale: LossScaleState | None = None,
+    input_dtype=jnp.float32,
+) -> TrainState:
+    """Initialize params + batch_stats with a dummy batch (shape-only trace)."""
+    dummy = jnp.zeros(input_shape, input_dtype)
+    variables = model.init({"params": rng, "dropout": rng}, dummy, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState.create(
+        apply_fn=model.apply, params=params, tx=tx,
+        batch_stats=batch_stats, loss_scale=loss_scale)
+
+
+def param_count(params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))

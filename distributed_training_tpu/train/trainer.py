@@ -1,0 +1,179 @@
+"""The Trainer: epoch loop, eval, checkpointing, logging.
+
+The framework-level replacement for the reference's three per-backend
+``__main__`` blocks + ``train_epoch`` functions (SURVEY.md §1 L2): one
+engine parameterized by :class:`TrainConfig`, with every dangling surface of
+the reference wired for real — the eval loop the reference never runs
+(``test_dataloader`` built and dropped, ``resnet/pytorch_ddp/ddp_train.py:96``),
+the ``--target_acc`` assertion (``resnet/colossal/colossal_train.py:43-46``),
+and checkpoint save/resume (``:40-42``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_training_tpu import checkpoint as ckpt_lib
+from distributed_training_tpu.config import TrainConfig
+from distributed_training_tpu.data.pipeline import build_dataloaders, to_global_batch
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.parallel.sharding import (
+    batch_sharding,
+    place_state,
+    state_shardings,
+)
+from distributed_training_tpu.runtime.coordinator import Coordinator
+from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh, data_axis_size
+from distributed_training_tpu.train.optim import make_optimizer
+from distributed_training_tpu.train.precision import LossScaleState, Policy
+from distributed_training_tpu.train.step import make_eval_step, make_train_step
+from distributed_training_tpu.train.train_state import init_train_state, param_count
+from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
+from distributed_training_tpu.utils.profiling import WallClock, trace
+
+
+class Trainer:
+    """End-to-end training engine over a device mesh."""
+
+    def __init__(self, cfg: TrainConfig, mesh=None):
+        self.cfg = cfg
+        self.coord = Coordinator()
+        self.mesh = mesh if mesh is not None else create_mesh(
+            MeshConfig(
+                data=cfg.mesh.data, fsdp=cfg.mesh.fsdp, model=cfg.mesh.model,
+                expert=cfg.mesh.expert, sequence=cfg.mesh.sequence))
+        self.world_size = data_axis_size(self.mesh)
+
+        if cfg.moe.enabled and not cfg.model.startswith("moe"):
+            raise NotImplementedError(
+                f"MoE is only wired into the moe_* models (models/moe.py); "
+                f"model {cfg.model!r} would silently train dense")
+        if not cfg.sync_batchnorm:
+            import warnings
+
+            warnings.warn(
+                "sync_batchnorm=False: the GSPMD train step still reduces BN "
+                "statistics over the global batch (local-BN needs the "
+                "shard_map step); statistics will be global")
+
+        policy = Policy.from_config(cfg.precision)
+        self.model = get_model(
+            cfg.model,
+            num_classes=cfg.data.num_classes,
+            dtype=policy.compute_dtype,
+            axis_name=None,  # GSPMD path: BN sync is automatic over the mesh
+        )
+        self.tx = make_optimizer(cfg.optimizer, cfg.scheduler, self.world_size)
+
+        rng = jax.random.PRNGKey(cfg.seed)
+        self.rng, init_rng = jax.random.split(rng)
+        input_shape = (
+            max(1, cfg.data.batch_size),
+            cfg.data.image_size, cfg.data.image_size, 3)
+        state = init_train_state(
+            self.model, init_rng, input_shape, self.tx,
+            loss_scale=LossScaleState.create(cfg.precision))
+        self.shardings = state_shardings(state, self.mesh, cfg.zero.stage)
+        self.state = place_state(state, self.shardings)
+
+        self.train_step = make_train_step(self.mesh, zero_stage=cfg.zero.stage)
+        self.eval_step = make_eval_step(self.mesh)
+        self.meter = MetricMeter(cfg.log_interval)
+        self.clock = WallClock(cfg.wall_clock_breakdown)
+        self._global_step = 0
+        self.coord.print(
+            f"[trainer] model={cfg.model} params={param_count(state.params):,} "
+            f"mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))} "
+            f"plugin={cfg.plugin} zero_stage={cfg.zero.stage} "
+            f"dtype={cfg.precision.dtype}")
+
+    # -- data ---------------------------------------------------------------
+    def make_loaders(self):
+        return build_dataloaders(self.cfg, self.coord, seed=self.cfg.seed)
+
+    def _batch_shardings(self, batch):
+        return {k: batch_sharding(self.mesh, v.ndim) for k, v in batch.items()}
+
+    # -- train --------------------------------------------------------------
+    def train_epoch(self, epoch: int, loader) -> dict:
+        loader.set_epoch(epoch)
+        bar = EpochBar(len(loader), epoch, self.cfg.num_epochs,
+                       self.coord.is_master())
+        for batch in loader:
+            with self.clock.phase("data"):
+                gbatch = to_global_batch(
+                    batch, self.mesh, self._batch_shardings(batch))
+            with self.clock.phase("step"):
+                self.rng, step_rng = jax.random.split(self.rng)
+                self.state, metrics = self.train_step(
+                    self.state, gbatch, step_rng)
+            with self.clock.phase("log"):
+                # Host-side counter: metrics stay device-resident until the
+                # meter's interval flush — no per-step loss.item() sync.
+                self._global_step += 1
+                fetched = self.meter.push(self._global_step, metrics)
+                bar.update()
+                if fetched:
+                    bar.set_postfix(self.meter.last)
+        bar.set_postfix(self.meter.flush())
+        bar.close()
+        if self.cfg.wall_clock_breakdown:
+            self.coord.print(f"[wall_clock] {self.clock.report()}")
+        return self.meter.last
+
+    # -- eval ---------------------------------------------------------------
+    def evaluate(self, loader) -> float:
+        correct = 0.0
+        total = 0.0
+        for batch in loader:
+            gbatch = to_global_batch(
+                batch, self.mesh, self._batch_shardings(batch))
+            c, t = self.eval_step(self.state, gbatch)
+            correct += float(c)
+            total += float(t)
+        return correct / max(total, 1.0)
+
+    # -- full run -----------------------------------------------------------
+    def fit(self) -> dict:
+        cfg = self.cfg
+        train_loader, eval_loader = self.make_loaders()
+
+        start_epoch = 0
+        if cfg.checkpoint.resume >= 0:
+            self.state, start_epoch = ckpt_lib.restore_checkpoint(
+                cfg.checkpoint.directory, cfg.checkpoint.resume, self.state)
+            self.state = place_state(self.state, self.shardings)
+            self.coord.print(f"[trainer] resumed at epoch {start_epoch}")
+
+        final_acc = None
+        last_eval_epoch = -1
+        with trace(cfg.profile_dir):
+            for epoch in range(start_epoch, cfg.num_epochs):
+                self.train_epoch(epoch, train_loader)
+                if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                    final_acc = self.evaluate(eval_loader)
+                    last_eval_epoch = epoch + 1
+                    self.coord.print(
+                        f"[eval] epoch {epoch + 1}: top-1 {final_acc:.4f}")
+                if cfg.checkpoint.interval and (
+                        epoch + 1) % cfg.checkpoint.interval == 0:
+                    ckpt_lib.save_checkpoint(
+                        cfg.checkpoint.directory, epoch, self.state)
+                    ckpt_lib.prune_checkpoints(
+                        cfg.checkpoint.directory, cfg.checkpoint.keep)
+
+        # --target_acc gate, parsed-but-never-used in the reference
+        # (colossal_train.py:43-46) — functional here. Re-evaluate if the
+        # last eval predates the final epoch (eval_every ∤ num_epochs), so
+        # the gate judges the *final* model, not a stale accuracy.
+        if cfg.target_acc is not None:
+            if final_acc is None or last_eval_epoch != cfg.num_epochs:
+                final_acc = self.evaluate(eval_loader)
+            if final_acc < cfg.target_acc:
+                raise RuntimeError(
+                    f"target accuracy {cfg.target_acc} not reached "
+                    f"(got {final_acc:.4f})")
+        return {"final_acc": final_acc, "last_metrics": self.meter.last,
+                "steps": int(jax.device_get(self.state.step))}

@@ -1,0 +1,59 @@
+"""Profiling hooks.
+
+The reference pins NVTX/DLProf wheels but never imports them, and ships
+DeepSpeed's ``wall_clock_breakdown`` flag turned off
+(``resnet/deepspeed/deepspeed_train.py:209``; SURVEY.md §5 "Tracing").
+TPU-native equivalents:
+
+- ``jax.profiler`` traces (TensorBoard trace viewer) via :func:`trace`;
+- ``jax.named_scope`` as the NVTX-range analogue (re-exported);
+- :class:`WallClock` — a working ``wall_clock_breakdown``: wall-time split
+  into data / step / logging phases per epoch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+named_scope = jax.named_scope
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class WallClock:
+    """Phase timer: ``with clock.phase('data'): ...``; report per epoch."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self.totals: dict[str, float] = defaultdict(float)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+
+    def report(self) -> dict[str, float]:
+        out = dict(self.totals)
+        self.totals.clear()
+        return out

@@ -1,0 +1,51 @@
+"""Test harness: a virtual 8-device CPU mesh.
+
+Multi-device-without-hardware strategy per SURVEY.md §4. Two subtleties of
+this environment:
+
+- The axon TPU plugin's sitecustomize runs at interpreter start and calls
+  ``jax.config.update("jax_platforms", "axon,cpu")``, overriding the
+  ``JAX_PLATFORMS`` env var. Tests must run on CPU (the tunnel exposes one
+  real chip and wedges under concurrent backend inits), so we override the
+  *config* back to cpu here — conftest imports before any backend init, so
+  this wins.
+- ``xla_force_host_platform_device_count`` is read at CPU client creation;
+  setting it here (before the first device use) is early enough.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest  # noqa: E402
+
+from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh(devices):
+    return create_mesh(MeshConfig(data=-1))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4(devices):
+    """data=2 × fsdp=4 mesh for ZeRO/FSDP tests."""
+    return create_mesh(MeshConfig(data=2, fsdp=4))

@@ -1,0 +1,115 @@
+"""Data pipeline tests: shard index math, set_epoch shuffling, transforms
+(SURVEY.md §4 'data-shard index math')."""
+
+import numpy as np
+import pytest
+
+from distributed_training_tpu.data.cifar10 import synthetic_cifar10
+from distributed_training_tpu.data.pipeline import ShardedDataLoader
+from distributed_training_tpu.data import transforms
+
+
+def _loader(n=64, gbs=16, pi=0, pc=1, **kw):
+    x, y = synthetic_cifar10(n, train=True)
+    defaults = dict(global_batch_size=gbs, shuffle=True, drop_last=True,
+                    augment="none", train=True, seed=0,
+                    process_index=pi, process_count=pc)
+    defaults.update(kw)
+    return ShardedDataLoader(x, y, **defaults)
+
+
+def test_shards_partition_global_batch():
+    """Across processes, per-process slices tile each global batch exactly."""
+    n, gbs, pc = 64, 16, 4
+    loaders = [_loader(n, gbs, pi=p, pc=pc) for p in range(pc)]
+    for l in loaders:
+        l.set_epoch(0)
+    batches = [list(l) for l in loaders]
+    x, y = synthetic_cifar10(n, train=True)
+    seen = []
+    for step in range(len(loaders[0])):
+        labels = np.concatenate([batches[p][step]["label"] for p in range(pc)])
+        assert len(labels) == gbs
+        seen.append(labels)
+    # With drop_last and n % gbs == 0, every example appears exactly once.
+    all_labels = np.concatenate(seen)
+    assert len(all_labels) == n
+
+
+def test_set_epoch_reshuffles_deterministically():
+    l = _loader()
+    l.set_epoch(0)
+    e0a = [b["label"].copy() for b in l]
+    l.set_epoch(0)
+    e0b = [b["label"].copy() for b in l]
+    l.set_epoch(1)
+    e1 = [b["label"].copy() for b in l]
+    for a, b in zip(e0a, e0b):
+        np.testing.assert_array_equal(a, b)  # same epoch → same order
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(e0a, e1)
+    ), "different epoch must reshuffle"
+
+
+def test_no_shuffle_is_sequential():
+    l = _loader(shuffle=False)
+    x, y = synthetic_cifar10(64, train=True)
+    first = next(iter(l))
+    np.testing.assert_array_equal(first["label"], y[:16])
+
+
+def test_drop_last_true_drops_ragged_batch():
+    l = _loader(n=70, gbs=16)
+    assert len(l) == 4
+    assert sum(1 for _ in l) == 4
+
+
+def test_drop_last_false_pads_with_mask():
+    l = _loader(n=70, gbs=16, drop_last=False, shuffle=False, train=False)
+    batches = list(l)
+    assert len(batches) == 5
+    last = batches[-1]
+    assert last["image"].shape[0] == 16
+    assert last["mask"].sum() == 70 - 64
+    assert all(b["mask"].sum() == 16 for b in batches[:-1])
+
+
+def test_global_batch_must_divide_by_process_count():
+    with pytest.raises(ValueError):
+        _loader(gbs=10, pc=4)
+
+
+def test_pad_crop_flip_shapes_and_range():
+    rng = np.random.RandomState(0)
+    x = np.random.RandomState(1).randint(0, 256, (8, 32, 32, 3), dtype=np.uint8)
+    out = transforms.pad_crop_flip(x, rng)
+    assert out.shape == x.shape
+    assert out.dtype == np.uint8
+
+
+def test_pad_crop_identity_possible():
+    """With pad=0 and no flip chance, crop must be the identity."""
+    class FixedRng:
+        def randint(self, lo, hi, size=None):
+            return np.zeros(size, dtype=np.int64)
+        def rand(self, n):
+            return np.ones(n)  # >= 0.5 → no flip... (flips where < 0.5)
+    x = np.arange(8 * 32 * 32 * 3, dtype=np.uint8).reshape(8, 32, 32, 3) % 255
+    out = transforms.pad_crop_flip(x, FixedRng(), pad=0)
+    np.testing.assert_array_equal(out, x)
+
+
+def test_normalize_half_range():
+    x = np.array([[[[0, 128, 255]]]], dtype=np.uint8)
+    out = transforms.normalize_half(transforms.to_float(x))
+    assert out.min() >= -1.0 and out.max() <= 1.0
+    np.testing.assert_allclose(out[0, 0, 0, 0], -1.0)
+    np.testing.assert_allclose(out[0, 0, 0, 2], 1.0)
+
+
+def test_synthetic_cifar_learnable_structure():
+    x, y = synthetic_cifar10(512, train=True)
+    # Class-conditional means must be ordered — the property making the
+    # synthetic set learnable for convergence smoke tests.
+    means = [x[y == c].mean() for c in range(10) if (y == c).any()]
+    assert all(b > a for a, b in zip(means, means[1:]))

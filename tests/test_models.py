@@ -1,0 +1,74 @@
+"""Model unit tests: shapes, dtypes, param counts (SURVEY.md §4 'Unit')."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_training_tpu.models import available_models, get_model
+from distributed_training_tpu.train.train_state import param_count
+
+
+@pytest.mark.parametrize("name,num_classes", [("resnet18", 10), ("resnet50", 10)])
+def test_resnet_forward_shapes(name, num_classes):
+    model = get_model(name, num_classes=num_classes)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, num_classes)
+    assert logits.dtype == jnp.float32
+
+
+def test_resnet18_param_count_torchvision_parity():
+    # torchvision resnet18(num_classes=10): 11,181,642 params.
+    model = get_model("resnet18", num_classes=10)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    n = param_count(variables["params"])
+    # BatchNorm running stats live in batch_stats, not params — count
+    # trainable only, exactly like model.parameters() in torch.
+    assert n == 11_181_642, n
+
+
+def test_resnet50_param_count_torchvision_parity():
+    # torchvision resnet50(num_classes=1000): 25,557,032 params.
+    model = get_model("resnet50", num_classes=1000)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    assert param_count(variables["params"]) == 25_557_032
+
+
+def test_bf16_compute_fp32_params():
+    model = get_model("resnet18", num_classes=10, dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    leaves = jax.tree.leaves(variables["params"])
+    assert all(l.dtype == jnp.float32 for l in leaves)
+    logits = model.apply(variables, x, train=False)
+    assert logits.dtype == jnp.float32  # fp32 logits for stable CE
+
+
+def test_batch_stats_update_in_train_mode():
+    model = get_model("resnet18", num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    _, mutated = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    old = jax.tree.leaves(variables["batch_stats"])
+    new = jax.tree.leaves(mutated["batch_stats"])
+    assert any(
+        not jnp.allclose(a, b) for a, b in zip(old, new)), "BN stats must move"
+
+
+def test_vit_forward():
+    model = get_model("vit_b16", num_classes=10, hidden_size=64,
+                      num_layers=2, num_heads=4, mlp_dim=128)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+
+
+def test_registry_lists_model_families():
+    names = available_models()
+    for required in ("resnet18", "resnet34", "resnet50", "resnet101",
+                     "resnet152", "vit_b16"):
+        assert required in names

@@ -1,0 +1,130 @@
+"""Dynamic loss-scaler state machine tests (DeepSpeed fp16 semantics,
+``resnet/deepspeed/deepspeed_train.py:203-207``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_training_tpu.config import PrecisionConfig
+from distributed_training_tpu.train.precision import (
+    LossScaleState,
+    all_finite,
+    select_tree,
+)
+
+
+def _cfg(**kw):
+    base = dict(dtype="fp16", initial_scale_power=15, loss_scale_window=500,
+                hysteresis=2, min_loss_scale=1.0)
+    base.update(kw)
+    return PrecisionConfig(**base)
+
+
+def test_initial_scale_is_2_pow_15():
+    s = LossScaleState.create(_cfg())
+    assert float(s.scale) == 2.0 ** 15
+    assert s.dynamic
+
+
+def test_window_of_good_steps_doubles_scale():
+    s = LossScaleState.create(_cfg(loss_scale_window=3))
+    for _ in range(2):
+        s = s.update(jnp.bool_(True))
+        assert float(s.scale) == 2.0 ** 15
+    s = s.update(jnp.bool_(True))  # 3rd good step hits the window
+    assert float(s.scale) == 2.0 ** 16
+    assert int(s.good_steps) == 0
+
+
+def test_hysteresis_defers_halving():
+    # hysteresis=2: first overflow consumes a credit, second halves.
+    s = LossScaleState.create(_cfg())
+    s = s.update(jnp.bool_(False))
+    assert float(s.scale) == 2.0 ** 15
+    assert int(s.hysteresis_left) == 1
+    s = s.update(jnp.bool_(False))
+    assert float(s.scale) == 2.0 ** 14
+    assert int(s.hysteresis_left) == 2  # refilled after halving
+
+
+def test_overflow_resets_good_step_count():
+    s = LossScaleState.create(_cfg(loss_scale_window=4))
+    for _ in range(3):
+        s = s.update(jnp.bool_(True))
+    assert int(s.good_steps) == 3
+    s = s.update(jnp.bool_(False))
+    assert int(s.good_steps) == 0
+
+
+def test_min_loss_scale_floor():
+    s = LossScaleState.create(_cfg(initial_scale_power=1, hysteresis=1,
+                                   min_loss_scale=1.0))
+    for _ in range(10):
+        s = s.update(jnp.bool_(False))
+    assert float(s.scale) == 1.0
+
+
+def test_good_step_refills_hysteresis_only_at_window():
+    s = LossScaleState.create(_cfg(loss_scale_window=2))
+    s = s.update(jnp.bool_(False))           # consume one credit
+    assert int(s.hysteresis_left) == 1
+    s = s.update(jnp.bool_(True))            # good step: credit unchanged
+    assert int(s.hysteresis_left) == 1
+    s = s.update(jnp.bool_(True))            # window hit: doubled + refilled
+    assert int(s.hysteresis_left) == 2
+
+
+def test_static_scale_never_moves():
+    s = LossScaleState.create(_cfg(static_loss_scale=1024.0))
+    assert not s.dynamic
+    s2 = s.update(jnp.bool_(False))
+    assert float(s2.scale) == 1024.0
+
+
+def test_bf16_and_fp32_scaler_inert():
+    for dtype in ("bf16", "fp32"):
+        s = LossScaleState.create(PrecisionConfig(dtype=dtype))
+        assert float(s.scale) == 1.0
+        assert not s.dynamic
+
+
+def test_scaler_update_is_jittable_without_recompile():
+    s = LossScaleState.create(_cfg())
+    traces = []
+
+    @jax.jit
+    def step(s, finite):
+        traces.append(1)
+        return s.update(finite)
+
+    s = step(s, jnp.bool_(True))
+    s = step(s, jnp.bool_(False))
+    s = step(s, jnp.bool_(True))
+    assert len(traces) == 1, "scaler transition must not retrigger tracing"
+
+
+def test_all_finite_detects_overflow():
+    good = {"a": jnp.ones(3), "b": jnp.zeros(2)}
+    bad = {"a": jnp.ones(3), "b": jnp.array([1.0, jnp.inf])}
+    nan = {"a": jnp.array([jnp.nan]), "b": jnp.zeros(2)}
+    assert bool(all_finite(good))
+    assert not bool(all_finite(bad))
+    assert not bool(all_finite(nan))
+
+
+def test_select_tree_skips_update_on_overflow():
+    old = {"w": jnp.zeros(3)}
+    new = {"w": jnp.ones(3)}
+    out = select_tree(jnp.bool_(False), new, old)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.zeros(3))
+    out = select_tree(jnp.bool_(True), new, old)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones(3))
+
+
+def test_scale_loss_unscale_grads_roundtrip():
+    s = LossScaleState.create(_cfg())
+    loss = jnp.float32(2.5)
+    assert float(s.scale_loss(loss)) == 2.5 * 2 ** 15
+    grads = {"w": jnp.full(4, 2.0 ** 15)}
+    un = s.unscale_grads(grads)
+    np.testing.assert_allclose(np.asarray(un["w"]), np.ones(4))

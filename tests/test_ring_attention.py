@@ -1,0 +1,106 @@
+"""Ring attention == full attention (the sequence-parallel invariant)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_tpu.parallel.ring_attention import (
+    RingSelfAttention,
+    ring_attention,
+)
+from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return create_mesh(
+        MeshConfig(data=1, fsdp=1, model=1, expert=1, sequence=8))
+
+
+def _qkv(seed=0, b=2, h=4, t=32, d=8):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, h, t, d).astype(np.float32))  # noqa: E731
+    return mk(), mk(), mk()
+
+
+def _smap(fn, mesh, in_specs, out_specs):
+    try:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_matches_full_attention(seq_mesh, causal):
+    q, k, v = _qkv()
+    oracle = ring_attention(q, k, v, axis_name=None, causal=causal)
+
+    spec = P(None, None, "sequence", None)
+    ringed = _smap(
+        functools.partial(ring_attention, axis_name="sequence", causal=causal),
+        seq_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    out = jax.jit(ringed)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_grads_match(seq_mesh):
+    """The VJP through the ring (ppermute transposes) must equal full
+    attention's — this is what training under sequence parallelism uses."""
+    q, k, v = _qkv(seed=3, t=16)
+
+    def loss_full(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, axis_name=None) ** 2)
+
+    spec = P(None, None, "sequence", None)
+    ringed = _smap(
+        functools.partial(ring_attention, axis_name="sequence"),
+        seq_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ringed(q, k, v) ** 2)
+
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(g_full, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-5, rtol=5e-5)
+
+
+def test_ring_self_attention_module_single_block():
+    """The flax module is exact MHA when no axis is bound."""
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 10, 16).astype(np.float32))
+    mod = RingSelfAttention(num_heads=4)
+    variables = mod.init(jax.random.PRNGKey(0), x)
+    out = mod.apply(variables, x)
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_causal_first_block_ignores_future(seq_mesh):
+    """Perturbing future-shard keys must not change earlier shards' output."""
+    q, k, v = _qkv(seed=5)
+    spec = P(None, None, "sequence", None)
+    ringed = jax.jit(_smap(
+        functools.partial(ring_attention, axis_name="sequence", causal=True),
+        seq_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    ))
+    base = np.asarray(ringed(q, k, v))
+    k2 = k.at[:, :, 16:, :].add(3.0)  # perturb the last 4 shards
+    v2 = v.at[:, :, 16:, :].add(3.0)
+    pert = np.asarray(ringed(q, k2, v2))
+    np.testing.assert_allclose(pert[:, :, :16], base[:, :, :16], atol=1e-6)
+    assert not np.allclose(pert[:, :, 16:], base[:, :, 16:])
