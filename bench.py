@@ -1,0 +1,142 @@
+"""Benchmark: ResNet-50 training throughput in images/sec/chip.
+
+The north-star metric from BASELINE.json: ResNet-50/ImageNet-1k
+images/sec/chip on TPU (target ≥6000 on v4-8; this environment exposes one
+v5e chip via the axon tunnel). Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+
+Measures the steady-state jitted train step (fwd + bwd + Adam update, bf16
+compute) on device-resident synthetic ImageNet batches — the same compute
+graph as real training; input-pipeline overlap is benchmarked separately by
+the data-layer tests. The per-step host sync the reference suffers
+(``loss.item()``, SURVEY.md §2.5) is absent by construction: the loop only
+blocks on the final step's output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 6000.0
+
+
+def ensure_live_backend(probe_timeout: int = 180) -> str:
+    """Return the platform to bench on, falling back to CPU if TPU is stuck.
+
+    The axon TPU tunnel serves one client and can wedge (backend init blocks
+    forever) if a previous client died uncleanly. Probe it in a subprocess
+    with a timeout so bench.py itself never hangs; on failure, run on CPU
+    with an honest label rather than block the driver.
+    """
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=probe_timeout)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    print("bench: TPU backend unreachable (tunnel hang?); falling back to CPU",
+          file=sys.stderr)
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
+
+
+def build(model_name: str, batch_size: int, image_size: int, num_classes: int):
+    from distributed_training_tpu.config import PrecisionConfig
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.parallel.sharding import (
+        place_state,
+        state_shardings,
+    )
+    from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+    from distributed_training_tpu.train.precision import LossScaleState
+    from distributed_training_tpu.train.step import make_train_step
+    from distributed_training_tpu.train.train_state import init_train_state
+
+    mesh = create_mesh(MeshConfig(data=-1))
+    model = get_model(model_name, num_classes=num_classes, dtype=jnp.bfloat16)
+    tx = optax.adam(1e-3)
+    state = init_train_state(
+        model, jax.random.PRNGKey(0),
+        (batch_size, image_size, image_size, 3), tx,
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="bf16")))
+    state = place_state(state, state_shardings(state, mesh, zero_stage=0))
+    step = make_train_step(mesh, zero_stage=0, donate=True)
+    return mesh, state, step
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch-size", type=int, default=256,
+                    help="per-chip batch size")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--steps", type=int, default=20)
+    args = ap.parse_args()
+
+    platform = ensure_live_backend()
+    if platform == "cpu" and args.model == "resnet50":
+        # CPU fallback: keep the graph identical in kind but tractable.
+        args.batch_size = min(args.batch_size, 16)
+        args.image_size = min(args.image_size, 64)
+        args.steps = min(args.steps, 5)
+        args.warmup = min(args.warmup, 2)
+
+    n_chips = jax.device_count()
+    global_batch = args.batch_size * n_chips
+
+    mesh, state, step = build(
+        args.model, global_batch, args.image_size, args.num_classes)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": jnp.asarray(
+            rng.rand(global_batch, args.image_size, args.image_size, 3),
+            jnp.float32),
+        "label": jnp.asarray(
+            rng.randint(0, args.num_classes, global_batch), jnp.int32),
+    }
+    key = jax.random.PRNGKey(0)
+
+    for _ in range(args.warmup):
+        state, metrics = step(state, batch, key)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, batch, key)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    images_per_sec = args.steps * global_batch / dt
+    per_chip = images_per_sec / n_chips
+    print(json.dumps({
+        "metric": f"{args.model} synthetic-ImageNet train throughput "
+                  f"(bf16, batch {args.batch_size}/chip, {n_chips} "
+                  f"{platform} chip(s))",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

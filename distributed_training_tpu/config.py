@@ -164,6 +164,7 @@ class DataConfig:
     num_classes: int = 10
     drop_last: bool = True
     synthetic_ok: bool = True  # fall back to synthetic data if not on disk
+    max_steps_per_epoch: int | None = None  # cap train steps (smoke/bench runs)
 
 
 @dataclasses.dataclass(frozen=True)

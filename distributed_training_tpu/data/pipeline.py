@@ -49,6 +49,7 @@ class ShardedDataLoader:
         seed: int = 0,
         process_index: int | None = None,
         process_count: int | None = None,
+        max_steps: int | None = None,
     ):
         self.images = images
         self.labels = labels
@@ -68,6 +69,7 @@ class ShardedDataLoader:
                 f"global batch {global_batch_size} not divisible by "
                 f"{self.process_count} processes")
         self.local_batch_size = global_batch_size // self.process_count
+        self.max_steps = max_steps
 
     def set_epoch(self, epoch: int) -> None:
         """Reseed the shuffle — ``sampler.set_epoch`` parity."""
@@ -75,9 +77,11 @@ class ShardedDataLoader:
 
     def __len__(self) -> int:
         n = len(self.labels)
-        if self.drop_last:
-            return n // self.global_batch_size
-        return -(-n // self.global_batch_size)
+        steps = (n // self.global_batch_size if self.drop_last
+                 else -(-n // self.global_batch_size))
+        if self.max_steps is not None:
+            steps = min(steps, self.max_steps)
+        return steps
 
     def __iter__(self) -> Iterator[dict]:
         n = len(self.labels)
@@ -168,7 +172,8 @@ def build_dataloaders(cfg, coordinator=None, *, seed: int = 0):
 
     train_loader = ShardedDataLoader(
         train_x, train_y, global_batch_size=global_bs, shuffle=True,
-        drop_last=data.drop_last, augment=data.augment, train=True, seed=seed)
+        drop_last=data.drop_last, augment=data.augment, train=True, seed=seed,
+        max_steps=data.max_steps_per_epoch)
     eval_loader = ShardedDataLoader(
         eval_x, eval_y, global_batch_size=global_bs, shuffle=False,
         drop_last=False, augment=data.augment, train=False, seed=seed)

@@ -1,0 +1,242 @@
+"""JAX/TPU backend trainer — sibling of the reference's per-backend dirs.
+
+The per-backend-directory layout IS the plugin boundary
+(``resnet/{pytorch_ddp,deepspeed,colossal}/`` in the reference;
+BASELINE.json north star: "a JAX/TPU backend added as a sibling"). This CLI
+subsumes the union of all three reference trainers' surfaces:
+
+- DDP style (``resnet/pytorch_ddp/ddp_train.py:107-114``): defaults —
+  5 epochs, batch 100/device, Adam lr 1e-3 × world_size.
+- DeepSpeed style (``resnet/deepspeed/deepspeed_train.py:27-129``):
+  ``--dtype``, ``--stage``, the full MoE flag set, ``--log-interval``,
+  ``--deepspeed``/``--deepspeed_config`` passthrough, and the in-code
+  ds_config dict (``:172-220``) ingested via ``from_ds_config``.
+- ColossalAI style (``resnet/colossal/colossal_train.py:30-50``):
+  ``-p/--plugin``, ``-r/--resume``, ``-c/--checkpoint``, ``-i/--interval``,
+  ``--target_acc`` — all functional here (the reference parses but never
+  wires resume/checkpoint/target_acc; SURVEY.md §2.5).
+
+Unlike the reference there is no per-rank process fan-out (``mp.spawn``) —
+JAX is one process per host; multi-host runs call
+``initialize_distributed()`` from the launcher env (RANK/WORLD_SIZE/
+MASTER_ADDR), and all device parallelism lives in the compiled mesh program.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def add_argument() -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="CIFAR on TPU (JAX backend)")
+
+    # -- model / plugin (Colossal style) ------------------------------------
+    parser.add_argument("-p", "--plugin", type=str, default="torch_ddp",
+                        choices=["torch_ddp", "torch_ddp_fp16",
+                                 "low_level_zero", "gemini", "deepspeed"],
+                        help="parallelism plugin to use")
+    parser.add_argument("--model", type=str, default="resnet18",
+                        help="model name from the registry")
+    parser.add_argument("-r", "--resume", type=int, default=-1,
+                        help="resume from the epoch's checkpoint")
+    parser.add_argument("-c", "--checkpoint", type=str, default="./checkpoint",
+                        help="checkpoint directory")
+    parser.add_argument("-i", "--interval", type=int, default=5,
+                        help="interval of saving checkpoint (epochs)")
+    parser.add_argument("--target_acc", type=float, default=None,
+                        help="target accuracy; raise if not reached")
+    parser.add_argument("--local-rank", "--local_rank", type=int, default=-1,
+                        help="accepted for launcher compat; unused (JAX is "
+                             "one process per host)")
+
+    # -- train (DeepSpeed style) --------------------------------------------
+    parser.add_argument("-b", "--batch_size", type=int, default=100,
+                        help="per-device mini-batch size")
+    parser.add_argument("-e", "--epochs", type=int, default=5,
+                        help="number of total epochs")
+    parser.add_argument("--log-interval", type=int, default=100,
+                        help="steps between metric fetches/logs")
+    parser.add_argument("--dtype", type=str, default="fp32",
+                        choices=["bf16", "fp16", "fp32"],
+                        help="compute datatype")
+    parser.add_argument("--stage", type=int, default=0, choices=[0, 1, 2, 3],
+                        help="ZeRO optimization stage (deepspeed plugin)")
+    parser.add_argument("--deepspeed", action="store_true", default=False,
+                        help="accepted for launcher compat (config comes "
+                             "from --deepspeed_config / built-in defaults)")
+    parser.add_argument("--deepspeed_config", type=str, default=None,
+                        help="path to a DeepSpeed-style JSON config to ingest")
+
+    # -- MoE (DeepSpeed style, deepspeed_train.py:61-106) -------------------
+    parser.add_argument("--moe", action="store_true", default=False,
+                        help="use mixture of experts")
+    parser.add_argument("--ep-world-size", type=int, default=1,
+                        help="(moe) expert parallel world size")
+    parser.add_argument("--num-experts", type=int, nargs="+", default=[1],
+                        help="number of experts list, MoE related.")
+    parser.add_argument("--mlp-type", type=str, default="standard",
+                        help="only applicable when num-experts > 1; "
+                             "accepts [standard, residual]")
+    parser.add_argument("--top-k", type=int, default=1,
+                        help="(moe) gating top 1 and 2 supported")
+    parser.add_argument("--min-capacity", type=int, default=0,
+                        help="(moe) minimum expert capacity")
+    parser.add_argument("--noisy-gate-policy", type=str, default=None,
+                        help="(moe) None, RSample, or Jitter")
+    parser.add_argument("--moe-param-group", action="store_true",
+                        default=False,
+                        help="(moe) separate moe param groups for ZeRO")
+
+    # -- data / misc --------------------------------------------------------
+    parser.add_argument("--dataset", type=str, default="cifar10",
+                        choices=["cifar10", "synthetic_cifar",
+                                 "synthetic_imagenet"])
+    parser.add_argument("--data-path", type=str, default=None,
+                        help="dataset root (default: $DATA or ../data)")
+    parser.add_argument("--steps-per-epoch", type=int, default=None,
+                        help="cap train steps per epoch (smoke runs)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--wall-clock-breakdown", action="store_true",
+                        default=False)
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="jax.profiler trace output directory")
+
+    return parser.parse_args()
+
+
+# The DeepSpeed trainer's in-code engine config
+# (resnet/deepspeed/deepspeed_train.py:172-220), reproduced as the default
+# ds_config for the 'deepspeed' plugin; --dtype/--stage patch it exactly the
+# way the reference's args do.
+def default_ds_config(dtype: str, stage: int, batch_size: int) -> dict:
+    return {
+        "train_batch_size": batch_size,
+        "steps_per_print": 2000,
+        "optimizer": {
+            "type": "Adam",
+            "params": {
+                "lr": 0.001,
+                "betas": [0.8, 0.999],
+                "eps": 1e-8,
+                "weight_decay": 3e-7,
+            },
+        },
+        "scheduler": {
+            "type": "WarmupLR",
+            "params": {
+                "warmup_min_lr": 0,
+                "warmup_max_lr": 0.001,
+                "warmup_num_steps": 1000,
+            },
+        },
+        "gradient_clipping": 1.0,
+        "prescale_gradients": False,
+        "bf16": {"enabled": dtype == "bf16"},
+        "fp16": {
+            "enabled": dtype == "fp16",
+            "fp16_master_weights_and_grads": False,
+            "loss_scale": 0,
+            "loss_scale_window": 500,
+            "hysteresis": 2,
+            "min_loss_scale": 1,
+            "initial_scale_power": 15,
+        },
+        "wall_clock_breakdown": False,
+        "zero_optimization": {
+            "stage": stage,
+            "allgather_partitions": True,
+            "reduce_scatter": True,
+            "allgather_bucket_size": 50000000,
+            "reduce_bucket_size": 50000000,
+            "overlap_comm": True,
+            "contiguous_gradients": True,
+            "cpu_offload": False,
+        },
+    }
+
+
+def build_config(args: argparse.Namespace):
+    from distributed_training_tpu.config import (
+        CheckpointConfig,
+        DataConfig,
+        MoEConfig,
+        TrainConfig,
+        from_ds_config,
+    )
+
+    cfg = TrainConfig.from_plugin(args.plugin)
+
+    if args.plugin == "deepspeed":
+        if args.deepspeed_config:
+            with open(args.deepspeed_config) as fh:
+                ds = json.load(fh)
+        else:
+            ds = default_ds_config(args.dtype, args.stage, args.batch_size)
+        cfg = from_ds_config(ds, base=cfg)
+    else:
+        cfg = cfg.replace(
+            precision=dataclasses.replace(cfg.precision, dtype=args.dtype)
+            if args.dtype != "fp32" else cfg.precision)
+
+    num_classes = 1000 if args.dataset == "synthetic_imagenet" else 10
+    image_size = 224 if args.dataset == "synthetic_imagenet" else 32
+    augment = ("normalize_only" if args.plugin == "deepspeed"
+               else "pad_crop_flip")  # DS normalizes; DDP/Colossal crop+flip
+
+    cfg = cfg.replace(
+        model=args.model,
+        num_epochs=args.epochs,
+        seed=args.seed,
+        log_interval=args.log_interval,
+        target_acc=args.target_acc,
+        wall_clock_breakdown=args.wall_clock_breakdown,
+        profile_dir=args.profile_dir,
+        checkpoint=CheckpointConfig(
+            directory=args.checkpoint,
+            interval=args.interval,
+            resume=args.resume,
+        ),
+        data=DataConfig(
+            dataset=args.dataset,
+            data_path=args.data_path,
+            batch_size=args.batch_size,
+            augment=augment,
+            image_size=image_size,
+            num_classes=num_classes,
+            max_steps_per_epoch=args.steps_per_epoch,
+        ),
+        moe=MoEConfig(
+            enabled=args.moe,
+            ep_world_size=args.ep_world_size,
+            num_experts=tuple(args.num_experts),
+            mlp_type=args.mlp_type,
+            top_k=args.top_k,
+            min_capacity=args.min_capacity,
+            noisy_gate_policy=args.noisy_gate_policy,
+            moe_param_group=args.moe_param_group,
+        ),
+    )
+    return cfg
+
+
+def main() -> int:
+    args = add_argument()
+
+    from distributed_training_tpu.runtime.distributed import (
+        initialize_distributed,
+    )
+    from distributed_training_tpu.train.trainer import Trainer
+
+    initialize_distributed()  # no-op single-process; env-driven multi-host
+    cfg = build_config(args)
+    trainer = Trainer(cfg)
+    result = trainer.fit()
+    trainer.coord.print(f"[done] {result}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,145 @@
+"""Config system tests: plugin presets + ds_config ingestion round-trip."""
+
+import pytest
+
+from distributed_training_tpu.config import (
+    PLUGINS,
+    TrainConfig,
+    from_ds_config,
+)
+
+
+def _reference_ds_config(dtype="bf16", stage=0):
+    # Mirrors resnet/deepspeed/deepspeed_train.py:172-220 field-for-field.
+    return {
+        "train_batch_size": 96,
+        "steps_per_print": 2000,
+        "optimizer": {
+            "type": "Adam",
+            "params": {"lr": 0.001, "betas": [0.8, 0.999], "eps": 1e-8,
+                       "weight_decay": 3e-7},
+        },
+        "scheduler": {
+            "type": "WarmupLR",
+            "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.001,
+                       "warmup_num_steps": 1000},
+        },
+        "gradient_clipping": 1.0,
+        "prescale_gradients": False,
+        "bf16": {"enabled": dtype == "bf16"},
+        "fp16": {
+            "enabled": dtype == "fp16",
+            "fp16_master_weights_and_grads": False,
+            "loss_scale": 0,
+            "loss_scale_window": 500,
+            "hysteresis": 2,
+            "min_loss_scale": 1,
+            "initial_scale_power": 15,
+        },
+        "wall_clock_breakdown": False,
+        "zero_optimization": {
+            "stage": stage,
+            "allgather_partitions": True,
+            "reduce_scatter": True,
+            "allgather_bucket_size": 50000000,
+            "reduce_bucket_size": 50000000,
+            "overlap_comm": True,
+            "contiguous_gradients": True,
+            "cpu_offload": False,
+        },
+    }
+
+
+def test_reference_ds_config_ingests_losslessly():
+    cfg = from_ds_config(_reference_ds_config())
+    assert cfg.optimizer.lr == 0.001
+    assert cfg.optimizer.betas == (0.8, 0.999)
+    assert cfg.optimizer.eps == 1e-8
+    assert cfg.optimizer.weight_decay == 3e-7
+    assert cfg.optimizer.grad_clip_norm == 1.0
+    assert cfg.scheduler.name == "warmup_lr"
+    assert cfg.scheduler.warmup_num_steps == 1000
+    assert cfg.precision.dtype == "bf16"
+    assert cfg.zero.stage == 0
+    assert cfg.zero.reduce_bucket_size == 50_000_000
+    assert cfg.data.global_batch_size == 96
+    assert cfg.log_interval == 2000
+    assert cfg.wall_clock_breakdown is False
+
+
+def test_ds_config_fp16_scaler_fields():
+    cfg = from_ds_config(_reference_ds_config(dtype="fp16", stage=2))
+    assert cfg.precision.dtype == "fp16"
+    assert cfg.precision.initial_scale_power == 15
+    assert cfg.precision.loss_scale_window == 500
+    assert cfg.precision.hysteresis == 2
+    assert cfg.precision.min_loss_scale == 1
+    assert cfg.precision.static_loss_scale is None  # loss_scale: 0 → dynamic
+    assert cfg.zero.stage == 2
+
+
+def test_ds_config_static_loss_scale():
+    ds = _reference_ds_config(dtype="fp16")
+    ds["fp16"]["loss_scale"] = 1024
+    cfg = from_ds_config(ds)
+    assert cfg.precision.static_loss_scale == 1024.0
+
+
+def test_ds_config_adamw_maps_to_decoupled_decay():
+    ds = _reference_ds_config()
+    ds["optimizer"]["type"] = "AdamW"
+    cfg = from_ds_config(ds)
+    assert cfg.optimizer.name == "adamw"
+
+
+def test_ds_config_rejects_unknown_keys():
+    ds = _reference_ds_config()
+    ds["not_a_real_knob"] = True
+    with pytest.raises(ValueError, match="not_a_real_knob"):
+        from_ds_config(ds)
+    ds = _reference_ds_config()
+    ds["zero_optimization"]["typo_knob"] = 1
+    with pytest.raises(ValueError, match="typo_knob"):
+        from_ds_config(ds)
+
+
+def test_plugin_presets():
+    assert TrainConfig.from_plugin("torch_ddp").precision.dtype == "fp32"
+    fp16 = TrainConfig.from_plugin("torch_ddp_fp16")
+    assert fp16.precision.dtype == "fp16"
+    llz = TrainConfig.from_plugin("low_level_zero")
+    assert llz.zero.stage == 1
+    assert llz.precision.initial_scale_power == 5  # colossal initial_scale=2**5
+    gem = TrainConfig.from_plugin("gemini")
+    assert gem.zero.stage == 3
+    ds = TrainConfig.from_plugin("deepspeed")
+    assert ds.optimizer.betas == (0.8, 0.999)
+    assert ds.optimizer.grad_clip_norm == 1.0
+    with pytest.raises(ValueError):
+        TrainConfig.from_plugin("bogus")
+    assert set(PLUGINS) == {
+        "torch_ddp", "torch_ddp_fp16", "low_level_zero", "gemini", "deepspeed"}
+
+
+def test_lr_world_scaling_preset():
+    # DDP/Colossal linear scaling rule: lr = 1e-3 * world_size.
+    from distributed_training_tpu.train.optim import make_schedule
+
+    cfg = TrainConfig.from_plugin("torch_ddp")
+    assert cfg.optimizer.scale_lr_by_world
+    sched = make_schedule(cfg.optimizer, cfg.scheduler, world_size=8)
+    assert float(sched(0)) == pytest.approx(8e-3)
+
+
+def test_warmup_lr_schedule_shape():
+    from distributed_training_tpu.config import OptimizerConfig, SchedulerConfig
+    from distributed_training_tpu.train.optim import make_schedule
+
+    sched = make_schedule(
+        OptimizerConfig(),
+        SchedulerConfig(name="warmup_lr", warmup_min_lr=0.0,
+                        warmup_max_lr=1e-3, warmup_num_steps=1000))
+    assert float(sched(0)) == pytest.approx(0.0)
+    assert float(sched(500)) == pytest.approx(5e-4)
+    assert float(sched(1000)) == pytest.approx(1e-3)
+    assert float(sched(5000)) == pytest.approx(1e-3)  # constant after warmup
