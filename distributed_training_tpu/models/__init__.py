@@ -32,7 +32,13 @@ def _vit(**kw):
     return make_vit(**kw)
 
 
+def _moe(**kw):
+    from distributed_training_tpu.models.moe import make_moe_classifier
+    return make_moe_classifier(**kw)
+
+
 _REGISTRY["vit_b16"] = _vit
+_REGISTRY["moe_mlp"] = _moe
 
 
 def available_models() -> list[str]:

@@ -63,16 +63,20 @@ def _forward_and_loss(state: TrainState, params, batch, rng, train: bool):
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
     if train:
+        rngs = dict(zip(("dropout", "gate"), jax.random.split(rng)))
         logits, mutated = state.apply_fn(
             variables, batch["image"], train=True,
-            mutable=["batch_stats"],
-            rngs={"dropout": rng},
+            mutable=["batch_stats", "aux_loss"],
+            rngs=rngs,
         )
-        new_batch_stats = dict(mutated).get("batch_stats", state.batch_stats)
+        mutated = dict(mutated)
+        new_batch_stats = mutated.get("batch_stats", state.batch_stats)
+        aux = sum(jax.tree.leaves(mutated.get("aux_loss", {})), jnp.float32(0))
     else:
         logits = state.apply_fn(variables, batch["image"], train=False)
         new_batch_stats = state.batch_stats
-    loss = cross_entropy_loss(logits, batch["label"])
+        aux = jnp.float32(0)
+    loss = cross_entropy_loss(logits, batch["label"]) + aux
     return loss, logits, new_batch_stats
 
 

@@ -59,11 +59,24 @@ class Trainer:
                 "shard_map step); statistics will be global")
 
         policy = Policy.from_config(cfg.precision)
+        model_kwargs = {}
+        if cfg.model.startswith("moe"):
+            mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+            model_kwargs = dict(
+                num_experts=tuple(cfg.moe.num_experts),
+                top_k=cfg.moe.top_k,
+                capacity_factor=cfg.moe.capacity_factor,
+                min_capacity=cfg.moe.min_capacity,
+                noisy_gate_policy=cfg.moe.noisy_gate_policy,
+                mlp_type=cfg.moe.mlp_type,
+                expert_axis="expert" if mesh_shape.get("expert", 1) > 1 else None,
+            )
         self.model = get_model(
             cfg.model,
             num_classes=cfg.data.num_classes,
             dtype=policy.compute_dtype,
             axis_name=None,  # GSPMD path: BN sync is automatic over the mesh
+            **model_kwargs,
         )
         self.tx = make_optimizer(cfg.optimizer, cfg.scheduler, self.world_size)
 

@@ -169,6 +169,12 @@ def build_config(args: argparse.Namespace):
 
     cfg = TrainConfig.from_plugin(args.plugin)
 
+    if args.moe and not args.model.startswith("moe"):
+        # The reference parses --moe but trains a dense ResNet regardless
+        # (deepspeed_train.py:223); here the flag selects the MoE model.
+        print(f"[moe] switching model {args.model!r} -> 'moe_mlp'")
+        args.model = "moe_mlp"
+
     if args.plugin == "deepspeed":
         if args.deepspeed_config:
             with open(args.deepspeed_config) as fh:

@@ -55,6 +55,32 @@ def test_fp16_zero1_plugin_trains(tmp_path):
     assert metrics["grads_finite"] == 1.0
 
 
+def test_moe_expert_parallel_training(tmp_path):
+    """MoE model + expert mesh axis: one epoch trains, aux loss flows."""
+    from distributed_training_tpu.config import MeshSpec, MoEConfig
+
+    cfg = _cfg(
+        tmp_path,
+        model="moe_mlp",
+        mesh=MeshSpec(data=-1, expert=2),
+        moe=MoEConfig(enabled=True, num_experts=(4,), top_k=2,
+                      noisy_gate_policy="RSample"),
+    )
+    trainer = Trainer(cfg)
+    train_loader, _ = trainer.make_loaders()
+    metrics = trainer.train_epoch(0, train_loader)
+    assert metrics["loss"] < 2.5
+    assert metrics["grads_finite"] == 1.0
+
+
+def test_moe_enabled_with_dense_model_refuses(tmp_path):
+    from distributed_training_tpu.config import MoEConfig
+
+    cfg = _cfg(tmp_path, moe=MoEConfig(enabled=True))
+    with pytest.raises(NotImplementedError, match="silently train dense"):
+        Trainer(cfg)
+
+
 @pytest.mark.slow
 def test_cli_backend_end_to_end(tmp_path):
     """Drive resnet/jax_tpu/train.py exactly as run.sh would."""
