@@ -1,0 +1,4 @@
+from distributed_training_tpu.ops.fused_adam import (  # noqa: F401
+    fused_adam,
+    fused_adam_kernel_update,
+)

@@ -1,0 +1,180 @@
+"""Pallas fused Adam — the TPU analogue of ColossalAI's HybridAdam.
+
+The reference consumes CUDA-fused optimizers as binary wheels (HybridAdam,
+``resnet/colossal/colossal_train.py:153``; DeepSpeed's FusedAdam inside the
+engine). On TPU, XLA already fuses the optax update chain into the step
+program, so a hand-written kernel is not *required* for performance parity —
+this kernel exists for the cases where explicit fusion wins anyway:
+
+- one pass over HBM touching p/g/m/v exactly once (the optax chain can
+  materialize intermediates when the update is used outside jit),
+- a single VMEM-resident block pipeline per parameter tensor, sized to the
+  VPU tile so the update is purely bandwidth-bound.
+
+Exposed two ways:
+- :func:`fused_adam_kernel_update` — the raw per-tensor kernel.
+- :func:`fused_adam` — an ``optax.GradientTransformation`` drop-in
+  (``make_optimizer(name='hybrid_adam', use_pallas=True)`` routes here).
+
+Off-TPU (tests, CPU mesh) the kernel runs in pallas interpret mode, bit-
+accurate with the compiled path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# VPU-tile-aligned block: 8 sublanes × 128 lanes × 32 rows.
+_BLOCK = 8 * 128 * 32
+
+
+def _make_kernel(b1: float, b2: float, eps: float):
+    """Build the per-block kernel; β/eps are compile-time constants, the
+    traced scalars [lr, 1/(1-β1^t), 1/(1-β2^t)] arrive via SMEM (bias
+    corrections are host-of-kernel scalar math, so the body is pure
+    elementwise VPU work)."""
+    def kernel(scalars_ref, p_ref, g_ref, m_ref, v_ref, p_out, m_out, v_out):
+        lr = scalars_ref[0]
+        bc1 = scalars_ref[1]
+        bc2 = scalars_ref[2]
+        g = g_ref[:]
+        m = b1 * m_ref[:] + (1.0 - b1) * g
+        v = b2 * v_ref[:] + (1.0 - b2) * g * g
+        p_out[:] = p_ref[:] - lr * (m * bc1) / (jnp.sqrt(v * bc2) + eps)
+        m_out[:] = m
+        v_out[:] = v
+    return kernel
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps", "interpret"))
+def fused_adam_kernel_update(
+    p: jnp.ndarray,
+    g: jnp.ndarray,
+    m: jnp.ndarray,
+    v: jnp.ndarray,
+    lr: jnp.ndarray,
+    step: jnp.ndarray,
+    *,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    interpret: bool = False,
+):
+    """Fused Adam on one tensor; returns (new_p, new_m, new_v).
+
+    ``step`` is the 1-based step count for bias correction.
+    """
+    orig_shape, orig_dtype = p.shape, p.dtype
+    n = p.size
+    padded = -(-n // _BLOCK) * _BLOCK
+
+    def flat(x):
+        x = x.reshape(-1).astype(jnp.float32)
+        return jnp.pad(x, (0, padded - n))
+
+    pf, gf, mf, vf = flat(p), flat(g), flat(m), flat(v)
+    rows = padded // 128
+    pf, gf, mf, vf = (x.reshape(rows, 128) for x in (pf, gf, mf, vf))
+
+    t = step.astype(jnp.float32)
+    scalars = jnp.stack([
+        lr.astype(jnp.float32),
+        1.0 / (1.0 - b1 ** t),
+        1.0 / (1.0 - b2 ** t),
+    ])
+
+    block_rows = _BLOCK // 128
+    grid = rows // block_rows
+    tensor_spec = pl.BlockSpec(
+        (block_rows, 128), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+    new_p, new_m, new_v = pl.pallas_call(
+        _make_kernel(b1, b2, eps),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            tensor_spec, tensor_spec, tensor_spec, tensor_spec,
+        ],
+        out_specs=[tensor_spec, tensor_spec, tensor_spec],
+        out_shape=[jax.ShapeDtypeStruct((rows, 128), jnp.float32)] * 3,
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=interpret,
+    )(scalars, pf, gf, mf, vf)
+
+    unflat = lambda x: x.reshape(-1)[:n].reshape(orig_shape)  # noqa: E731
+    return (unflat(new_p).astype(orig_dtype),
+            unflat(new_m).astype(orig_dtype),
+            unflat(new_v).astype(orig_dtype))
+
+
+class FusedAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def fused_adam(
+    learning_rate,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    interpret: bool | None = None,
+) -> optax.GradientTransformation:
+    """optax-compatible fused Adam (updates returned as deltas).
+
+    ``learning_rate`` may be a float or an optax schedule. ``interpret``
+    defaults to auto: compiled on TPU, interpret mode elsewhere.
+    """
+
+    def init_fn(params):
+        zeros = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), params)
+        return FusedAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=zeros,
+            nu=jax.tree.map(jnp.copy, zeros))
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("fused_adam requires params")
+        run_interpret = (not _on_tpu()) if interpret is None else interpret
+        count = state.count + 1
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        lr = jnp.asarray(lr, jnp.float32)
+
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(updates)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+
+        deltas, new_m, new_v = [], [], []
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            np_, nm, nv = fused_adam_kernel_update(
+                p, g, m, v, lr, count,
+                b1=b1, b2=b2, eps=eps, interpret=run_interpret)
+            deltas.append((np_ - p).astype(p.dtype))
+            new_m.append(nm)
+            new_v.append(nv)
+
+        return (
+            jax.tree.unflatten(treedef, deltas),
+            FusedAdamState(
+                count=count,
+                mu=jax.tree.unflatten(treedef, new_m),
+                nu=jax.tree.unflatten(treedef, new_v)),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)

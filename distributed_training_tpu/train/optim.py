@@ -66,7 +66,17 @@ def make_optimizer(
     parts = []
     if opt.grad_clip_norm is not None:
         parts.append(optax.clip_by_global_norm(opt.grad_clip_norm))
-    if opt.name in ("adam", "hybrid_adam"):
+    if opt.name == "hybrid_adam":
+        # Pallas fused Adam (ColossalAI HybridAdam analogue): one HBM pass
+        # per tensor; lr/schedule handled inside the transformation.
+        from distributed_training_tpu.ops.fused_adam import fused_adam
+
+        if opt.weight_decay:
+            parts.append(optax.add_decayed_weights(opt.weight_decay))
+        parts.append(fused_adam(
+            lr, b1=opt.betas[0], b2=opt.betas[1], eps=opt.eps))
+        return optax.chain(*parts)
+    if opt.name == "adam":
         if opt.weight_decay:
             parts.append(optax.add_decayed_weights(opt.weight_decay))
         parts.append(
