@@ -1,4 +1,4 @@
-"""Vectorized numpy augmentations.
+"""Batched augmentations: vectorized numpy with a native C++ fast path.
 
 Parity targets (SURVEY.md §2.4 "Augmentation"):
 - Pad(4) + RandomHorizontalFlip + RandomCrop(32) + ToTensor — the DDP and
@@ -8,36 +8,54 @@ Parity targets (SURVEY.md §2.4 "Augmentation"):
   (``resnet/deepspeed/deepspeed_train.py:227-230``).
 
 Unlike torchvision's per-sample Python transforms, these operate on whole
-uint8 batches with vectorized gathers — the host must keep ~6000 img/s/chip
-fed (SURVEY.md §7 hard parts), so per-sample Python loops are out.
+uint8 batches — the host must keep ~6000 img/s/chip fed (SURVEY.md §7 hard
+parts). Random draws happen here (one rng, one order) so the numpy and
+native paths produce byte-identical outputs; the native library
+(``ops/native``, multithreaded C++, the in-repo analogue of the DALI wheels
+the reference pins) only does the memory movement.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from distributed_training_tpu.ops.native import native
+
 
 def pad_crop_flip(
-    images: np.ndarray, rng: np.random.RandomState, pad: int = 4,
+    images: np.ndarray,
+    rng: np.random.RandomState,
+    pad: int = 4,
+    use_native: bool | None = None,
 ) -> np.ndarray:
     """Batched Pad(pad) → RandomCrop(original) → RandomHorizontalFlip."""
     n, h, w, c = images.shape
-    padded = np.pad(
-        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant")
     ys = rng.randint(0, 2 * pad + 1, size=n)
     xs = rng.randint(0, 2 * pad + 1, size=n)
+    flips = rng.rand(n) < 0.5
+
+    if use_native is None:
+        use_native = native.available()
+    if use_native:
+        return native.pad_crop_flip(
+            images, ys.astype(np.int32), xs.astype(np.int32),
+            flips.astype(np.uint8), pad)
+
+    padded = np.pad(
+        images, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant")
     # Gather crops via sliding-window view: windows[i, ys[i], xs[i]] is the
     # (h, w, c) crop — one fancy-index instead of a Python loop.
     windows = np.lib.stride_tricks.sliding_window_view(padded, (h, w), axis=(1, 2))
     crops = windows[np.arange(n), ys, xs]            # (n, c, h, w) after view
     crops = np.moveaxis(crops, 1, -1)                # back to NHWC
-    flips = rng.rand(n) < 0.5
     crops[flips] = crops[flips, :, ::-1]
     return np.ascontiguousarray(crops)
 
 
 def to_float(images: np.ndarray) -> np.ndarray:
     """ToTensor parity: uint8 [0,255] → float32 [0,1] (layout stays NHWC)."""
+    if images.dtype == np.uint8 and native.available():
+        return native.u8_to_f32(images, 1.0 / 255.0, 0.0)
     return images.astype(np.float32) / 255.0
 
 
@@ -52,6 +70,9 @@ def apply_train_augment(
     if mode == "pad_crop_flip":
         return to_float(pad_crop_flip(images, rng))
     if mode == "normalize_only":
+        if images.dtype == np.uint8 and native.available():
+            # Fused ToTensor+Normalize: x/255/0.5 - 1 = x·(2/255) - 1.
+            return native.u8_to_f32(images, 2.0 / 255.0, -1.0)
         return normalize_half(to_float(images))
     if mode == "none":
         return to_float(images)
@@ -61,5 +82,7 @@ def apply_train_augment(
 def apply_eval_transform(images: np.ndarray, mode: str) -> np.ndarray:
     # Eval uses plain ToTensor in DDP/Colossal; DS normalizes train==eval.
     if mode == "normalize_only":
+        if images.dtype == np.uint8 and native.available():
+            return native.u8_to_f32(images, 2.0 / 255.0, -1.0)
         return normalize_half(to_float(images))
     return to_float(images)

@@ -1,0 +1,118 @@
+// Native host-side data-pipeline kernels.
+//
+// The reference consumes its native data path as vendor wheels (DALI 1.7,
+// resnet/pytorch_ddp/requirements.txt:14) and never ships native source; on
+// the TPU side the input pipeline is host-CPU work (decode/augment/convert)
+// and is the usual bottleneck for ResNet-class throughput (SURVEY.md §7
+// "Input pipeline at >=6000 img/s/chip"). These kernels do the memory-bound
+// transforms multithreaded and fused:
+//
+//   pad_crop_flip : Pad(p) + RandomCrop(HxW) + HorizontalFlip in one pass
+//                   (crop offsets/flip bits supplied by the caller so Python
+//                   keeps RNG determinism and set_epoch parity)
+//   u8_to_f32     : uint8 -> float32 with affine scale/bias (fuses ToTensor
+//                   and Normalize into the copy)
+//
+// Built with plain g++ (no pybind11 in this image); bound via ctypes with a
+// numpy fallback when the .so is absent — see native.py.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+
+namespace {
+
+int hw_threads() {
+  unsigned n = std::thread::hardware_concurrency();
+  return n == 0 ? 4 : static_cast<int>(n);
+}
+
+template <typename F>
+void parallel_for(int64_t n, F&& fn) {
+  int nt = std::min<int64_t>(hw_threads(), n);
+  if (nt <= 1) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(lo + chunk, n);
+    if (lo >= hi) break;
+    threads.emplace_back([&fn, lo, hi] { fn(lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// in:  [n, h, w, c] uint8  (contiguous NHWC)
+// out: [n, h, w, c] uint8
+// ys, xs: [n] int32 crop offsets in [0, 2*pad]
+// flips:  [n] uint8 (1 = horizontal flip)
+// Zero-padding semantics identical to torchvision Pad(pad) + RandomCrop.
+void pad_crop_flip_u8(const uint8_t* in, uint8_t* out,
+                      int64_t n, int64_t h, int64_t w, int64_t c,
+                      int64_t pad,
+                      const int32_t* ys, const int32_t* xs,
+                      const uint8_t* flips) {
+  const int64_t img = h * w * c;
+  parallel_for(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* src = in + i * img;
+      uint8_t* dst = out + i * img;
+      const int64_t y0 = ys[i] - pad;  // crop origin in source coords
+      const int64_t x0 = xs[i] - pad;
+      const bool flip = flips[i] != 0;
+      for (int64_t y = 0; y < h; ++y) {
+        const int64_t sy = y + y0;
+        uint8_t* drow = dst + y * w * c;
+        if (sy < 0 || sy >= h) {
+          std::memset(drow, 0, w * c);
+          continue;
+        }
+        const uint8_t* srow = src + sy * w * c;
+        // Valid source x range for this row.
+        const int64_t xlo = std::max<int64_t>(0, -x0);
+        const int64_t xhi = std::min<int64_t>(w, w - x0);
+        if (!flip) {
+          if (xlo > 0) std::memset(drow, 0, xlo * c);
+          if (xhi > xlo)
+            std::memcpy(drow + xlo * c, srow + (xlo + x0) * c,
+                        (xhi - xlo) * c);
+          if (xhi < w) std::memset(drow + xhi * c, 0, (w - xhi) * c);
+        } else {
+          // dst x maps to source (w-1-x)+x0; write zero outside range.
+          for (int64_t x = 0; x < w; ++x) {
+            const int64_t sx = (w - 1 - x) + x0;
+            if (sx < 0 || sx >= w) {
+              std::memset(drow + x * c, 0, c);
+            } else {
+              std::memcpy(drow + x * c, srow + sx * c, c);
+            }
+          }
+        }
+      }
+    }
+  });
+}
+
+// out = in * scale + bias, elementwise over n values.
+void u8_to_f32_affine(const uint8_t* in, float* out, int64_t n,
+                      float scale, float bias) {
+  parallel_for((n + 4095) / 4096, [&](int64_t lo, int64_t hi) {
+    const int64_t a = lo * 4096;
+    const int64_t b = std::min<int64_t>(hi * 4096, n);
+    for (int64_t i = a; i < b; ++i) {
+      out[i] = static_cast<float>(in[i]) * scale + bias;
+    }
+  });
+}
+
+}  // extern "C"

@@ -1,0 +1,110 @@
+"""ctypes binding for the native augmentation library.
+
+Lazy-builds ``libaugment.so`` with g++ on first use (no pybind11 in this
+image; plain C ABI + ctypes per the environment's binding guidance) and
+falls back to the pure-numpy implementations in ``data/transforms.py`` when
+no compiler is available — the native path is an accelerator, never a hard
+dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "augment.cpp")
+_LIB = os.path.join(_HERE, "libaugment.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-pthread",
+           "-march=native", "-o", _LIB, _SRC]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if res.returncode != 0:
+            # Retry without -march=native (unsupported on some toolchains).
+            cmd.remove("-march=native")
+            res = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120)
+        return res.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.pad_crop_flip_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.pad_crop_flip_u8.restype = None
+        lib.u8_to_f32_affine.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_float, ctypes.c_float,
+        ]
+        lib.u8_to_f32_affine.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def pad_crop_flip(images: np.ndarray, ys: np.ndarray, xs: np.ndarray,
+                  flips: np.ndarray, pad: int) -> np.ndarray:
+    """Native Pad(pad)+Crop+Flip; semantics identical to the numpy path."""
+    lib = get_lib()
+    assert lib is not None, "native lib unavailable — check available() first"
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    n, h, w, c = images.shape
+    out = np.empty_like(images)
+    lib.pad_crop_flip_u8(
+        images.ctypes.data, out.ctypes.data,
+        n, h, w, c, pad,
+        np.ascontiguousarray(ys, np.int32).ctypes.data,
+        np.ascontiguousarray(xs, np.int32).ctypes.data,
+        np.ascontiguousarray(flips, np.uint8).ctypes.data)
+    return out
+
+
+def u8_to_f32(images: np.ndarray, scale: float, bias: float) -> np.ndarray:
+    """Native fused uint8→float32 affine (ToTensor [+ Normalize])."""
+    lib = get_lib()
+    assert lib is not None, "native lib unavailable — check available() first"
+    images = np.ascontiguousarray(images, dtype=np.uint8)
+    out = np.empty(images.shape, np.float32)
+    lib.u8_to_f32_affine(
+        images.ctypes.data, out.ctypes.data, images.size,
+        ctypes.c_float(scale), ctypes.c_float(bias))
+    return out

@@ -1,0 +1,78 @@
+"""Native C++ augmentation library: parity with the numpy path.
+
+The native lib is an accelerator, never a dependency — tests skip when no
+compiler/lib is available (the numpy fallback is covered in test_data.py).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_training_tpu.data import transforms
+from distributed_training_tpu.ops.native import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native augment lib unavailable")
+
+
+def _imgs(n=32, h=32, w=32, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 256, (n, h, w, 3), dtype=np.uint8)
+
+
+def test_pad_crop_flip_matches_numpy_bytewise():
+    x = _imgs()
+    a = transforms.pad_crop_flip(x, np.random.RandomState(7), use_native=True)
+    b = transforms.pad_crop_flip(x, np.random.RandomState(7), use_native=False)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_pad_crop_flip_edge_offsets():
+    """Extreme crop offsets (0 and 2·pad) hit the zero-padding borders."""
+    x = _imgs(n=4)
+    pad = 4
+    for y0, x0, flip in [(0, 0, 0), (8, 8, 1), (0, 8, 1), (8, 0, 0)]:
+        ys = np.full(4, y0, np.int32)
+        xs = np.full(4, x0, np.int32)
+        fl = np.full(4, flip, np.uint8)
+        out = native.pad_crop_flip(x, ys, xs, fl, pad)
+        # Build numpy reference directly from the same offsets.
+        padded = np.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        ref = padded[:, y0:y0 + 32, x0:x0 + 32, :]
+        if flip:
+            ref = ref[:, :, ::-1, :]
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_u8_to_f32_affine():
+    x = _imgs(n=2)
+    out = native.u8_to_f32(x, 2.0 / 255.0, -1.0)
+    ref = x.astype(np.float32) * (2.0 / 255.0) - 1.0
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+    assert out.dtype == np.float32
+
+
+def test_non_contiguous_input_handled():
+    x = _imgs(n=8)[::2]  # stride-2 view
+    a = transforms.pad_crop_flip(x, np.random.RandomState(3), use_native=True)
+    b = transforms.pad_crop_flip(
+        np.ascontiguousarray(x), np.random.RandomState(3), use_native=False)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_native_faster_than_numpy():
+    import time
+
+    big = _imgs(n=1024, seed=5)
+
+    def bench(use_native):
+        rng = np.random.RandomState(0)
+        t0 = time.perf_counter()
+        for _ in range(5):
+            transforms.pad_crop_flip(big, rng, use_native=use_native)
+        return time.perf_counter() - t0
+
+    bench(True)  # warm the thread pool/page cache
+    t_native = bench(True)
+    t_numpy = bench(False)
+    # Regression guard only (CI machines vary): native must not be slower.
+    assert t_native < t_numpy * 1.5, (t_native, t_numpy)
