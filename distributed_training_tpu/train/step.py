@@ -123,6 +123,16 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None):
         new_state = state.apply_gradients(grads)
         new_state = new_state.replace(batch_stats=new_batch_stats)
 
+    if axis_name is not None and new_batch_stats:
+        # shard_map path: with SyncBN (model axis_name set) stats are already
+        # identical across shards and this pmean is a no-op; with local BN
+        # they diverge per shard, and the step's contract is replicated
+        # output state — average them (torch DDP instead silently keeps
+        # per-rank stats and checkpoints rank 0's; averaging is deterministic
+        # and at least as principled).
+        new_state = new_state.replace(
+            batch_stats=jax.lax.pmean(new_state.batch_stats, axis_name))
+
     accuracy = jnp.mean(
         (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
     if axis_name is not None:

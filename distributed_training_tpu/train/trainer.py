@@ -50,13 +50,10 @@ class Trainer:
             raise NotImplementedError(
                 f"MoE is only wired into the moe_* models (models/moe.py); "
                 f"model {cfg.model!r} would silently train dense")
-        if not cfg.sync_batchnorm:
-            import warnings
-
-            warnings.warn(
-                "sync_batchnorm=False: the GSPMD train step still reduces BN "
-                "statistics over the global batch (local-BN needs the "
-                "shard_map step); statistics will be global")
+        if not cfg.sync_batchnorm and cfg.zero.stage != 0:
+            raise NotImplementedError(
+                "sync_batchnorm=False uses the explicit shard_map DP step, "
+                "which has no ZeRO sharding; use zero stage 0 with local BN")
 
         policy = Policy.from_config(cfg.precision)
         model_kwargs = {}
@@ -71,11 +68,17 @@ class Trainer:
                 mlp_type=cfg.moe.mlp_type,
                 expert_axis="expert" if mesh_shape.get("expert", 1) > 1 else None,
             )
+        # GSPMD path: BN statistics reduce over the global (sharded) batch
+        # automatically — SyncBN for free, no axis name needed. Local BN
+        # (sync_batchnorm=False, the torch-DDP-default semantics) instead
+        # uses the explicit shard_map step where each shard computes its own
+        # statistics (model axis_name stays None there too: BN only syncs
+        # when the model is given the mesh axis).
         self.model = get_model(
             cfg.model,
             num_classes=cfg.data.num_classes,
             dtype=policy.compute_dtype,
-            axis_name=None,  # GSPMD path: BN sync is automatic over the mesh
+            axis_name=None,
             **model_kwargs,
         )
         self.tx = make_optimizer(cfg.optimizer, cfg.scheduler, self.world_size)
@@ -91,7 +94,15 @@ class Trainer:
         self.shardings = state_shardings(state, self.mesh, cfg.zero.stage)
         self.state = place_state(state, self.shardings)
 
-        self.train_step = make_train_step(self.mesh, zero_stage=cfg.zero.stage)
+        if cfg.sync_batchnorm:
+            self.train_step = make_train_step(
+                self.mesh, zero_stage=cfg.zero.stage)
+        else:
+            from distributed_training_tpu.train.step import (
+                make_shard_map_train_step,
+            )
+
+            self.train_step = make_shard_map_train_step(self.mesh)
         self.eval_step = make_eval_step(self.mesh)
         self.meter = MetricMeter(cfg.log_interval)
         self.clock = WallClock(cfg.wall_clock_breakdown)

@@ -139,6 +139,57 @@ def test_adam_dp_step_matches_single_device(mesh):
     assert _maxdiff(new_state.params, ref_params) < 2e-2
 
 
+def test_local_bn_differs_from_sync_bn_in_variance(mesh):
+    """sync_batchnorm=False semantics: per-shard statistics.
+
+    Shard means average to the global mean (equal shard sizes), so the
+    running-mean EMAs agree; the running-*variance* EMAs must differ
+    (E[shard var] < global var when shard means differ) — that gap IS the
+    local-vs-sync distinction.
+    """
+    batch = _batch(n=16, seed=11)
+    rng = jax.random.PRNGKey(0)
+
+    with mesh:
+        step = make_shard_map_train_step(mesh, donate=False)
+        local_state, _ = step(_make_state(axis_name=None), batch, rng)
+        sync_state, _ = step(_make_state(axis_name="data"), batch, rng)
+
+    def stem(s, kind):
+        # Only the STEM BN sees identical inputs under both modes; deeper
+        # layers' inputs already differ (they are downstream of the first
+        # normalization), so the clean local-vs-sync contrast lives here.
+        [v] = [np.asarray(v) for k, v in
+               jax.tree_util.tree_flatten_with_path(s.batch_stats)[0]
+               if "bn_init" in jax.tree_util.keystr(k)
+               and kind in jax.tree_util.keystr(k)]
+        return v
+
+    # Shard means average to the global mean → running means agree...
+    np.testing.assert_allclose(
+        stem(local_state, "mean"), stem(sync_state, "mean"), atol=1e-5)
+    # ...but E[shard var] < global var: the variance EMAs must differ.
+    var_gap = np.abs(
+        stem(local_state, "var") - stem(sync_state, "var")).max()
+    assert var_gap > 1e-6, "local BN must produce different variance stats"
+
+
+def test_trainer_local_bn_path(tmp_path):
+    from distributed_training_tpu import TrainConfig, Trainer
+    from distributed_training_tpu.config import CheckpointConfig, DataConfig
+
+    cfg = TrainConfig.from_plugin("torch_ddp").replace(
+        model="resnet18", num_epochs=1, log_interval=4, sync_batchnorm=False,
+        data=DataConfig(dataset="synthetic_cifar", batch_size=8,
+                        max_steps_per_epoch=6),
+        checkpoint=CheckpointConfig(directory=str(tmp_path), interval=0))
+    trainer = Trainer(cfg)
+    loader, _ = trainer.make_loaders()
+    metrics = trainer.train_epoch(0, loader)
+    assert metrics["loss"] < 2.3
+    assert metrics["grads_finite"] == 1.0
+
+
 def test_gspmd_and_shard_map_paths_agree(mesh):
     state_a = _make_state()
     state_b = _make_state(axis_name="data")

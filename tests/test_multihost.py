@@ -1,0 +1,114 @@
+"""Multi-process rendezvous (SURVEY.md §4 'Multi-host').
+
+The reference approximates multi-node with 2 local ranks + a TCP store
+(``mp.spawn`` + MASTER_ADDR=localhost, ``resnet/pytorch_ddp/ddp_train.py:
+79-85,112-114``). The JAX analogue: 2 *processes* (one per would-be host),
+``jax.distributed.initialize`` against a local coordinator, 4 virtual CPU
+devices each → one 8-device global mesh; a psum must see all 8 devices and
+the sharded loader must hand each process disjoint halves of every global
+batch.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_training_tpu.runtime.distributed import initialize_distributed
+    initialize_distributed()  # from MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE
+
+    import numpy as np
+    import jax.numpy as jnp
+    from distributed_training_tpu.runtime.coordinator import Coordinator
+    from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+    from distributed_training_tpu.parallel.sharding import batch_sharding
+    from distributed_training_tpu.data.pipeline import (
+        ShardedDataLoader, to_global_batch)
+    from distributed_training_tpu.data.cifar10 import synthetic_cifar10
+
+    coord = Coordinator()
+    assert coord.process_count == 2, coord.process_count
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    with coord.priority_execution("test"):
+        pass  # serialized section must not deadlock
+    coord.barrier("sync")
+
+    mesh = create_mesh(MeshConfig(data=-1))
+
+    x, y = synthetic_cifar10(64, train=True)
+    loader = ShardedDataLoader(x, y, global_batch_size=16, shuffle=True,
+                               drop_last=True, augment="none", train=True)
+    assert loader.local_batch_size == 8
+    batch = next(iter(loader))
+    shardings = {k: batch_sharding(mesh, v.ndim) for k, v in batch.items()}
+    gbatch = to_global_batch(batch, mesh, shardings)
+    assert gbatch["image"].shape[0] == 16  # global logical batch
+
+    # A cross-process collective: global sum of per-device ones == 8.
+    total = jax.jit(
+        lambda v: jnp.sum(v),
+        out_shardings=None,
+    )(jnp.ones((8,)))
+    # And through the sharded array: mean label must match on all processes.
+    mean_label = float(jnp.mean(gbatch["label"].astype(jnp.float32)))
+    print(f"OK rank={coord.process_index} total={float(total)} "
+          f"mean_label={mean_label:.4f}", flush=True)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_rendezvous_and_sharding():
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            PYTHONPATH=REPO,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT=str(port),
+            RANK=str(rank),
+            WORLD_SIZE="2",
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env))
+
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err[-2000:]
+    lines = [o.strip().splitlines()[-1] for _, o, _ in outs]
+    assert any("rank=0" in l for l in lines)
+    assert any("rank=1" in l for l in lines)
+    # Both processes computed over the same 8-device world and agree on the
+    # globally-sharded batch content.
+    total0 = [l for l in lines if "rank=0" in l][0]
+    total1 = [l for l in lines if "rank=1" in l][0]
+    assert total0.split("total=")[1] == total1.split("total=")[1]
+    assert total0.split("mean_label=")[1] == total1.split("mean_label=")[1]
+    assert "total=8.0" in total0
