@@ -37,8 +37,14 @@ def _moe(**kw):
     return make_moe_classifier(**kw)
 
 
+def _lm(**kw):
+    from distributed_training_tpu.models.gpt import make_transformer_lm
+    return make_transformer_lm(**kw)
+
+
 _REGISTRY["vit_b16"] = _vit
 _REGISTRY["moe_mlp"] = _moe
+_REGISTRY["transformer_lm"] = _lm
 
 
 def available_models() -> list[str]:

@@ -1,0 +1,156 @@
+"""Decoder-only transformer LM with first-class sequence parallelism.
+
+The reference has no attention model and no sequence dimension at all
+(SURVEY.md §5 "Long-context": its only model is
+``torchvision.models.resnet18``, ``resnet/pytorch_ddp/ddp_train.py:95``).
+Long-context is nonetheless first-class in this framework, and this module
+is the model family that exercises it: a GPT-style causal LM whose attention
+is :class:`~distributed_training_tpu.parallel.ring_attention.RingSelfAttention`.
+
+Sequence parallelism is a *constructor argument*, not a separate model: with
+``seq_axis=None`` the model is an ordinary single-device causal LM (the test
+oracle); with ``seq_axis='sequence'`` every activation is a local sequence
+shard and only K/V blocks travel the ring (``lax.ppermute`` neighbor hops on
+the ICI torus). All other ops — embeddings, LayerNorm, MLP, the LM head —
+are position-wise, so they need no communication under sequence sharding.
+
+Positions are explicit inputs: under ``shard_map`` each shard passes its
+*global* token positions so learned positional embeddings and the causal
+mask are exact across shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from distributed_training_tpu.parallel.ring_attention import RingSelfAttention
+
+
+class MlpBlock(nn.Module):
+    """Position-wise transformer MLP (fc1 → GELU → fc2).
+
+    Kernel layout is TP-friendly: fc1 splits columns, fc2 splits rows over
+    the ``model`` mesh axis (see ``parallel/tensor_parallel.py``).
+    """
+
+    mlp_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
+        h = nn.gelu(h)
+        return nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+
+
+class DecoderBlock(nn.Module):
+    """Pre-LN causal decoder block: LN → ring-MHA → residual → LN → MLP."""
+
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.float32
+    seq_axis: str | None = None
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        y = RingSelfAttention(
+            num_heads=self.num_heads, dtype=self.dtype,
+            axis_name=self.seq_axis, causal=True, name="attn")(y)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = MlpBlock(mlp_dim=self.mlp_dim, dtype=self.dtype, name="mlp")(y)
+        if self.dropout_rate:
+            y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    """GPT-style causal LM.
+
+    Inputs: ``tokens`` int32 [B, T_local]; ``positions`` int32 [B, T_local]
+    of *global* positions (None → 0..T-1, the unsharded case). Returns
+    logits [B, T_local, vocab].
+    """
+
+    vocab_size: int
+    num_layers: int = 4
+    num_heads: int = 4
+    hidden_dim: int = 256
+    mlp_ratio: int = 4
+    max_len: int = 2048
+    dtype: Any = jnp.float32
+    seq_axis: str | None = None
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, train: bool = False):
+        if positions is None:
+            # Unsharded path: the sequence length is static, so bound-check
+            # it here — JAX gathers clamp out-of-range indices, which would
+            # otherwise silently reuse pos_embed[max_len-1] for every token
+            # past the table. (The sharded path's positions are traced and
+            # cannot be checked here; make_lm_train_step requires max_len
+            # and checks the global length instead.)
+            if tokens.shape[-1] > self.max_len:
+                raise ValueError(
+                    f"sequence length {tokens.shape[-1]} exceeds "
+                    f"max_len={self.max_len}")
+            positions = jnp.arange(tokens.shape[-1])[None, :]
+        x = nn.Embed(
+            self.vocab_size, self.hidden_dim,
+            dtype=self.dtype, name="tok_embed")(tokens)
+        pos_tab = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_len, self.hidden_dim))
+        x = x + pos_tab[positions].astype(self.dtype)
+        for i in range(self.num_layers):
+            x = DecoderBlock(
+                num_heads=self.num_heads,
+                mlp_dim=self.mlp_ratio * self.hidden_dim,
+                dtype=self.dtype,
+                seq_axis=self.seq_axis,
+                dropout_rate=self.dropout_rate,
+                name=f"block{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # Untied head; fp32 logits for a stable softmax under bf16 compute.
+        logits = nn.Dense(
+            self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
+        return logits
+
+
+def make_transformer_lm(
+    *,
+    num_classes: int = 256,
+    dtype: Any = jnp.float32,
+    axis_name: str | None = None,
+    seq_axis: str | None = None,
+    num_layers: int = 4,
+    num_heads: int = 4,
+    hidden_dim: int = 256,
+    mlp_ratio: int = 4,
+    max_len: int = 2048,
+    dropout_rate: float = 0.0,
+    **_: Any,
+) -> TransformerLM:
+    """Registry factory. ``num_classes`` doubles as vocab size; ``axis_name``
+    (the registry's SyncBN slot) is unused — LM has no BatchNorm."""
+    del axis_name
+    return TransformerLM(
+        vocab_size=num_classes,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        hidden_dim=hidden_dim,
+        mlp_ratio=mlp_ratio,
+        max_len=max_len,
+        dtype=dtype,
+        seq_axis=seq_axis,
+        dropout_rate=dropout_rate,
+    )

@@ -138,8 +138,14 @@ class RingSelfAttention(nn.Module):
         # [B, T, H, hd] -> [B, H, T, hd]
         q, k, v = (jnp.swapaxes(t, -3, -2) for t in (q, k, v))
 
+        # model.init traces this module outside shard_map where the mesh
+        # axis is unbound; params don't depend on the ring, so init uses the
+        # exact single-block path. Real applies keep the axis requirement
+        # loud: an unbound axis at apply time raises, catching models run
+        # under plain jit when they needed the shard_map step.
+        axis_name = None if self.is_initializing() else self.axis_name
         out = ring_attention(
-            q, k, v, axis_name=self.axis_name, causal=self.causal)
+            q, k, v, axis_name=axis_name, causal=self.causal)
 
         out = jnp.swapaxes(out, -3, -2)  # back to [B, T, H, hd]
         return dense(

@@ -1,0 +1,149 @@
+"""Sequence-parallel LM train step (context parallelism over the mesh).
+
+The long-context training path the reference lacks entirely (SURVEY.md §5
+"Long-context / sequence parallelism": absent — no attention model, no
+sequence dimension). Design:
+
+- the token batch [B, T] is sharded over BOTH mesh axes: ``data`` on the
+  batch dim and ``sequence`` on the time dim, so a sequence 8× longer than
+  one chip's HBM budget trains by adding devices to the ``sequence`` axis;
+- the step is a ``shard_map`` over the mesh: each device runs the model on
+  its [B/dp, T/sp] activation shard, with ring attention rotating K/V blocks
+  via ``lax.ppermute`` (see ``parallel/ring_attention.py``) — the only
+  communication the sequence axis needs;
+- every device computes grads for the full (replicated) parameter set from
+  its local tokens; the true gradient of the global mean loss is the mean of
+  shard grads over ``(data, sequence)`` — one fused ``lax.pmean``, the
+  direct generalization of DDP's all-reduce to context parallelism;
+- global token positions come from ``lax.axis_index('sequence')`` so learned
+  positional embeddings and causal masks are exact across shards.
+
+Next-token targets are produced host-side (``targets[t] = tokens[t+1]``)
+*before* sharding, so the shift crosses shard boundaries correctly without
+any halo exchange.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_training_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQUENCE
+from distributed_training_tpu.train.precision import all_finite, select_tree
+from distributed_training_tpu.train.train_state import TrainState
+from distributed_training_tpu.utils.compat import shard_map
+
+_GRAD_AXES = (AXIS_DATA, AXIS_SEQUENCE)
+
+
+def _lm_step_body(state: TrainState, batch, rng):
+    tokens = batch["tokens"]
+    targets = batch["targets"]
+    t_local = tokens.shape[1]
+    seq_idx = lax.axis_index(AXIS_SEQUENCE)
+    positions = (seq_idx * t_local + jnp.arange(t_local))[None, :]
+    # Decorrelate dropout across shards; no-op when the model has none.
+    shard_rng = jax.random.fold_in(
+        rng, seq_idx * lax.axis_size(AXIS_DATA) + lax.axis_index(AXIS_DATA))
+
+    def loss_fn(params):
+        logits = state.apply_fn(
+            {"params": params}, tokens, positions=positions, train=True,
+            rngs={"dropout": shard_rng})
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+        return state.loss_scale.scale_loss(loss), (loss, logits)
+
+    grads, (loss, logits) = jax.grad(loss_fn, has_aux=True)(state.params)
+    grads = lax.pmean(grads, _GRAD_AXES)
+    grads = state.loss_scale.unscale_grads(grads)
+
+    if state.loss_scale.dynamic:
+        finite = all_finite(grads)
+        candidate = state.apply_gradients(grads)
+        new_state = select_tree(
+            finite,
+            candidate.replace(loss_scale=state.loss_scale.update(finite)),
+            state.replace(loss_scale=state.loss_scale.update(finite)),
+        )
+        new_state = new_state.replace(
+            step=state.step + finite.astype(jnp.int32))
+    else:
+        finite = jnp.bool_(True)
+        new_state = state.apply_gradients(grads)
+
+    loss = lax.pmean(loss, _GRAD_AXES)
+    accuracy = lax.pmean(
+        jnp.mean((jnp.argmax(logits, -1) == targets).astype(jnp.float32)),
+        _GRAD_AXES)
+    metrics = {
+        "loss": loss.astype(jnp.float32),
+        "accuracy": accuracy,
+        "perplexity": jnp.exp(loss).astype(jnp.float32),
+        "loss_scale": new_state.loss_scale.scale,
+        "grads_finite": finite.astype(jnp.float32),
+    }
+    return new_state, metrics
+
+
+def make_lm_train_step(
+    mesh: Mesh, *, max_len: int, donate: bool = True,
+) -> Callable:
+    """Build the (data × sequence)-parallel jitted LM train step.
+
+    Returns ``step(state, batch, rng) -> (state, metrics)`` where ``batch``
+    is ``{'tokens': i32[B, T], 'targets': i32[B, T]}`` as *global* arrays;
+    params/opt state replicated (ZeRO placement of LM states composes via
+    ``parallel/sharding.py`` but the sequence path keeps them replicated —
+    the sequence axis's job is activation memory, not state memory).
+
+    ``max_len`` (required): the model's positional-table size. Global
+    positions are traced values inside shard_map, so the model cannot
+    bound-check them itself, and JAX gathers clamp out-of-range indices —
+    an oversized T would silently reuse the last positional embedding.
+    The global sequence length is checked here instead, at the only place
+    it is statically known.
+    """
+    batch_spec = {"tokens": P(AXIS_DATA, AXIS_SEQUENCE),
+                  "targets": P(AXIS_DATA, AXIS_SEQUENCE)}
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def jitted(state: TrainState, batch, rng):
+        sharded = shard_map(
+            _lm_step_body, mesh,
+            in_specs=(jax.tree.map(lambda _: P(), state), batch_spec, P()),
+            out_specs=(jax.tree.map(lambda _: P(), state), P()),
+        )
+        return sharded(state, batch, rng)
+
+    def step(state: TrainState, batch, rng):
+        t_global = batch["tokens"].shape[1]
+        if t_global > max_len:
+            raise ValueError(
+                f"global sequence length {t_global} exceeds the model's "
+                f"positional table max_len={max_len}")
+        return jitted(state, batch, rng)
+
+    return step
+
+
+def lm_batch_shardings(mesh: Mesh) -> dict:
+    """NamedShardings for placing host token arrays on the mesh."""
+    spec = P(AXIS_DATA, AXIS_SEQUENCE)
+    return {"tokens": NamedSharding(mesh, spec),
+            "targets": NamedSharding(mesh, spec)}
+
+
+def make_lm_batch(tokens) -> dict:
+    """Host-side next-token split: inputs = tokens[:, :-1], targets = tokens[:, 1:].
+
+    Done before device sharding so the one-position shift crosses sequence
+    shard boundaries for free.
+    """
+    return {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}

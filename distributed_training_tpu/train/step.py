@@ -46,11 +46,7 @@ from distributed_training_tpu.parallel.sharding import (
 from distributed_training_tpu.runtime.mesh import AXIS_DATA
 from distributed_training_tpu.train.precision import all_finite, select_tree
 from distributed_training_tpu.train.train_state import TrainState
-
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from distributed_training_tpu.utils.compat import shard_map
 
 
 def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
@@ -193,20 +189,11 @@ def make_shard_map_train_step(mesh: Mesh, donate: bool = True) -> Callable:
     ``'data'`` so BatchNorm stats pmean over the same axis).
     """
 
-    def _smap(fn, in_specs, out_specs):
-        try:
-            return _shard_map(
-                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False)
-        except TypeError:  # older jax spells the flag check_rep
-            return _shard_map(
-                fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                check_rep=False)
-
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: TrainState, batch, rng):
-        sharded = _smap(
+        sharded = shard_map(
             functools.partial(_step_body, axis_name=AXIS_DATA),
+            mesh,
             in_specs=(
                 jax.tree.map(lambda _: P(), state),
                 {"image": P(AXIS_DATA), "label": P(AXIS_DATA)},

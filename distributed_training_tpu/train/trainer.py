@@ -28,7 +28,11 @@ from distributed_training_tpu.runtime.coordinator import Coordinator
 from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh, data_axis_size
 from distributed_training_tpu.train.optim import make_optimizer
 from distributed_training_tpu.train.precision import LossScaleState, Policy
-from distributed_training_tpu.train.step import make_eval_step, make_train_step
+from distributed_training_tpu.train.step import (
+    make_eval_step,
+    make_shard_map_train_step,
+    make_train_step,
+)
 from distributed_training_tpu.train.train_state import init_train_state, param_count
 from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
 from distributed_training_tpu.utils.profiling import WallClock, trace
@@ -50,10 +54,11 @@ class Trainer:
             raise NotImplementedError(
                 f"MoE is only wired into the moe_* models (models/moe.py); "
                 f"model {cfg.model!r} would silently train dense")
-        if not cfg.sync_batchnorm and cfg.zero.stage != 0:
+        if cfg.model == "transformer_lm":
             raise NotImplementedError(
-                "sync_batchnorm=False uses the explicit shard_map DP step, "
-                "which has no ZeRO sharding; use zero stage 0 with local BN")
+                "transformer_lm is a token model; this Trainer drives image "
+                "classification. Use train.lm_step.make_lm_train_step with "
+                "a (data × sequence) mesh (see tests/test_lm_sequence_parallel.py)")
 
         policy = Policy.from_config(cfg.precision)
         model_kwargs = {}
@@ -94,14 +99,19 @@ class Trainer:
         self.shardings = state_shardings(state, self.mesh, cfg.zero.stage)
         self.state = place_state(state, self.shardings)
 
-        if cfg.sync_batchnorm:
+        # Local-vs-sync BN only differs for models that actually carry
+        # BatchNorm state; BN-free models (ViT, MoE-MLP) always take the
+        # GSPMD path, where ZeRO placement composes.
+        has_bn = bool(jax.tree.leaves(state.batch_stats))
+        if cfg.sync_batchnorm or not has_bn:
             self.train_step = make_train_step(
                 self.mesh, zero_stage=cfg.zero.stage)
         else:
-            from distributed_training_tpu.train.step import (
-                make_shard_map_train_step,
-            )
-
+            if cfg.zero.stage != 0:
+                raise NotImplementedError(
+                    "sync_batchnorm=False uses the explicit shard_map DP "
+                    "step, which has no ZeRO sharding; use zero stage 0 "
+                    "with local BN")
             self.train_step = make_shard_map_train_step(self.mesh)
         self.eval_step = make_eval_step(self.mesh)
         self.meter = MetricMeter(cfg.log_interval)

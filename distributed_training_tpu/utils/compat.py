@@ -1,0 +1,23 @@
+"""JAX API compatibility shims shared across the framework."""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(fn, mesh, in_specs, out_specs):
+    """``shard_map`` without replication checking, across jax versions.
+
+    The replication-check flag was renamed ``check_rep`` → ``check_vma``;
+    both spellings are handled here so callers don't each carry the
+    try/except.
+    """
+    try:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)

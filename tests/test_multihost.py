@@ -56,11 +56,16 @@ WORKER = textwrap.dedent("""
     gbatch = to_global_batch(batch, mesh, shardings)
     assert gbatch["image"].shape[0] == 16  # global logical batch
 
-    # A cross-process collective: global sum of per-device ones == 8.
-    total = jax.jit(
-        lambda v: jnp.sum(v),
-        out_shardings=None,
-    )(jnp.ones((8,)))
+    # A cross-process collective: each process contributes a DIFFERENT
+    # local shard of a global array sharded across both processes' devices;
+    # the jitted sum must communicate to see all shards. rank0 holds
+    # [1,2,3,4], rank1 [5,6,7,8] -> global sum 36 on both.
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+    sharding = NamedSharding(mesh, Pspec("data"))
+    local = np.arange(1, 5, dtype=np.float32) + 4 * coord.process_index
+    garr = jax.make_array_from_process_local_data(sharding, local)
+    assert garr.shape == (8,)
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, Pspec()))(garr)
     # And through the sharded array: mean label must match on all processes.
     mean_label = float(jnp.mean(gbatch["label"].astype(jnp.float32)))
     print(f"OK rank={coord.process_index} total={float(total)} "
@@ -111,4 +116,4 @@ def test_two_process_rendezvous_and_sharding():
     total1 = [l for l in lines if "rank=1" in l][0]
     assert total0.split("total=")[1] == total1.split("total=")[1]
     assert total0.split("mean_label=")[1] == total1.split("mean_label=")[1]
-    assert "total=8.0" in total0
+    assert "total=36.0" in total0
