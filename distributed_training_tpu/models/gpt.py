@@ -138,10 +138,11 @@ def make_transformer_lm(
     mlp_ratio: int = 4,
     max_len: int = 2048,
     dropout_rate: float = 0.0,
-    **_: Any,
 ) -> TransformerLM:
     """Registry factory. ``num_classes`` doubles as vocab size; ``axis_name``
-    (the registry's SyncBN slot) is unused — LM has no BatchNorm."""
+    (the registry's SyncBN slot) is unused — LM has no BatchNorm. Unknown
+    kwargs raise (a swallowed typo like ``seq_axis_name=`` would silently
+    build an unsharded model that trains block-diagonal attention)."""
     del axis_name
     return TransformerLM(
         vocab_size=num_classes,

@@ -35,7 +35,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_training_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQUENCE
-from distributed_training_tpu.train.precision import all_finite, select_tree
+from distributed_training_tpu.train.precision import commit_gradients
 from distributed_training_tpu.train.train_state import TrainState
 from distributed_training_tpu.utils.compat import shard_map
 
@@ -64,19 +64,7 @@ def _lm_step_body(state: TrainState, batch, rng):
     grads = lax.pmean(grads, _GRAD_AXES)
     grads = state.loss_scale.unscale_grads(grads)
 
-    if state.loss_scale.dynamic:
-        finite = all_finite(grads)
-        candidate = state.apply_gradients(grads)
-        new_state = select_tree(
-            finite,
-            candidate.replace(loss_scale=state.loss_scale.update(finite)),
-            state.replace(loss_scale=state.loss_scale.update(finite)),
-        )
-        new_state = new_state.replace(
-            step=state.step + finite.astype(jnp.int32))
-    else:
-        finite = jnp.bool_(True)
-        new_state = state.apply_gradients(grads)
+    new_state, finite = commit_gradients(state, grads)
 
     loss = lax.pmean(loss, _GRAD_AXES)
     accuracy = lax.pmean(
@@ -93,7 +81,8 @@ def _lm_step_body(state: TrainState, batch, rng):
 
 
 def make_lm_train_step(
-    mesh: Mesh, *, max_len: int, donate: bool = True,
+    mesh: Mesh, *, model=None, max_len: int | None = None,
+    donate: bool = True,
 ) -> Callable:
     """Build the (data × sequence)-parallel jitted LM train step.
 
@@ -103,13 +92,19 @@ def make_lm_train_step(
     ``parallel/sharding.py`` but the sequence path keeps them replicated —
     the sequence axis's job is activation memory, not state memory).
 
-    ``max_len`` (required): the model's positional-table size. Global
-    positions are traced values inside shard_map, so the model cannot
+    ``model`` or ``max_len`` (exactly one): the positional-table bound.
+    Global positions are traced values inside shard_map, so the model cannot
     bound-check them itself, and JAX gathers clamp out-of-range indices —
-    an oversized T would silently reuse the last positional embedding.
-    The global sequence length is checked here instead, at the only place
-    it is statically known.
+    an oversized T would silently reuse the last positional embedding. The
+    global sequence length is checked here, at the only place it is
+    statically known. Pass ``model=`` (the :class:`TransformerLM`) to derive
+    the bound from the table itself; a hand-passed ``max_len`` that
+    disagrees with the model's would re-open the silent-clamp gap.
     """
+    if (model is None) == (max_len is None):
+        raise ValueError("pass exactly one of model= or max_len=")
+    if model is not None:
+        max_len = model.max_len
     batch_spec = {"tokens": P(AXIS_DATA, AXIS_SEQUENCE),
                   "targets": P(AXIS_DATA, AXIS_SEQUENCE)}
 

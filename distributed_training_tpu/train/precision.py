@@ -141,3 +141,41 @@ def select_tree(pred: jnp.ndarray, on_true, on_false):
     """Elementwise ``where`` over matching pytrees (skip-step on overflow)."""
     return jax.tree.map(
         lambda t, f: jnp.where(pred, t, f), on_true, on_false)
+
+
+def commit_gradients(state, grads, new_batch_stats=None):
+    """Apply unscaled grads to a TrainState with overflow skip/commit.
+
+    The one copy of the dynamic-loss-scale transaction shared by the image
+    step (``train/step.py``) and the LM step (``train/lm_step.py``):
+
+    - dynamic scaler: detect overflow, apply-or-skip the whole update
+      (``select_tree`` wheres every leaf, so the step counter must be
+      recomputed explicitly — a skipped step must not tick the scheduler),
+      and commit ``new_batch_stats`` only on good steps (an overflowed
+      forward's running mean/var are non-finite and would poison BN
+      permanently);
+    - static/inert scaler: plain apply.
+
+    Returns ``(new_state, grads_finite)``.
+    """
+    if state.loss_scale.dynamic:
+        finite = all_finite(grads)
+        candidate = state.apply_gradients(grads)
+        new_state = select_tree(
+            finite,
+            candidate.replace(loss_scale=state.loss_scale.update(finite)),
+            state.replace(loss_scale=state.loss_scale.update(finite)),
+        )
+        new_state = new_state.replace(
+            step=state.step + finite.astype(jnp.int32))
+        if new_batch_stats is not None:
+            new_state = new_state.replace(
+                batch_stats=select_tree(
+                    finite, new_batch_stats, state.batch_stats))
+    else:
+        finite = jnp.bool_(True)
+        new_state = state.apply_gradients(grads)
+        if new_batch_stats is not None:
+            new_state = new_state.replace(batch_stats=new_batch_stats)
+    return new_state, finite

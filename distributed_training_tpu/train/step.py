@@ -44,7 +44,7 @@ from distributed_training_tpu.parallel.sharding import (
     state_shardings,
 )
 from distributed_training_tpu.runtime.mesh import AXIS_DATA
-from distributed_training_tpu.train.precision import all_finite, select_tree
+from distributed_training_tpu.train.precision import commit_gradients
 from distributed_training_tpu.train.train_state import TrainState
 from distributed_training_tpu.utils.compat import shard_map
 
@@ -97,27 +97,7 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None):
 
     grads = state.loss_scale.unscale_grads(grads)
 
-    if state.loss_scale.dynamic:
-        finite = all_finite(grads)
-        candidate = state.apply_gradients(grads)
-        new_state = select_tree(
-            finite,
-            candidate.replace(loss_scale=state.loss_scale.update(finite)),
-            state.replace(loss_scale=state.loss_scale.update(finite)),
-        )
-        # select_tree ran jnp.where over every leaf incl. step; recompute the
-        # step explicitly so a skipped step doesn't tick the scheduler.
-        # BatchNorm stats from an overflowed forward are non-finite — commit
-        # them only on good steps, or one bad batch would poison the running
-        # mean/var permanently (every later eval would see NaN logits).
-        new_state = new_state.replace(
-            step=state.step + finite.astype(jnp.int32),
-            batch_stats=select_tree(finite, new_batch_stats, state.batch_stats),
-        )
-    else:
-        finite = jnp.bool_(True)
-        new_state = state.apply_gradients(grads)
-        new_state = new_state.replace(batch_stats=new_batch_stats)
+    new_state, finite = commit_gradients(state, grads, new_batch_stats)
 
     if axis_name is not None and new_batch_stats:
         # shard_map path: with SyncBN (model axis_name set) stats are already

@@ -39,10 +39,11 @@ def _make_state(seq_axis, dtype="fp32", seed=0, max_len=128, opt="adam"):
     # amplifies fp32 collective-reassociation noise into O(lr) param diffs.
     tx = (optax.sgd(0.1) if opt == "sgd" else
           optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3)))
-    return init_train_state(
+    state = init_train_state(
         model, jax.random.PRNGKey(seed), (2, 16), tx,
         loss_scale=LossScaleState.create(PrecisionConfig(dtype=dtype)),
         input_dtype=jnp.int32)
+    return model, state
 
 
 def _tokens(b=4, t=65, seed=0):
@@ -50,7 +51,7 @@ def _tokens(b=4, t=65, seed=0):
 
 
 def test_lm_forward_shapes():
-    state = _make_state(None)
+    _, state = _make_state(None)
     batch = make_lm_batch(_tokens())
     logits = state.apply_fn(
         {"params": state.params}, jnp.asarray(batch["tokens"]), train=False)
@@ -66,7 +67,7 @@ def test_sequence_parallel_step_matches_single_device(lm_mesh):
     rng = jax.random.PRNGKey(7)
 
     # Oracle: unsharded model, plain full-batch step.
-    oracle = _make_state(None, opt="sgd")
+    _, oracle = _make_state(None, opt="sgd")
 
     def oracle_step(state, batch):
         def loss_fn(params):
@@ -81,11 +82,12 @@ def test_sequence_parallel_step_matches_single_device(lm_mesh):
     oracle_new, oracle_loss = jax.jit(oracle_step)(oracle, batch)
 
     # Sequence-parallel: same init seed → same initial params.
-    sp = _make_state("sequence", opt="sgd")
+    model, sp = _make_state("sequence", opt="sgd")
     gbatch = jax.device_put(
         {k: jnp.asarray(v) for k, v in batch.items()},
         lm_batch_shardings(lm_mesh))
-    step = make_lm_train_step(lm_mesh, max_len=128, donate=False)
+    # model= path: the bound derives from the positional table itself.
+    step = make_lm_train_step(lm_mesh, model=model, donate=False)
     sp_new, metrics = step(sp, gbatch, rng)
 
     np.testing.assert_allclose(
@@ -108,7 +110,7 @@ def test_lm_loss_decreases_under_sequence_parallelism(lm_mesh):
         {k: jnp.asarray(v) for k, v in batch.items()},
         lm_batch_shardings(lm_mesh))
 
-    state = _make_state("sequence")
+    model, state = _make_state("sequence")
     step = make_lm_train_step(lm_mesh, max_len=128, donate=False)
     rng = jax.random.PRNGKey(0)
     first = None
@@ -122,14 +124,36 @@ def test_lm_loss_decreases_under_sequence_parallelism(lm_mesh):
 
 
 def test_lm_dynamic_loss_scale_skips_bad_step(lm_mesh):
-    """fp16-style dynamic scaling composes with the sequence-parallel step."""
-    state = _make_state("sequence", dtype="fp16")
+    """An overflowed gradient skips the whole update: params frozen, step
+    not ticked, one hysteresis credit consumed — the commit_gradients skip
+    transaction driven through the full sequence-parallel step."""
+    model, state = _make_state("sequence", dtype="fp16")
     assert state.loss_scale.dynamic
     batch = make_lm_batch(_tokens())
     gbatch = jax.device_put(
         {k: jnp.asarray(v) for k, v in batch.items()},
         lm_batch_shardings(lm_mesh))
     step = make_lm_train_step(lm_mesh, max_len=128, donate=False)
-    new_state, metrics = step(state, gbatch, jax.random.PRNGKey(0))
+
+    # Good step first: update applies, counter ticks.
+    good_state, metrics = step(state, gbatch, jax.random.PRNGKey(0))
     assert float(metrics["grads_finite"]) == 1.0
-    assert int(new_state.step) == 1
+    assert int(good_state.step) == 1
+
+    # Force an overflow: a loss scale beyond fp32 range makes the scaled
+    # loss (and thus every gradient) infinite.
+    bad = good_state.replace(
+        loss_scale=good_state.loss_scale.replace(scale=jnp.float32(1e38)))
+    skipped, metrics = step(bad, gbatch, jax.random.PRNGKey(1))
+    assert float(metrics["grads_finite"]) == 0.0
+    assert int(skipped.step) == 1  # NOT ticked: the scheduler must not move
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        skipped.params, bad.params)
+    # First overflow consumes a hysteresis credit (DS hysteresis=2 default)
+    # without halving the scale yet.
+    assert int(skipped.loss_scale.hysteresis_left) == \
+        int(bad.loss_scale.hysteresis_left) - 1
+    assert float(skipped.loss_scale.scale) == pytest.approx(1e38)
+    assert int(skipped.loss_scale.good_steps) == 0
