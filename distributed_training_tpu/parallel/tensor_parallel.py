@@ -1,0 +1,166 @@
+"""Tensor parallelism: megatron-style layer sharding over the ``model`` axis.
+
+The reference exercises no tensor parallelism (SURVEY.md §2.3 "TP: Absent —
+no megatron-style layer splitting anywhere in the 3 scripts"); this module is
+the natural TPU-native extension the survey names (`pjit` with a ``model``
+mesh axis).
+
+On GPU, megatron TP is hand-written: column-parallel Linear (shard output
+features, defer the gather), row-parallel Linear (shard input features,
+all-reduce the partial products), f/g conjugate autograd functions around
+each pair. On TPU the same placement is *declarative*: annotate each weight's
+PartitionSpec over the ``model`` axis and GSPMD materializes exactly those
+collectives — the row-parallel psum appears because the contraction dimension
+is sharded; the column-parallel all-gather never appears because the next
+layer consumes the sharded dimension directly. XLA's latency-hiding scheduler
+overlaps them with compute.
+
+Rules for :class:`~distributed_training_tpu.models.gpt.TransformerLM`
+(paths matched against any pytree whose leaf paths end with the param path,
+so the same table places optimizer moments — Adam mu/nu are congruent with
+params):
+
+- ``attn/qkv/kernel``  [d, 3, H, hd]  → shard H       (column-parallel QKV;
+  each TP rank owns H/tp heads, attention itself is embarrassingly parallel
+  over heads)
+- ``attn/out/kernel``  [H, hd, d]     → shard H       (row-parallel output
+  proj; GSPMD inserts the one psum per block)
+- ``mlp/fc1/kernel``   [d, 4d]        → shard cols    (column-parallel)
+- ``mlp/fc2/kernel``   [4d, d]        → shard rows    (row-parallel psum)
+- ``lm_head/kernel``   [d, V]         → shard vocab   (column-parallel;
+  softmax-CE over sharded logits becomes a psum of partial log-sum-exp)
+- ``tok_embed/embedding`` [V, d]      → shard vocab   (megatron
+  VocabParallelEmbedding; the gather over a vocab-sharded table becomes a
+  masked-gather + psum)
+- biases follow their kernel's output dim; LayerNorms/pos_embed replicated.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_training_tpu.runtime.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+)
+
+# (path regex, spec) — first match wins; matched against "/".join(path keys).
+# Specs use AXIS_MODEL; dims listed explicitly per the param layouts above.
+LM_TP_RULES: tuple[tuple[str, P], ...] = (
+    (r"attn/qkv/kernel$", P(None, None, AXIS_MODEL, None)),
+    (r"attn/qkv/bias$", P(None, AXIS_MODEL, None)),
+    (r"attn/out/kernel$", P(AXIS_MODEL, None, None)),
+    (r"attn/out/bias$", P()),
+    (r"fc1/kernel$", P(None, AXIS_MODEL)),
+    (r"fc1/bias$", P(AXIS_MODEL)),
+    (r"fc2/kernel$", P(AXIS_MODEL, None)),
+    (r"fc2/bias$", P()),
+    (r"lm_head/kernel$", P(None, AXIS_MODEL)),
+    (r"lm_head/bias$", P(AXIS_MODEL)),
+    (r"tok_embed/embedding$", P(AXIS_MODEL, None)),
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def tp_spec_for_path(path_str: str) -> P:
+    """TP PartitionSpec for one leaf path (replicated if no rule matches)."""
+    for pat, spec in LM_TP_RULES:
+        if re.search(pat, path_str):
+            return spec
+    return P()
+
+
+def _recruit_axes(spec: P, leaf: Any, mesh_shape: dict, axes: tuple[str, ...]) -> P:
+    """Additionally shard ``leaf`` over ``axes`` on a dim the TP spec left free.
+
+    This composes TP with ZeRO: the data/fsdp axes partition whatever
+    dimension the ``model`` axis did not claim (DeepSpeed's stages likewise
+    partition *within* each TP rank's slice of the weights).
+    """
+    n = int(np.prod([mesh_shape.get(a, 1) for a in axes]))
+    if n <= 1 or not hasattr(leaf, "shape") or leaf.ndim == 0:
+        return spec
+    entries = list(spec) + [None] * (leaf.ndim - len(spec))
+    free = [(leaf.shape[i], i) for i, e in enumerate(entries)
+            if e is None and leaf.shape[i] % n == 0 and leaf.shape[i] >= n]
+    if not free:
+        return spec
+    _, best = max(free)
+    entries[best] = axes if len(axes) > 1 else axes[0]
+    return P(*entries)
+
+
+def tp_tree_shardings(
+    tree: Any,
+    mesh: Mesh,
+    *,
+    extra_axes: tuple[str, ...] = (),
+) -> Any:
+    """NamedShardings for every leaf of ``tree`` by the TP rule table.
+
+    Works on params *and* on optimizer state: optax moment trees embed the
+    param tree, so leaf paths end with the param path and the same rules hit.
+    ``extra_axes`` recruits data/fsdp on a TP-free dim (ZeRO composition).
+    """
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp_on = shape.get(AXIS_MODEL, 1) > 1
+
+    def leaf_sharding(path, leaf):
+        spec = tp_spec_for_path(_path_str(path)) if tp_on else P()
+        if extra_axes:
+            spec = _recruit_axes(spec, leaf, shape, extra_axes)
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def tp_state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0):
+    """Shardings for a full TrainState under TP (+ optional ZeRO stages).
+
+    Mirrors :func:`distributed_training_tpu.parallel.sharding.state_shardings`
+    but lays the ``model`` axis through the transformer weights first, then
+    recruits data/fsdp for optimizer (stage≥1) / parameter (stage≥3) sharding
+    on the remaining dims.
+    """
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    fsdp_on = shape.get(AXIS_FSDP, 1) > 1
+    if zero_stage >= 1:
+        opt_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
+    else:
+        opt_axes = (AXIS_FSDP,) if fsdp_on else ()
+    if zero_stage >= 3:
+        param_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
+    else:
+        param_axes = (AXIS_FSDP,) if fsdp_on else ()
+
+    params_sh = tp_tree_shardings(state.params, mesh, extra_axes=param_axes)
+    opt_sh = tp_tree_shardings(state.opt_state, mesh, extra_axes=opt_axes)
+    repl = NamedSharding(mesh, P())
+    batch_stats_sh = jax.tree.map(lambda _: repl, state.batch_stats)
+    scale_sh = jax.tree.map(lambda _: repl, state.loss_scale)
+    return state.replace(
+        step=repl,
+        params=params_sh,
+        batch_stats=batch_stats_sh,
+        opt_state=opt_sh,
+        loss_scale=scale_sh,
+    )

@@ -112,8 +112,9 @@ def make_zero1_train_step(
       loss_fn: ``(params, local_batch, rng) -> scalar`` mean loss over the
         local batch shard (the step pmeans across shards).
       config: Adam hyperparameters.
-      schedule: optional ``step -> lr`` multiplier source (e.g. WarmupLR);
-        overrides ``config.lr`` when given.
+      schedule: optional ``step -> learning rate`` (an absolute lr, e.g.
+        ``optax.linear_schedule(0, 1e-3, 1000)`` for WarmupLR parity);
+        when given it *replaces* ``config.lr`` entirely.
       donate: donate the state buffers (steady-state training).
 
     Returns ``step(state, batch, rng) -> (state, metrics)`` with ``batch`` a

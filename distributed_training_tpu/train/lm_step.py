@@ -42,6 +42,38 @@ from distributed_training_tpu.utils.compat import shard_map
 _GRAD_AXES = (AXIS_DATA, AXIS_SEQUENCE)
 
 
+def _lm_loss_and_grads(state: TrainState, tokens, targets, rng, positions=None):
+    """Scaled-CE value-and-grad shared by every LM step variant."""
+    def loss_fn(params):
+        logits = state.apply_fn(
+            {"params": params}, tokens, positions=positions, train=True,
+            rngs={"dropout": rng})
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+        return state.loss_scale.scale_loss(loss), (loss, logits)
+
+    grads, (loss, logits) = jax.grad(loss_fn, has_aux=True)(state.params)
+    return grads, loss, logits
+
+
+def _lm_metrics(new_state: TrainState, loss, logits, targets, finite,
+                pmean_axes=None):
+    """The LM metrics contract; ``pmean_axes`` averages shard-local values
+    (the GSPMD path computes global values already)."""
+    accuracy = jnp.mean(
+        (jnp.argmax(logits, -1) == targets).astype(jnp.float32))
+    if pmean_axes:
+        loss = lax.pmean(loss, pmean_axes)
+        accuracy = lax.pmean(accuracy, pmean_axes)
+    return {
+        "loss": loss.astype(jnp.float32),
+        "accuracy": accuracy,
+        "perplexity": jnp.exp(loss).astype(jnp.float32),
+        "loss_scale": new_state.loss_scale.scale,
+        "grads_finite": finite.astype(jnp.float32),
+    }
+
+
 def _lm_step_body(state: TrainState, batch, rng):
     tokens = batch["tokens"]
     targets = batch["targets"]
@@ -52,32 +84,14 @@ def _lm_step_body(state: TrainState, batch, rng):
     shard_rng = jax.random.fold_in(
         rng, seq_idx * lax.axis_size(AXIS_DATA) + lax.axis_index(AXIS_DATA))
 
-    def loss_fn(params):
-        logits = state.apply_fn(
-            {"params": params}, tokens, positions=positions, train=True,
-            rngs={"dropout": shard_rng})
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, targets).mean()
-        return state.loss_scale.scale_loss(loss), (loss, logits)
-
-    grads, (loss, logits) = jax.grad(loss_fn, has_aux=True)(state.params)
+    grads, loss, logits = _lm_loss_and_grads(
+        state, tokens, targets, shard_rng, positions=positions)
     grads = lax.pmean(grads, _GRAD_AXES)
     grads = state.loss_scale.unscale_grads(grads)
 
     new_state, finite = commit_gradients(state, grads)
-
-    loss = lax.pmean(loss, _GRAD_AXES)
-    accuracy = lax.pmean(
-        jnp.mean((jnp.argmax(logits, -1) == targets).astype(jnp.float32)),
-        _GRAD_AXES)
-    metrics = {
-        "loss": loss.astype(jnp.float32),
-        "accuracy": accuracy,
-        "perplexity": jnp.exp(loss).astype(jnp.float32),
-        "loss_scale": new_state.loss_scale.scale,
-        "grads_finite": finite.astype(jnp.float32),
-    }
-    return new_state, metrics
+    return new_state, _lm_metrics(
+        new_state, loss, logits, targets, finite, pmean_axes=_GRAD_AXES)
 
 
 def make_lm_train_step(
@@ -125,6 +139,75 @@ def make_lm_train_step(
                 f"positional table max_len={max_len}")
         return jitted(state, batch, rng)
 
+    return step
+
+
+def make_tp_lm_train_step(
+    mesh: Mesh, *, model, zero_stage: int = 0, donate: bool = True,
+) -> Callable:
+    """Tensor-parallel (megatron-style) LM train step via GSPMD placement.
+
+    The conjugate of :func:`make_lm_train_step`: instead of sharding the
+    sequence and replicating weights, this shards the *weights* over the
+    ``model`` mesh axis (per ``parallel/tensor_parallel.py``'s rule table)
+    and the batch over ``data``. No collective is written by hand — the
+    row-parallel psums (attn/out, mlp/fc2, the vocab-sharded softmax-CE
+    reduction) and the gradient all-reduce over ``data`` all come from GSPMD
+    propagating the annotated placements, overlapped by XLA's scheduler.
+    ``zero_stage`` composes DeepSpeed-style optimizer/param sharding on the
+    dims TP left free (SURVEY.md §2.3 TP row: "natural extension via pjit
+    with a ``model`` mesh axis").
+
+    The model must be built with ``seq_axis=None`` (full attention; TP
+    shards heads, which is orthogonal to — and composable with — the ring
+    path, but the GSPMD step runs under plain ``jit``, where no ring axis is
+    bound).
+
+    Returns ``step(state, batch, rng) -> (state, metrics)`` plus a
+    ``.state_shardings(state)`` attribute for placing a host-built state.
+    """
+    from distributed_training_tpu.parallel.tensor_parallel import (
+        tp_state_shardings,
+    )
+
+    if model.seq_axis is not None:
+        raise ValueError(
+            "TP step runs under plain jit; build the model with "
+            "seq_axis=None (ring attention needs the shard_map step)")
+    max_len = model.max_len
+    batch_sh = {"tokens": NamedSharding(mesh, P(AXIS_DATA, None)),
+                "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
+
+    def body(state: TrainState, batch, rng):
+        grads, loss, logits = _lm_loss_and_grads(
+            state, batch["tokens"], batch["targets"], rng)
+        grads = state.loss_scale.unscale_grads(grads)
+        new_state, finite = commit_gradients(state, grads)
+        return new_state, _lm_metrics(
+            new_state, loss, logits, batch["targets"], finite)
+
+    jitted = None  # built lazily: shardings need a concrete state's pytree
+
+    def step(state: TrainState, batch, rng):
+        nonlocal jitted
+        t_global = batch["tokens"].shape[1]
+        if t_global > max_len:
+            raise ValueError(
+                f"sequence length {t_global} exceeds max_len={max_len}")
+        if jitted is None:
+            state_sh = tp_state_shardings(state, mesh, zero_stage=zero_stage)
+            repl = NamedSharding(mesh, P())
+            jitted = jax.jit(
+                body,
+                in_shardings=(state_sh, batch_sh, repl),
+                out_shardings=(state_sh, repl),
+                donate_argnums=(0,) if donate else (),
+            )
+        return jitted(state, batch, rng)
+
+    step.state_shardings = lambda state: tp_state_shardings(
+        state, mesh, zero_stage=zero_stage)
+    step.batch_shardings = batch_sh
     return step
 
 
