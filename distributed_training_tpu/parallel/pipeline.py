@@ -1,0 +1,224 @@
+"""Pipeline parallelism: GPipe-style SPMD pipelining over a ``pipe`` mesh axis.
+
+The reference exercises no pipeline parallelism (SURVEY.md §2.3 "PP:
+Absent"); this module is the TPU-native extension alongside TP. GPU
+frameworks implement PP as a *runtime scheduler*: per-stage processes,
+P2P send/recv of activation tensors, hand-written 1F1B interleaving, and a
+separate backward schedule. None of that maps to XLA's single-program model.
+
+The TPU-native formulation is a single SPMD program:
+
+- the transformer's decoder blocks are *stacked* into one pytree with a
+  leading layer dimension and sharded over the ``pipe`` axis — each device
+  holds a contiguous stage of ``L/S`` layers;
+- a ``lax.scan`` over ``M + S - 1`` ticks runs the GPipe schedule: at tick
+  ``t`` stage ``s`` processes microbatch ``t - s``; activations hop to the
+  next stage with one ``lax.ppermute`` per tick (point-to-point on the ICI
+  torus — the XLA analogue of the NCCL send/recv pair);
+- the backward pass is not scheduled by hand: differentiating through the
+  scan + ppermute yields the reverse pipeline automatically (ppermute's
+  transpose is the inverse permutation, so gradients hop backwards through
+  the stages in reverse tick order);
+- embeddings, final LayerNorm, and the LM head run outside the pipeline as
+  ordinary GSPMD-sharded ops, so PP composes freely with the ``data`` axis
+  (and, via the TP rule table, with ``model``).
+
+The pipeline bubble is the usual GPipe ``(S-1)/(M+S-1)`` fraction; raise
+``num_microbatches`` to amortize it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_training_tpu.runtime.mesh import AXIS_DATA, AXIS_PIPE
+from distributed_training_tpu.utils.compat import shard_map
+
+
+def stack_block_params(params: dict, num_layers: int, prefix: str = "block"):
+    """Split model params into (stacked decoder blocks, everything else).
+
+    The per-layer trees ``params['block0'] .. params['block{L-1}']`` are
+    congruent, so they stack leaf-wise into one tree with a leading layer
+    dim — the representation the ``pipe`` axis shards (stage = a contiguous
+    slice of layers).
+    """
+    blocks = [params[f"{prefix}{i}"] for i in range(num_layers)]
+    rest = {k: v for k, v in params.items()
+            if not (k.startswith(prefix) and k[len(prefix):].isdigit())}
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    return stacked, rest
+
+
+def unstack_block_params(stacked, rest: dict, prefix: str = "block") -> dict:
+    """Inverse of :func:`stack_block_params` (checkpoint interop)."""
+    num_layers = jax.tree.leaves(stacked)[0].shape[0]
+    out = dict(rest)
+    for i in range(num_layers):
+        out[f"{prefix}{i}"] = jax.tree.map(lambda x: x[i], stacked)
+    return out
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x: jnp.ndarray,
+    *,
+    axis_name: str = AXIS_PIPE,
+    num_microbatches: int,
+) -> jnp.ndarray:
+    """Run ``x`` through the S-stage pipeline. Call inside ``shard_map``.
+
+    Args:
+      stage_fn: ``(stage_params, x_mb) -> y_mb`` applying this device's
+        layers to one microbatch (shape-preserving).
+      stage_params: this device's stage shard (leading dim = L/S layers).
+      x: [B_local, ...] the full local batch of pipeline inputs.
+      num_microbatches: M; B_local must divide by it.
+
+    Returns [B_local, ...] outputs, replicated over the pipe axis (the last
+    stage's results are psum-broadcast so downstream unsharded ops — final
+    LN, LM head — read them on every rank).
+    """
+    s = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = num_microbatches
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"local batch {b} not divisible by microbatches {m}")
+    mb = x.reshape(m, b // m, *x.shape[1:])
+    perm = [(j, (j + 1) % s) for j in range(s)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        # Stage 0 feeds itself from the microbatch queue; everyone else
+        # consumes what the previous stage sent last tick. Clipped indices
+        # make warmup/drain ticks well-defined (their results are masked).
+        inp = jnp.where(
+            idx == 0,
+            lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, m - 1), 0,
+                                     keepdims=False),
+            recv)
+        out = stage_fn(stage_params, inp)
+        j = jnp.clip(t - (s - 1), 0, m - 1)
+        written = lax.dynamic_update_index_in_dim(outputs, out, j, 0)
+        outputs = jnp.where((idx == s - 1) & (t >= s - 1), written, outputs)
+        return (lax.ppermute(out, axis_name, perm), outputs), None
+
+    init = (jnp.zeros_like(mb[0]), jnp.zeros_like(mb))
+    (_, outputs), _ = lax.scan(tick, init, jnp.arange(m + s - 1))
+    # Only the last stage holds real outputs; broadcast them to every pipe
+    # rank (psum of a one-hot-by-rank value == broadcast from that rank).
+    outputs = lax.psum(
+        jnp.where(idx == s - 1, outputs, jnp.zeros_like(outputs)), axis_name)
+    return outputs.reshape(b, *x.shape[1:])
+
+
+def pp_tree_shardings(tree: Any, mesh: Mesh) -> Any:
+    """Shardings for any tree congruent with PP params (incl. Adam moments):
+    leaves under a ``blocks`` key shard their leading (layer) dim over
+    ``pipe``; everything else is replicated."""
+    from distributed_training_tpu.parallel.tensor_parallel import _path_str
+
+    def leaf(path, x):
+        if "blocks" in _path_str(path) and getattr(x, "ndim", 0) >= 1:
+            return NamedSharding(mesh, P(AXIS_PIPE))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(leaf, tree)
+
+
+class PipelinedLM:
+    """A TransformerLM executed with its decoder blocks pipelined.
+
+    Wraps an existing :class:`~distributed_training_tpu.models.gpt.TransformerLM`
+    (same init, same math — the blocks run through the module's own
+    ``DecoderBlock.apply``), re-homing the per-layer params into the stacked
+    layout and the layer loop into :func:`spmd_pipeline`. ``apply_fn``
+    mirrors the flax signature used by the train steps, so TrainState,
+    ``commit_gradients`` and the LM metrics helpers all work unchanged.
+    """
+
+    def __init__(self, model, mesh: Mesh, *, num_microbatches: int):
+        from distributed_training_tpu.models.gpt import DecoderBlock
+
+        if model.seq_axis is not None:
+            raise ValueError("pipelined LM uses full attention per stage; "
+                             "build the model with seq_axis=None")
+        if model.dropout_rate:
+            raise ValueError("pipelined LM does not thread dropout rngs "
+                             "through the stage scan yet")
+        self.model = model
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self.block = DecoderBlock(
+            num_heads=model.num_heads,
+            mlp_dim=model.mlp_ratio * model.hidden_dim,
+            dtype=model.dtype,
+            seq_axis=None,
+            name=None)
+        shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self.pipe_size = shape.get(AXIS_PIPE, 1)
+        if model.num_layers % max(self.pipe_size, 1):
+            raise ValueError(
+                f"{model.num_layers} layers not divisible into "
+                f"{self.pipe_size} pipeline stages")
+
+    def init_params(self, rng: jax.Array) -> dict:
+        """Init via the wrapped model, then stack the blocks."""
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        variables = self.model.init({"params": rng}, dummy, train=False)
+        stacked, rest = stack_block_params(
+            dict(variables["params"]), self.model.num_layers)
+        return {"blocks": stacked, **rest}
+
+    def param_shardings(self, params: dict) -> dict:
+        """Blocks sharded over ``pipe`` on the layer dim; rest replicated."""
+        return pp_tree_shardings(params, self.mesh)
+
+    def _stage_fn(self, stage_params, x):
+        def layer(h, p):
+            return self.block.apply({"params": p}, h), None
+        h, _ = lax.scan(layer, x, stage_params)
+        return h
+
+    def apply_fn(self, variables, tokens, positions=None, train=False,
+                 rngs=None, mutable=()):
+        """Flax-shaped apply: embeddings/LN/head as plain GSPMD ops, blocks
+        through the shard_map pipeline."""
+        import flax.linen as nn
+
+        del train, rngs, mutable  # no dropout/batch_stats in this path
+        params = variables["params"]
+        m = self.model
+        if tokens.shape[-1] > m.max_len:
+            raise ValueError(
+                f"sequence length {tokens.shape[-1]} exceeds "
+                f"max_len={m.max_len}")
+        if positions is None:
+            positions = jnp.arange(tokens.shape[-1])[None, :]
+
+        x = nn.Embed(m.vocab_size, m.hidden_dim, dtype=m.dtype).apply(
+            {"params": params["tok_embed"]}, tokens)
+        x = x + params["pos_embed"][positions].astype(m.dtype)
+
+        pipeline = shard_map(
+            functools.partial(
+                spmd_pipeline, self._stage_fn,
+                num_microbatches=self.num_microbatches),
+            self.mesh,
+            in_specs=(jax.tree.map(lambda _: P(AXIS_PIPE), params["blocks"]),
+                      P(AXIS_DATA, None, None)),
+            out_specs=P(AXIS_DATA, None, None),
+        )
+        x = pipeline(params["blocks"], x)
+
+        x = nn.LayerNorm(dtype=m.dtype).apply({"params": params["ln_f"]}, x)
+        return nn.Dense(m.vocab_size, dtype=jnp.float32).apply(
+            {"params": params["lm_head"]}, x)

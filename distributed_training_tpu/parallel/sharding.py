@@ -54,7 +54,9 @@ def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
     return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
 
 
-def zero_leaf_sharding(leaf: Any, mesh: Mesh, axes: tuple[str, ...]) -> NamedSharding:
+def zero_leaf_sharding(
+    leaf: Any, mesh: Mesh, axes: tuple[str, ...], *, base: P | None = None,
+) -> NamedSharding:
     """Shard one state tensor over ``axes`` (ZeRO partitioning rule).
 
     Picks the largest tensor dimension divisible by the shard count and
@@ -62,18 +64,45 @@ def zero_leaf_sharding(leaf: Any, mesh: Mesh, axes: tuple[str, ...]) -> NamedSha
     memory is negligible — biases, BN scales). DeepSpeed pads flat buffers
     instead; divisibility-or-replicate keeps every tensor a clean GSPMD
     sharding with zero padding logic.
+
+    ``base`` composes with other parallelisms (TP): only dims the base spec
+    left unsharded are candidates, so e.g. the data axis partitions within
+    each TP rank's slice — the same nesting DeepSpeed's stages apply inside
+    megatron groups.
     """
+    base = base if base is not None else P()
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     n = int(np.prod([shape.get(a, 1) for a in axes]))
     if n <= 1 or not hasattr(leaf, "shape") or leaf.ndim == 0:
-        return replicated(mesh)
-    dims = [(d, i) for i, d in enumerate(leaf.shape) if d % n == 0 and d >= n]
+        return NamedSharding(mesh, base)
+    entries = list(base) + [None] * (leaf.ndim - len(base))
+    dims = [(leaf.shape[i], i) for i, e in enumerate(entries)
+            if e is None and leaf.shape[i] % n == 0 and leaf.shape[i] >= n]
     if not dims:
-        return replicated(mesh)
+        return NamedSharding(mesh, base)
     _, best = max(dims)
-    spec = [None] * leaf.ndim
-    spec[best] = axes if len(axes) > 1 else axes[0]
-    return NamedSharding(mesh, P(*spec))
+    entries[best] = axes if len(axes) > 1 else axes[0]
+    return NamedSharding(mesh, P(*entries))
+
+
+def zero_stage_axes(mesh: Mesh, zero_stage: int) -> tuple[tuple, tuple]:
+    """DeepSpeed stage number → (param_axes, opt_axes) to recruit.
+
+    The fsdp mesh axis, if sized >1, always shards params/opt (that is its
+    meaning); ``zero_stage`` additionally recruits the data axis the way
+    DeepSpeed's stages recruit DP ranks.
+    """
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    fsdp_on = shape.get(AXIS_FSDP, 1) > 1
+    if zero_stage >= 1:
+        opt_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
+    else:
+        opt_axes = (AXIS_FSDP,) if fsdp_on else ()
+    if zero_stage >= 3:
+        param_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
+    else:
+        param_axes = (AXIS_FSDP,) if fsdp_on else ()
+    return param_axes, opt_axes
 
 
 def _tree_shardings(tree: Any, mesh: Mesh, axes: tuple[str, ...], shard: bool):
@@ -85,23 +114,10 @@ def _tree_shardings(tree: Any, mesh: Mesh, axes: tuple[str, ...], shard: bool):
 def state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0):
     """Shardings for a full TrainState pytree per ZeRO stage.
 
-    Returns a pytree of NamedSharding congruent with ``state``. The fsdp
-    mesh axis, if sized >1, always shards params/opt (that is its meaning);
-    ``zero_stage`` additionally recruits the data axis the way DeepSpeed's
-    stages recruit DP ranks.
+    Returns a pytree of NamedSharding congruent with ``state``; axis
+    recruitment per stage lives in :func:`zero_stage_axes`.
     """
-    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    fsdp_on = shape.get(AXIS_FSDP, 1) > 1
-    opt_axes: tuple[str, ...]
-    param_axes: tuple[str, ...]
-    if zero_stage >= 1:
-        opt_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
-    else:
-        opt_axes = (AXIS_FSDP,) if fsdp_on else ()
-    if zero_stage >= 3:
-        param_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
-    else:
-        param_axes = (AXIS_FSDP,) if fsdp_on else ()
+    param_axes, opt_axes = zero_stage_axes(mesh, zero_stage)
 
     params_sh = _tree_shardings(state.params, mesh, param_axes, bool(param_axes))
     opt_sh = jax.tree.map(

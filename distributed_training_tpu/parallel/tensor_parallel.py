@@ -41,14 +41,9 @@ import re
 from typing import Any
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_training_tpu.runtime.mesh import (
-    AXIS_DATA,
-    AXIS_FSDP,
-    AXIS_MODEL,
-)
+from distributed_training_tpu.runtime.mesh import AXIS_MODEL
 
 # (path regex, spec) — first match wins; matched against "/".join(path keys).
 # Specs use AXIS_MODEL; dims listed explicitly per the param layouts above.
@@ -89,26 +84,6 @@ def tp_spec_for_path(path_str: str) -> P:
     return P()
 
 
-def _recruit_axes(spec: P, leaf: Any, mesh_shape: dict, axes: tuple[str, ...]) -> P:
-    """Additionally shard ``leaf`` over ``axes`` on a dim the TP spec left free.
-
-    This composes TP with ZeRO: the data/fsdp axes partition whatever
-    dimension the ``model`` axis did not claim (DeepSpeed's stages likewise
-    partition *within* each TP rank's slice of the weights).
-    """
-    n = int(np.prod([mesh_shape.get(a, 1) for a in axes]))
-    if n <= 1 or not hasattr(leaf, "shape") or leaf.ndim == 0:
-        return spec
-    entries = list(spec) + [None] * (leaf.ndim - len(spec))
-    free = [(leaf.shape[i], i) for i, e in enumerate(entries)
-            if e is None and leaf.shape[i] % n == 0 and leaf.shape[i] >= n]
-    if not free:
-        return spec
-    _, best = max(free)
-    entries[best] = axes if len(axes) > 1 else axes[0]
-    return P(*entries)
-
-
 def tp_tree_shardings(
     tree: Any,
     mesh: Mesh,
@@ -119,15 +94,19 @@ def tp_tree_shardings(
 
     Works on params *and* on optimizer state: optax moment trees embed the
     param tree, so leaf paths end with the param path and the same rules hit.
-    ``extra_axes`` recruits data/fsdp on a TP-free dim (ZeRO composition).
+    ``extra_axes`` recruits data/fsdp on a TP-free dim via the shared ZeRO
+    placement rule (``sharding.zero_leaf_sharding`` with the TP spec as
+    base) — DeepSpeed's stages likewise partition within megatron slices.
     """
+    from distributed_training_tpu.parallel.sharding import zero_leaf_sharding
+
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     tp_on = shape.get(AXIS_MODEL, 1) > 1
 
     def leaf_sharding(path, leaf):
         spec = tp_spec_for_path(_path_str(path)) if tp_on else P()
         if extra_axes:
-            spec = _recruit_axes(spec, leaf, shape, extra_axes)
+            return zero_leaf_sharding(leaf, mesh, extra_axes, base=spec)
         return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
@@ -139,18 +118,12 @@ def tp_state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0):
     Mirrors :func:`distributed_training_tpu.parallel.sharding.state_shardings`
     but lays the ``model`` axis through the transformer weights first, then
     recruits data/fsdp for optimizer (stage≥1) / parameter (stage≥3) sharding
-    on the remaining dims.
+    on the remaining dims (stage→axes mapping shared via
+    ``sharding.zero_stage_axes``).
     """
-    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    fsdp_on = shape.get(AXIS_FSDP, 1) > 1
-    if zero_stage >= 1:
-        opt_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
-    else:
-        opt_axes = (AXIS_FSDP,) if fsdp_on else ()
-    if zero_stage >= 3:
-        param_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
-    else:
-        param_axes = (AXIS_FSDP,) if fsdp_on else ()
+    from distributed_training_tpu.parallel.sharding import zero_stage_axes
+
+    param_axes, opt_axes = zero_stage_axes(mesh, zero_stage)
 
     params_sh = tp_tree_shardings(state.params, mesh, extra_axes=param_axes)
     opt_sh = tp_tree_shardings(state.opt_state, mesh, extra_axes=opt_axes)

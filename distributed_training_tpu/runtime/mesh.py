@@ -11,6 +11,7 @@ logical ``jax.sharding.Mesh`` whose axes map onto the ICI torus (intra-slice)
 and DCN (inter-slice). The canonical axes used throughout this framework:
 
 - ``data``     — batch (DP) axis; gradient all-reduce rides here.
+- ``pipe``     — pipeline-parallel axis (GPipe stage hops via ppermute).
 - ``fsdp``     — parameter/optimizer sharding axis (ZeRO-3 / FSDP).
 - ``model``    — tensor-parallel axis (megatron-style layer splits).
 - ``expert``   — expert-parallel axis for MoE all-to-all dispatch.
@@ -34,11 +35,16 @@ AXIS_FSDP = "fsdp"
 AXIS_MODEL = "model"
 AXIS_EXPERT = "expert"
 AXIS_SEQUENCE = "sequence"
+AXIS_PIPE = "pipe"
 
 # Order matters: outer-to-inner, so `data` varies slowest. On multi-slice
 # topologies the slowest axis lands on DCN and the fast axes stay on ICI,
 # which is where the per-step collectives (psum over `model`/`fsdp`) belong.
-CANONICAL_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_EXPERT, AXIS_SEQUENCE)
+# `pipe` sits next to `data`: pipeline stage hops are point-to-point and
+# infrequent (once per microbatch tick), so they tolerate DCN, while the
+# chatty `model`/`sequence` collectives keep the innermost ICI dims.
+CANONICAL_AXES = (
+    AXIS_DATA, AXIS_PIPE, AXIS_FSDP, AXIS_MODEL, AXIS_EXPERT, AXIS_SEQUENCE)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,6 +56,7 @@ class MeshConfig:
     model: int = 1
     expert: int = 1
     sequence: int = 1
+    pipe: int = 1
 
     def sizes(self) -> dict[str, int]:
         return {
@@ -58,6 +65,7 @@ class MeshConfig:
             AXIS_MODEL: self.model,
             AXIS_EXPERT: self.expert,
             AXIS_SEQUENCE: self.sequence,
+            AXIS_PIPE: self.pipe,
         }
 
 

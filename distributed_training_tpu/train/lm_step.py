@@ -142,6 +142,51 @@ def make_lm_train_step(
     return step
 
 
+def _make_gspmd_lm_step(
+    mesh: Mesh,
+    state_shardings_fn: Callable,
+    *,
+    max_len: int | None = None,
+    donate: bool = True,
+) -> Callable:
+    """Shared GSPMD LM step builder (the TP and PP steps differ only in how
+    the train state is placed): batch over ``data``, lazy jit once a
+    concrete state's pytree is known, placements from ``state_shardings_fn``.
+    """
+    batch_sh = {"tokens": NamedSharding(mesh, P(AXIS_DATA, None)),
+                "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
+
+    def body(state: TrainState, batch, rng):
+        grads, loss, logits = _lm_loss_and_grads(
+            state, batch["tokens"], batch["targets"], rng)
+        grads = state.loss_scale.unscale_grads(grads)
+        new_state, finite = commit_gradients(state, grads)
+        return new_state, _lm_metrics(
+            new_state, loss, logits, batch["targets"], finite)
+
+    jitted = None  # built lazily: shardings need a concrete state's pytree
+
+    def step(state: TrainState, batch, rng):
+        nonlocal jitted
+        if max_len is not None and batch["tokens"].shape[1] > max_len:
+            raise ValueError(
+                f"sequence length {batch['tokens'].shape[1]} exceeds "
+                f"max_len={max_len}")
+        if jitted is None:
+            repl = NamedSharding(mesh, P())
+            jitted = jax.jit(
+                body,
+                in_shardings=(state_shardings_fn(state), batch_sh, repl),
+                out_shardings=(state_shardings_fn(state), repl),
+                donate_argnums=(0,) if donate else (),
+            )
+        return jitted(state, batch, rng)
+
+    step.batch_shardings = batch_sh
+    step.state_shardings = state_shardings_fn
+    return step
+
+
 def make_tp_lm_train_step(
     mesh: Mesh, *, model, zero_stage: int = 0, donate: bool = True,
 ) -> Callable:
@@ -174,40 +219,48 @@ def make_tp_lm_train_step(
         raise ValueError(
             "TP step runs under plain jit; build the model with "
             "seq_axis=None (ring attention needs the shard_map step)")
-    max_len = model.max_len
-    batch_sh = {"tokens": NamedSharding(mesh, P(AXIS_DATA, None)),
-                "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
+    return _make_gspmd_lm_step(
+        mesh,
+        lambda state: tp_state_shardings(state, mesh, zero_stage=zero_stage),
+        max_len=model.max_len, donate=donate)
 
-    def body(state: TrainState, batch, rng):
-        grads, loss, logits = _lm_loss_and_grads(
-            state, batch["tokens"], batch["targets"], rng)
-        grads = state.loss_scale.unscale_grads(grads)
-        new_state, finite = commit_gradients(state, grads)
-        return new_state, _lm_metrics(
-            new_state, loss, logits, batch["targets"], finite)
 
-    jitted = None  # built lazily: shardings need a concrete state's pytree
+def make_pp_lm_train_step(
+    mesh: Mesh, *, model, num_microbatches: int, donate: bool = True,
+) -> Callable:
+    """Pipeline-parallel LM train step (GPipe schedule over ``pipe``).
 
-    def step(state: TrainState, batch, rng):
-        nonlocal jitted
-        t_global = batch["tokens"].shape[1]
-        if t_global > max_len:
-            raise ValueError(
-                f"sequence length {t_global} exceeds max_len={max_len}")
-        if jitted is None:
-            state_sh = tp_state_shardings(state, mesh, zero_stage=zero_stage)
-            repl = NamedSharding(mesh, P())
-            jitted = jax.jit(
-                body,
-                in_shardings=(state_sh, batch_sh, repl),
-                out_shardings=(state_sh, repl),
-                donate_argnums=(0,) if donate else (),
-            )
-        return jitted(state, batch, rng)
+    Decoder blocks are stacked and sharded over the ``pipe`` mesh axis; the
+    forward runs the ``lax.scan`` + ``lax.ppermute`` schedule from
+    ``parallel/pipeline.py`` and the backward pipeline falls out of
+    autodiff (ppermute's transpose is the reverse hop). Embeddings and the
+    LM head are plain GSPMD ops sharded over ``data``, so DP composes.
 
-    step.state_shardings = lambda state: tp_state_shardings(
-        state, mesh, zero_stage=zero_stage)
-    step.batch_shardings = batch_sh
+    Returns ``step(state, batch, rng) -> (state, metrics)`` with a
+    ``.pipelined`` attribute (the :class:`PipelinedLM`) and
+    ``.batch_shardings`` / ``.state_shardings(state)`` like the TP step.
+    """
+    from distributed_training_tpu.parallel.pipeline import (
+        PipelinedLM,
+        pp_tree_shardings,
+    )
+
+    plm = PipelinedLM(model, mesh, num_microbatches=num_microbatches)
+
+    def state_shardings(state: TrainState):
+        repl = NamedSharding(mesh, P())
+        return state.replace(
+            step=repl,
+            params=pp_tree_shardings(state.params, mesh),
+            batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+            opt_state=pp_tree_shardings(state.opt_state, mesh),
+            loss_scale=jax.tree.map(lambda _: repl, state.loss_scale),
+        )
+
+    # max_len is enforced inside PipelinedLM.apply_fn (statically), so the
+    # shared builder doesn't need to re-check it.
+    step = _make_gspmd_lm_step(mesh, state_shardings, donate=donate)
+    step.pipelined = plm
     return step
 
 

@@ -1,0 +1,189 @@
+"""Pipeline-parallel (GPipe over ``pipe`` mesh axis) correctness.
+
+PP is absent from the reference (SURVEY.md §2.3 "PP: Absent"); this
+framework provides it as an SPMD scan + ppermute schedule
+(``parallel/pipeline.py``). The invariants: the pipelined forward is the
+plain TransformerLM forward; the backward pipeline that autodiff derives
+from the forward schedule produces the single-device gradients; training
+through the pipeline learns.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_tpu.config import PrecisionConfig
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.parallel.pipeline import (
+    PipelinedLM,
+    stack_block_params,
+    unstack_block_params,
+)
+from distributed_training_tpu.runtime.mesh import (
+    AXIS_PIPE,
+    MeshConfig,
+    create_mesh,
+)
+from distributed_training_tpu.train.lm_step import (
+    make_lm_batch,
+    make_pp_lm_train_step,
+)
+from distributed_training_tpu.train.precision import LossScaleState
+from distributed_training_tpu.train.train_state import TrainState
+
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    return create_mesh(MeshConfig(data=2, pipe=4))
+
+
+def _model(num_layers=4):
+    return get_model(
+        "transformer_lm", num_classes=VOCAB, seq_axis=None,
+        num_layers=num_layers, num_heads=2, hidden_dim=32, max_len=128)
+
+
+def _tokens(b=4, t=17, seed=0):
+    return np.random.RandomState(seed).randint(0, VOCAB, (b, t)).astype(np.int32)
+
+
+def test_stack_unstack_roundtrip():
+    model = _model()
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False)
+    params = dict(variables["params"])
+    stacked, rest = stack_block_params(params, model.num_layers)
+    qkv = stacked["attn"]["qkv"]["kernel"]
+    assert qkv.shape[0] == model.num_layers
+    assert "block0" not in rest and "tok_embed" in rest
+    restored = unstack_block_params(stacked, rest)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        restored, params)
+
+
+def test_pipelined_forward_matches_plain(pp_mesh):
+    """PipelinedLM.apply_fn == TransformerLM.apply on identical params."""
+    model = _model()
+    rng = jax.random.PRNGKey(0)
+    variables = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    plm = PipelinedLM(model, pp_mesh, num_microbatches=2)
+    pp_params = plm.init_params(rng)
+
+    tokens = jnp.asarray(_tokens())
+    ref = model.apply(variables, tokens, train=False)
+    got = jax.jit(lambda p, t: plm.apply_fn({"params": p}, t))(pp_params, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-4)
+
+
+def _pp_state(plm, rng, opt="sgd"):
+    tx = (optax.sgd(0.1) if opt == "sgd" else
+          optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3)))
+    return TrainState.create(
+        apply_fn=plm.apply_fn, params=plm.init_params(rng), tx=tx,
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+
+
+def test_pp_step_matches_single_device(pp_mesh):
+    """One (data=2 × pipe=4) GPipe step == one single-device step — the
+    autodiff-derived backward pipeline produces the true gradients."""
+    model = _model()
+    rng0 = jax.random.PRNGKey(0)
+    batch = make_lm_batch(_tokens())
+    step_rng = jax.random.PRNGKey(7)
+
+    # Oracle on the unstacked model.
+    variables = model.init({"params": rng0}, jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+
+    def oracle_step(params, batch):
+        def loss_fn(p):
+            logits = model.apply({"params": p},
+                                 jnp.asarray(batch["tokens"]), train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, jnp.asarray(batch["targets"])).mean()
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        return jax.tree.map(lambda p, g: p - 0.1 * g, params, grads), loss
+
+    oracle_params, oracle_loss = jax.jit(oracle_step)(
+        dict(variables["params"]), batch)
+    oracle_stacked, oracle_rest = stack_block_params(
+        oracle_params, model.num_layers)
+
+    # Pipelined step from the same init.
+    step = make_pp_lm_train_step(pp_mesh, model=model, num_microbatches=2,
+                                 donate=False)
+    state = _pp_state(step.pipelined, rng0, opt="sgd")
+    gbatch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in batch.items()}, step.batch_shardings)
+    new_state, metrics = step(state, gbatch, step_rng)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(oracle_loss), atol=1e-5, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+        new_state.params["blocks"], oracle_stacked)
+    for key in ("tok_embed", "pos_embed", "ln_f", "lm_head"):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+            new_state.params[key], oracle_rest[key])
+
+
+def test_pp_blocks_actually_sharded(pp_mesh):
+    """Stacked blocks land with their layer dim split across pipe ranks."""
+    model = _model()
+    step = make_pp_lm_train_step(pp_mesh, model=model, num_microbatches=2,
+                                 donate=False)
+    state = _pp_state(step.pipelined, jax.random.PRNGKey(0))
+    batch = make_lm_batch(_tokens())
+    gbatch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in batch.items()}, step.batch_shardings)
+    new_state, _ = step(state, gbatch, jax.random.PRNGKey(0))
+    qkv = new_state.params["blocks"]["attn"]["qkv"]["kernel"]
+    assert qkv.sharding.spec == P(AXIS_PIPE)
+    assert qkv.addressable_shards[0].data.shape[0] == 1  # 4 layers / 4 stages
+
+
+def test_pp_loss_decreases(pp_mesh):
+    """Smoke: 30 GPipe steps on a learnable pattern drop the loss."""
+    start = np.random.RandomState(0).randint(0, VOCAB, (8, 1))
+    tokens = (start + np.arange(33)) % VOCAB
+    batch = make_lm_batch(tokens.astype(np.int32))
+
+    model = _model()
+    step = make_pp_lm_train_step(pp_mesh, model=model, num_microbatches=4,
+                                 donate=False)
+    state = _pp_state(step.pipelined, jax.random.PRNGKey(0), opt="adam")
+    gbatch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in batch.items()}, step.batch_shardings)
+    rng = jax.random.PRNGKey(0)
+    first = None
+    for _ in range(30):
+        rng, sub = jax.random.split(rng)
+        state, metrics = step(state, gbatch, sub)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert last < first * 0.5, (first, last)
+
+
+def test_pp_rejects_bad_config(pp_mesh):
+    model = get_model("transformer_lm", num_classes=VOCAB, seq_axis=None,
+                      num_layers=3, num_heads=2, hidden_dim=32, max_len=128)
+    with pytest.raises(ValueError, match="not divisible"):
+        PipelinedLM(model, pp_mesh, num_microbatches=2)
+    seq_model = get_model("transformer_lm", num_classes=VOCAB,
+                          seq_axis="sequence", num_layers=4, num_heads=2,
+                          hidden_dim=32, max_len=128)
+    with pytest.raises(ValueError, match="seq_axis"):
+        PipelinedLM(seq_model, pp_mesh, num_microbatches=2)
