@@ -72,6 +72,25 @@ class DecoderBlock(nn.Module):
         return x + y
 
 
+def make_tok_embed(m: "TransformerLM", name: str | None = None) -> nn.Embed:
+    """Token-embedding module; single source of its config for both the
+    plain model and the pipelined executor (``parallel/pipeline.py``)."""
+    return nn.Embed(m.vocab_size, m.hidden_dim, dtype=m.dtype, name=name)
+
+
+def make_final_norm(m: "TransformerLM", name: str | None = None) -> nn.LayerNorm:
+    return nn.LayerNorm(dtype=m.dtype, name=name)
+
+
+def make_lm_head(m: "TransformerLM", name: str | None = None) -> nn.Dense:
+    # Untied head; fp32 logits for a stable softmax under bf16 compute.
+    return nn.Dense(m.vocab_size, dtype=jnp.float32, name=name)
+
+
+def add_pos_embed(m: "TransformerLM", pos_tab, x, positions):
+    return x + pos_tab[positions].astype(m.dtype)
+
+
 class TransformerLM(nn.Module):
     """GPT-style causal LM.
 
@@ -104,13 +123,11 @@ class TransformerLM(nn.Module):
                     f"sequence length {tokens.shape[-1]} exceeds "
                     f"max_len={self.max_len}")
             positions = jnp.arange(tokens.shape[-1])[None, :]
-        x = nn.Embed(
-            self.vocab_size, self.hidden_dim,
-            dtype=self.dtype, name="tok_embed")(tokens)
+        x = make_tok_embed(self, name="tok_embed")(tokens)
         pos_tab = self.param(
             "pos_embed", nn.initializers.normal(0.02),
             (self.max_len, self.hidden_dim))
-        x = x + pos_tab[positions].astype(self.dtype)
+        x = add_pos_embed(self, pos_tab, x, positions)
         for i in range(self.num_layers):
             x = DecoderBlock(
                 num_heads=self.num_heads,
@@ -119,11 +136,8 @@ class TransformerLM(nn.Module):
                 seq_axis=self.seq_axis,
                 dropout_rate=self.dropout_rate,
                 name=f"block{i}")(x, train=train)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
-        # Untied head; fp32 logits for a stable softmax under bf16 compute.
-        logits = nn.Dense(
-            self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
-        return logits
+        x = make_final_norm(self, name="ln_f")(x)
+        return make_lm_head(self, name="lm_head")(x)
 
 
 def make_transformer_lm(

@@ -123,11 +123,13 @@ def spmd_pipeline(
 def pp_tree_shardings(tree: Any, mesh: Mesh) -> Any:
     """Shardings for any tree congruent with PP params (incl. Adam moments):
     leaves under a ``blocks`` key shard their leading (layer) dim over
-    ``pipe``; everything else is replicated."""
-    from distributed_training_tpu.parallel.tensor_parallel import _path_str
+    ``pipe``; everything else is replicated. The match is on an exact path
+    component (not a substring), so e.g. a ``res_blocks`` module is not
+    accidentally pipe-sharded."""
+    from distributed_training_tpu.utils.tree import path_keys
 
     def leaf(path, x):
-        if "blocks" in _path_str(path) and getattr(x, "ndim", 0) >= 1:
+        if "blocks" in path_keys(path) and getattr(x, "ndim", 0) >= 1:
             return NamedSharding(mesh, P(AXIS_PIPE))
         return NamedSharding(mesh, P())
 
@@ -190,9 +192,15 @@ class PipelinedLM:
 
     def apply_fn(self, variables, tokens, positions=None, train=False,
                  rngs=None, mutable=()):
-        """Flax-shaped apply: embeddings/LN/head as plain GSPMD ops, blocks
+        """Flax-shaped apply: embeddings/LN/head as plain GSPMD ops (module
+        configs single-sourced from ``models/gpt.py`` factories), blocks
         through the shard_map pipeline."""
-        import flax.linen as nn
+        from distributed_training_tpu.models.gpt import (
+            add_pos_embed,
+            make_final_norm,
+            make_lm_head,
+            make_tok_embed,
+        )
 
         del train, rngs, mutable  # no dropout/batch_stats in this path
         params = variables["params"]
@@ -204,9 +212,8 @@ class PipelinedLM:
         if positions is None:
             positions = jnp.arange(tokens.shape[-1])[None, :]
 
-        x = nn.Embed(m.vocab_size, m.hidden_dim, dtype=m.dtype).apply(
-            {"params": params["tok_embed"]}, tokens)
-        x = x + params["pos_embed"][positions].astype(m.dtype)
+        x = make_tok_embed(m).apply({"params": params["tok_embed"]}, tokens)
+        x = add_pos_embed(m, params["pos_embed"], x, positions)
 
         pipeline = shard_map(
             functools.partial(
@@ -219,6 +226,5 @@ class PipelinedLM:
         )
         x = pipeline(params["blocks"], x)
 
-        x = nn.LayerNorm(dtype=m.dtype).apply({"params": params["ln_f"]}, x)
-        return nn.Dense(m.vocab_size, dtype=jnp.float32).apply(
-            {"params": params["lm_head"]}, x)
+        x = make_final_norm(m).apply({"params": params["ln_f"]}, x)
+        return make_lm_head(m).apply({"params": params["lm_head"]}, x)

@@ -44,6 +44,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_training_tpu.runtime.mesh import AXIS_MODEL
+from distributed_training_tpu.utils.tree import path_str
 
 # (path regex, spec) — first match wins; matched against "/".join(path keys).
 # Specs use AXIS_MODEL; dims listed explicitly per the param layouts above.
@@ -60,20 +61,6 @@ LM_TP_RULES: tuple[tuple[str, P], ...] = (
     (r"lm_head/bias$", P(AXIS_MODEL)),
     (r"tok_embed/embedding$", P(AXIS_MODEL, None)),
 )
-
-
-def _path_str(path) -> str:
-    parts = []
-    for p in path:
-        if hasattr(p, "key"):
-            parts.append(str(p.key))
-        elif hasattr(p, "idx"):
-            parts.append(str(p.idx))
-        elif hasattr(p, "name"):
-            parts.append(str(p.name))
-        else:
-            parts.append(str(p))
-    return "/".join(parts)
 
 
 def tp_spec_for_path(path_str: str) -> P:
@@ -104,7 +91,7 @@ def tp_tree_shardings(
     tp_on = shape.get(AXIS_MODEL, 1) > 1
 
     def leaf_sharding(path, leaf):
-        spec = tp_spec_for_path(_path_str(path)) if tp_on else P()
+        spec = tp_spec_for_path(path_str(path)) if tp_on else P()
         if extra_axes:
             return zero_leaf_sharding(leaf, mesh, extra_axes, base=spec)
         return NamedSharding(mesh, spec)

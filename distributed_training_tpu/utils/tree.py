@@ -1,0 +1,23 @@
+"""Pytree path utilities shared by the sharding-rule modules."""
+
+from __future__ import annotations
+
+
+def path_keys(path) -> list[str]:
+    """Key-path entries (DictKey/SequenceKey/attr) as a list of strings."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return parts
+
+
+def path_str(path) -> str:
+    """Key path joined as ``a/b/c`` — the form sharding rule tables match."""
+    return "/".join(path_keys(path))
