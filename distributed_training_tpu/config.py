@@ -176,6 +176,31 @@ class MeshSpec:
     model: int = 1
     expert: int = 1
     sequence: int = 1
+    pipe: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class LMConfig:
+    """Transformer-LM model + token-data surface (the long-context workload
+    the reference lacks; see ``models/gpt.py`` / ``train/lm_trainer.py``).
+
+    The parallel strategy is NOT chosen here — it follows from the mesh:
+    ``sequence>1`` → ring attention, ``model>1`` → megatron TP, ``pipe>1`` →
+    GPipe. ``num_microbatches`` only applies to the pipe path.
+    """
+
+    seq_len: int = 128
+    vocab_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    hidden_dim: int = 256
+    mlp_ratio: int = 4
+    max_len: int = 2048
+    num_microbatches: int = 1
+    attn_impl: str = "exact"  # exact | flash (Pallas kernel; not w/ sequence)
+    corpus_path: str | None = None  # byte-level text file; None → synthetic
+    train_sequences: int = 2048     # synthetic dataset size
+    eval_sequences: int = 256
 
 
 @dataclasses.dataclass(frozen=True)
@@ -195,6 +220,7 @@ class TrainConfig:
     moe: MoEConfig = dataclasses.field(default_factory=MoEConfig)
     checkpoint: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    lm: LMConfig = dataclasses.field(default_factory=LMConfig)
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     # Profiling: ds_config "wall_clock_breakdown" (deepspeed_train.py:209).
     wall_clock_breakdown: bool = False

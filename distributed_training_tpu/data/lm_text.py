@@ -1,0 +1,115 @@
+"""Token datasets + sharded loader for the LM training path.
+
+The reference has no text workload at all (SURVEY.md §5 "Long-context":
+ResNet-only); this module supplies the data layer for the framework's
+long-context LM extension. Two sources:
+
+- :func:`synthetic_tokens` — arithmetic-progression sequences (next token =
+  (prev + 1) mod vocab): cheap, learnable, deterministic — the LM analogue
+  of the synthetic CIFAR fallback.
+- :func:`byte_corpus` — byte-level tokenization of a local text file
+  (vocab 256, no tokenizer dependency; zero-egress friendly).
+
+:class:`TokenLoader` mirrors ``ShardedDataLoader``'s semantics
+(``data/pipeline.py``): a global ``(seed, epoch)``-seeded permutation of
+sequence windows (``sampler.set_epoch`` parity,
+``resnet/pytorch_ddp/ddp_train.py:102``), per-process contiguous slices of
+each global batch, partial batches dropped. Batches are
+``{'tokens': i32[B, T+1]}`` — one
+extra position so ``make_lm_batch`` can do the next-token shift host-side
+before sequence sharding (``train/lm_step.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+import numpy as np
+
+
+def synthetic_tokens(
+    n: int, seq_len: int, vocab_size: int = 256, seed: int = 0,
+) -> np.ndarray:
+    """[n, seq_len+1] int32 progressions: row i = (start_i + arange) % V."""
+    rng = np.random.RandomState(seed)
+    starts = rng.randint(0, vocab_size, size=(n, 1))
+    return ((starts + np.arange(seq_len + 1)) % vocab_size).astype(np.int32)
+
+
+def byte_corpus(
+    path: str, n: int, seq_len: int, seed: int = 0,
+    span: tuple[float, float] = (0.0, 1.0),
+) -> np.ndarray:
+    """[n, seq_len+1] int32 byte windows sampled from a slice of a file.
+
+    ``span`` selects a fractional byte range — train/eval draw from
+    *disjoint* spans (e.g. (0, 0.9) vs (0.9, 1.0)) so held-out perplexity
+    measures generalization, not window overlap with the training set.
+    """
+    with open(path, "rb") as f:
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    lo, hi = int(data.size * span[0]), int(data.size * span[1])
+    data = data[lo:hi]
+    if data.size < seq_len + 2:
+        raise ValueError(
+            f"corpus {path!r} span {span} has {data.size} bytes; "
+            f"need > {seq_len + 1}")
+    rng = np.random.RandomState(seed)
+    starts = rng.randint(0, data.size - seq_len - 1, size=n)
+    idx = starts[:, None] + np.arange(seq_len + 1)[None, :]
+    return data[idx].astype(np.int32)
+
+
+class TokenLoader:
+    """Deterministic sharded loader over a [N, T+1] token array."""
+
+    def __init__(
+        self,
+        tokens: np.ndarray,
+        *,
+        global_batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        process_index: int | None = None,
+        process_count: int | None = None,
+        max_steps: int | None = None,
+    ):
+        # Always drop_last: a partial global batch cannot be sliced evenly
+        # across processes/devices, and eval perplexity over full batches
+        # is the metric contract. (The image pipeline's masked ragged-eval
+        # machinery can be ported here if token counts must be exact.)
+        self.tokens = tokens
+        self.global_batch_size = global_batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index)
+        self.process_count = (
+            jax.process_count() if process_count is None else process_count)
+        if global_batch_size % self.process_count:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{self.process_count} processes")
+        self.max_steps = max_steps
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        steps = self.tokens.shape[0] // self.global_batch_size
+        return min(steps, self.max_steps) if self.max_steps else steps
+
+    def __iter__(self) -> Iterator[dict]:
+        n = self.tokens.shape[0]
+        order = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState((self.seed, self.epoch)).shuffle(order)
+        per_proc = self.global_batch_size // self.process_count
+        lo = self.process_index * per_proc
+        for step in range(len(self)):
+            sel = order[step * self.global_batch_size:
+                        (step + 1) * self.global_batch_size]
+            shard = sel[lo:lo + per_proc]
+            yield {"tokens": self.tokens[shard]}

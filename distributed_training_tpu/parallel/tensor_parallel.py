@@ -63,10 +63,11 @@ LM_TP_RULES: tuple[tuple[str, P], ...] = (
 )
 
 
-def tp_spec_for_path(path_str: str) -> P:
-    """TP PartitionSpec for one leaf path (replicated if no rule matches)."""
+def tp_spec_for_path(path: str) -> P:
+    """TP PartitionSpec for one ``a/b/c`` leaf path (replicated if no rule
+    matches)."""
     for pat, spec in LM_TP_RULES:
-        if re.search(pat, path_str):
+        if re.search(pat, path):
             return spec
     return P()
 

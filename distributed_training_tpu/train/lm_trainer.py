@@ -1,0 +1,304 @@
+"""LMTrainer: end-to-end transformer-LM training over any mesh strategy.
+
+The LM counterpart of :class:`~distributed_training_tpu.train.trainer.Trainer`
+(the reference has no token workload at all — SURVEY.md §5 "Long-context";
+this engine drives the framework's long-context extension as a first-class
+product surface, not just library steps).
+
+The parallel strategy follows from the mesh, not from a flag:
+
+- ``sequence > 1``  → ring-attention sequence parallelism
+  (``make_lm_train_step``: shard_map, K/V blocks hop the ICI ring);
+- ``pipe > 1``      → GPipe pipeline parallelism
+  (``make_pp_lm_train_step``: stacked blocks sharded over ``pipe``);
+- otherwise         → the GSPMD step (``make_tp_lm_train_step``), which is
+  megatron TP when ``model > 1`` and plain DP when ``model == 1``, with
+  ZeRO stages composing on the free dims.
+
+Mutually exclusive combinations are rejected loudly (``sequence`` with
+``model``/``pipe`` would need 2-level shard_map nesting that is not built).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_training_tpu import checkpoint as ckpt_lib
+from distributed_training_tpu.config import TrainConfig
+from distributed_training_tpu.data.lm_text import (
+    TokenLoader,
+    byte_corpus,
+    synthetic_tokens,
+)
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.parallel.sharding import place_state
+from distributed_training_tpu.runtime.coordinator import Coordinator
+from distributed_training_tpu.runtime.mesh import (
+    AXIS_MODEL,
+    AXIS_PIPE,
+    AXIS_SEQUENCE,
+    MeshConfig,
+    create_mesh,
+    data_axis_size,
+)
+from distributed_training_tpu.train.lm_step import (
+    lm_batch_shardings,
+    make_lm_batch,
+    make_lm_train_step,
+    make_pp_lm_train_step,
+    make_tp_lm_train_step,
+)
+from distributed_training_tpu.train.optim import make_optimizer
+from distributed_training_tpu.train.precision import LossScaleState, Policy
+from distributed_training_tpu.train.train_state import (
+    TrainState,
+    init_train_state,
+    param_count,
+)
+from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
+from distributed_training_tpu.utils.profiling import WallClock, trace
+
+
+class LMTrainer:
+    """Epoch-loop engine for :class:`TransformerLM` on a device mesh."""
+
+    def __init__(self, cfg: TrainConfig, mesh=None):
+        self.cfg = cfg
+        self.coord = Coordinator()
+        self.mesh = mesh if mesh is not None else create_mesh(
+            MeshConfig(**dataclasses.asdict(cfg.mesh)))
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        seq = shape.get(AXIS_SEQUENCE, 1)
+        pipe = shape.get(AXIS_PIPE, 1)
+        model_par = shape.get(AXIS_MODEL, 1)
+        if seq > 1 and (pipe > 1 or model_par > 1):
+            raise NotImplementedError(
+                "sequence parallelism does not compose with model/pipe axes "
+                "in this engine; use one of (sequence) | (model [+zero]) | "
+                "(pipe)")
+        if pipe > 1 and model_par > 1:
+            raise NotImplementedError("model and pipe axes do not compose yet")
+        self.strategy = ("sequence" if seq > 1 else
+                         "pipeline" if pipe > 1 else
+                         "tensor/dp")
+        if self.strategy != "tensor/dp" and cfg.zero.stage != 0:
+            # Refuse rather than silently train unsharded while the banner
+            # advertises a ZeRO stage.
+            raise NotImplementedError(
+                f"zero stage {cfg.zero.stage} composes with the tensor/dp "
+                f"strategy only; the {self.strategy} step keeps non-block "
+                "state replicated")
+        if self.strategy == "sequence" and cfg.lm.attn_impl == "flash":
+            raise ValueError(
+                "attn_impl='flash' is the unsharded kernel; the sequence "
+                "strategy rings K/V blocks itself (use exact)")
+
+        lm = cfg.lm
+        if seq > 1 and lm.seq_len % seq:
+            raise ValueError(
+                f"sequence-parallel size {seq} must divide seq_len "
+                f"(= {lm.seq_len})")
+        if pipe > 1:
+            if lm.num_layers % pipe:
+                raise ValueError(
+                    f"pipeline size {pipe} must divide num_layers "
+                    f"(= {lm.num_layers})")
+            if cfg.data.batch_size % lm.num_microbatches:
+                raise ValueError(
+                    f"num_microbatches {lm.num_microbatches} must divide "
+                    f"the per-shard batch_size (= {cfg.data.batch_size})")
+        if model_par > 1:
+            # The megatron rule table shards heads / mlp columns / vocab over
+            # the model axis; device_put fails opaquely on non-divisible
+            # dims, so check here where the message can name the knob.
+            for what, n in (("num_heads", lm.num_heads),
+                            ("vocab_size", lm.vocab_size),
+                            ("mlp dim", lm.hidden_dim * lm.mlp_ratio)):
+                if n % model_par:
+                    raise ValueError(
+                        f"tensor parallelism size {model_par} must divide "
+                        f"{what} (= {n})")
+        policy = Policy.from_config(cfg.precision)
+        self.model = get_model(
+            "transformer_lm",
+            num_classes=lm.vocab_size,
+            dtype=policy.compute_dtype,
+            seq_axis=AXIS_SEQUENCE if seq > 1 else None,
+            num_layers=lm.num_layers,
+            num_heads=lm.num_heads,
+            hidden_dim=lm.hidden_dim,
+            mlp_ratio=lm.mlp_ratio,
+            max_len=lm.max_len,
+            attn_impl=lm.attn_impl,
+        )
+        self.world_size = data_axis_size(self.mesh)
+        self.tx = make_optimizer(cfg.optimizer, cfg.scheduler, self.world_size)
+        loss_scale = LossScaleState.create(cfg.precision)
+
+        self.rng, init_rng = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        if self.strategy == "pipeline":
+            self.train_step = make_pp_lm_train_step(
+                self.mesh, model=self.model,
+                num_microbatches=lm.num_microbatches)
+            plm = self.train_step.pipelined
+            state = TrainState.create(
+                apply_fn=plm.apply_fn, params=plm.init_params(init_rng),
+                tx=self.tx, loss_scale=loss_scale)
+            self.shardings = self.train_step.state_shardings(state)
+        elif self.strategy == "sequence":
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.train_step = make_lm_train_step(self.mesh, model=self.model)
+            state = init_train_state(
+                self.model, init_rng, (1, 8), self.tx,
+                loss_scale=loss_scale, input_dtype=jnp.int32)
+            repl = NamedSharding(self.mesh, P())
+            self.shardings = jax.tree.map(lambda _: repl, state)
+        else:
+            self.train_step = make_tp_lm_train_step(
+                self.mesh, model=self.model, zero_stage=cfg.zero.stage)
+            state = init_train_state(
+                self.model, init_rng, (1, 8), self.tx,
+                loss_scale=loss_scale, input_dtype=jnp.int32)
+            self.shardings = self.train_step.state_shardings(state)
+        self.state = place_state(state, self.shardings)
+
+        if self.strategy == "sequence":
+            self.batch_shardings = lm_batch_shardings(self.mesh)
+        else:
+            self.batch_shardings = self.train_step.batch_shardings
+
+        # Eval forward: the ring-attention model only applies inside
+        # shard_map (its sequence axis must be bound), so the sequence
+        # strategy evaluates through an unsharded twin — params are
+        # replicated there, and the math is identical by construction
+        # (tests/test_lm_sequence_parallel.py pins this equivalence).
+        if self.strategy == "sequence":
+            eval_model = self.model.clone(seq_axis=None)
+            eval_apply = eval_model.apply
+        else:
+            eval_apply = self.state.apply_fn
+
+        def eval_loss(params, batch):
+            logits = eval_apply({"params": params}, batch["tokens"],
+                                train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["targets"]).mean()
+
+        self._eval_fn = jax.jit(eval_loss)
+
+        self.meter = MetricMeter(cfg.log_interval)
+        self.clock = WallClock(cfg.wall_clock_breakdown)
+        self._global_step = 0
+        self.coord.print(
+            f"[lm_trainer] params={param_count(state.params):,} "
+            f"mesh={shape} strategy={self.strategy} "
+            f"zero_stage={cfg.zero.stage} dtype={cfg.precision.dtype} "
+            f"seq_len={lm.seq_len}")
+
+    # -- data ---------------------------------------------------------------
+    def make_loaders(self) -> tuple[TokenLoader, TokenLoader]:
+        lm = self.cfg.lm
+        if lm.corpus_path:
+            # Disjoint byte spans: eval windows never overlap training text.
+            train = byte_corpus(
+                lm.corpus_path, lm.train_sequences, lm.seq_len,
+                seed=self.cfg.seed, span=(0.0, 0.9))
+            evals = byte_corpus(
+                lm.corpus_path, lm.eval_sequences, lm.seq_len,
+                seed=self.cfg.seed + 1, span=(0.9, 1.0))
+        else:
+            train = synthetic_tokens(
+                lm.train_sequences, lm.seq_len, lm.vocab_size,
+                seed=self.cfg.seed)
+            evals = synthetic_tokens(
+                lm.eval_sequences, lm.seq_len, lm.vocab_size,
+                seed=self.cfg.seed + 1)
+        gbs = (self.cfg.data.global_batch_size or
+               self.cfg.data.batch_size * self.world_size)
+        def mk(toks, train_mode):
+            return TokenLoader(
+                toks, global_batch_size=gbs, shuffle=train_mode,
+                seed=self.cfg.seed,
+                max_steps=(self.cfg.data.max_steps_per_epoch
+                           if train_mode else None))
+        return mk(train, True), mk(evals, False)
+
+    def _place(self, host_batch: dict) -> dict:
+        # Shift on the host numpy array, then one device_put straight onto
+        # the mesh placement — no staging copy through the default device.
+        batch = make_lm_batch(host_batch["tokens"])
+        return jax.device_put(batch, self.batch_shardings)
+
+    # -- train --------------------------------------------------------------
+    def train_epoch(self, epoch: int, loader: TokenLoader) -> dict:
+        loader.set_epoch(epoch)
+        bar = EpochBar(len(loader), epoch, self.cfg.num_epochs,
+                       self.coord.is_master())
+        for batch in loader:
+            with self.clock.phase("data"):
+                gbatch = self._place(batch)
+            with self.clock.phase("step"):
+                self.rng, step_rng = jax.random.split(self.rng)
+                self.state, metrics = self.train_step(
+                    self.state, gbatch, step_rng)
+            with self.clock.phase("log"):
+                self._global_step += 1
+                fetched = self.meter.push(self._global_step, metrics)
+                bar.update()
+                if fetched:
+                    bar.set_postfix(self.meter.last)
+        bar.set_postfix(self.meter.flush())
+        bar.close()
+        if self.cfg.wall_clock_breakdown:
+            self.coord.print(f"[wall_clock] {self.clock.report()}")
+        return self.meter.last
+
+    # -- eval ---------------------------------------------------------------
+    def evaluate(self, loader: TokenLoader) -> float:
+        """Mean held-out perplexity (exp of the mean token CE)."""
+        losses = []
+        for batch in loader:
+            gbatch = self._place(batch)
+            losses.append(float(self._eval_fn(self.state.params, gbatch)))
+        if not losses:
+            raise ValueError(
+                "eval loader yielded no batches (eval_sequences "
+                f"{self.cfg.lm.eval_sequences} < global batch "
+                f"{loader.global_batch_size}? drop_last discards partials)")
+        return float(np.exp(np.mean(losses)))
+
+    # -- full run -----------------------------------------------------------
+    def fit(self) -> dict:
+        cfg = self.cfg
+        train_loader, eval_loader = self.make_loaders()
+
+        start_epoch = 0
+        if cfg.checkpoint.resume >= 0:
+            self.state, start_epoch = ckpt_lib.restore_checkpoint(
+                cfg.checkpoint.directory, cfg.checkpoint.resume, self.state)
+            self.state = place_state(self.state, self.shardings)
+            self.coord.print(f"[lm_trainer] resumed at epoch {start_epoch}")
+
+        ppl = None
+        with trace(cfg.profile_dir):
+            for epoch in range(start_epoch, cfg.num_epochs):
+                self.train_epoch(epoch, train_loader)
+                if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
+                    ppl = self.evaluate(eval_loader)
+                    self.coord.print(
+                        f"[eval] epoch {epoch + 1}: perplexity {ppl:.4f}")
+                if cfg.checkpoint.interval and (
+                        epoch + 1) % cfg.checkpoint.interval == 0:
+                    ckpt_lib.save_checkpoint(
+                        cfg.checkpoint.directory, epoch, self.state)
+                    ckpt_lib.prune_checkpoints(
+                        cfg.checkpoint.directory, cfg.checkpoint.keep)
+
+        return {"final_perplexity": ppl, "last_metrics": self.meter.last,
+                "steps": int(jax.device_get(self.state.step))}

@@ -11,6 +11,8 @@ and checkpoint save/resume (``:40-42``).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -44,10 +46,11 @@ class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None):
         self.cfg = cfg
         self.coord = Coordinator()
+        # Field-by-name conversion so every MeshSpec axis (incl. additions
+        # like `pipe`) reaches the mesh — a hand-copied subset here would
+        # silently reassign those devices to the inferred data axis.
         self.mesh = mesh if mesh is not None else create_mesh(
-            MeshConfig(
-                data=cfg.mesh.data, fsdp=cfg.mesh.fsdp, model=cfg.mesh.model,
-                expert=cfg.mesh.expert, sequence=cfg.mesh.sequence))
+            MeshConfig(**dataclasses.asdict(cfg.mesh)))
         self.world_size = data_axis_size(self.mesh)
 
         if cfg.moe.enabled and not cfg.model.startswith("moe"):

@@ -1,0 +1,1 @@
+python train.py --sp 4 -b 8 --seq-len 512 -c ./ckpt-lm

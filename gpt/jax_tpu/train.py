@@ -1,0 +1,121 @@
+"""Transformer-LM trainer CLI (JAX/TPU backend, sibling-directory layout).
+
+The reference's plugin boundary is a directory per backend under the
+workload dir (``resnet/{pytorch_ddp,deepspeed,colossal}``, SURVEY.md §1 L1);
+this directory extends the same layout to the framework's long-context LM
+workload — a model family the reference does not have (SURVEY.md §5
+"Long-context": absent).
+
+The parallel strategy is the mesh: ``--sp 4`` rings the sequence over 4
+devices, ``--tp 4`` megatron-shards the layers, ``--pp 4`` pipelines them;
+the rest of the devices form the data axis. ZeRO stages compose with TP/DP
+via ``--stage``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+
+def add_argument() -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="TransformerLM on TPU")
+    parser.add_argument("-b", "--batch_size", type=int, default=32,
+                        help="per-data-shard batch size")
+    parser.add_argument("-e", "--epochs", type=int, default=5)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--vocab-size", type=int, default=256)
+    parser.add_argument("--num-layers", type=int, default=4)
+    parser.add_argument("--num-heads", type=int, default=4)
+    parser.add_argument("--hidden-dim", type=int, default=256)
+    parser.add_argument("--max-len", type=int, default=2048)
+    parser.add_argument("--corpus", type=str, default=None,
+                        help="byte-level text file; default synthetic tokens")
+    parser.add_argument("--attn-impl", type=str, default="exact",
+                        choices=["exact", "flash"],
+                        help="flash = Pallas blockwise kernel (not with --sp)")
+    parser.add_argument("--dtype", type=str, default="fp32",
+                        choices=["bf16", "fp16", "fp32"])
+    parser.add_argument("--stage", type=int, default=0, choices=[0, 1, 2, 3],
+                        help="ZeRO stage (composes with --tp / pure DP)")
+    parser.add_argument("--tp", type=int, default=1,
+                        help="tensor-parallel (model axis) size")
+    parser.add_argument("--pp", type=int, default=1,
+                        help="pipeline-parallel (pipe axis) size")
+    parser.add_argument("--sp", type=int, default=1,
+                        help="sequence-parallel (ring) size")
+    parser.add_argument("--microbatches", type=int, default=2,
+                        help="GPipe microbatches (only with --pp)")
+    parser.add_argument("-c", "--checkpoint", type=str, default="./checkpoint")
+    parser.add_argument("-i", "--interval", type=int, default=5)
+    parser.add_argument("-r", "--resume", type=int, default=-1)
+    parser.add_argument("--log-interval", type=int, default=50)
+    parser.add_argument("--steps-per-epoch", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--wall-clock-breakdown", action="store_true")
+    parser.add_argument("--profile-dir", type=str, default=None)
+    return parser.parse_args()
+
+
+def build_config(args: argparse.Namespace):
+    from distributed_training_tpu.config import (
+        CheckpointConfig,
+        DataConfig,
+        LMConfig,
+        MeshSpec,
+        TrainConfig,
+        ZeroConfig,
+    )
+
+    cfg = TrainConfig(model="transformer_lm")
+    return cfg.replace(
+        num_epochs=args.epochs,
+        seed=args.seed,
+        log_interval=args.log_interval,
+        wall_clock_breakdown=args.wall_clock_breakdown,
+        profile_dir=args.profile_dir,
+        precision=dataclasses.replace(cfg.precision, dtype=args.dtype),
+        zero=ZeroConfig(stage=args.stage),
+        mesh=MeshSpec(data=-1, model=args.tp, pipe=args.pp, sequence=args.sp),
+        checkpoint=CheckpointConfig(
+            directory=args.checkpoint,
+            interval=args.interval,
+            resume=args.resume,
+        ),
+        data=DataConfig(
+            batch_size=args.batch_size,
+            max_steps_per_epoch=args.steps_per_epoch,
+        ),
+        lm=LMConfig(
+            seq_len=args.seq_len,
+            vocab_size=args.vocab_size,
+            num_layers=args.num_layers,
+            num_heads=args.num_heads,
+            hidden_dim=args.hidden_dim,
+            max_len=args.max_len,
+            num_microbatches=args.microbatches,
+            attn_impl=args.attn_impl,
+            corpus_path=args.corpus,
+        ),
+    )
+
+
+def main() -> int:
+    args = add_argument()
+
+    from distributed_training_tpu.runtime.distributed import (
+        initialize_distributed,
+    )
+    from distributed_training_tpu.train.lm_trainer import LMTrainer
+
+    initialize_distributed()
+    cfg = build_config(args)
+    trainer = LMTrainer(cfg)
+    result = trainer.fit()
+    trainer.coord.print(f"[done] {result}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
