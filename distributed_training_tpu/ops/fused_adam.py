@@ -31,6 +31,8 @@ import optax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributed_training_tpu.utils.compat import on_tpu
+
 # VPU-tile-aligned block: 8 sublanes × 128 lanes × 32 rows.
 _BLOCK = 8 * 128 * 32
 
@@ -51,13 +53,6 @@ def _make_kernel(b1: float, b2: float, eps: float):
         m_out[:] = m
         v_out[:] = v
     return kernel
-
-
-def _on_tpu() -> bool:
-    try:
-        return jax.devices()[0].platform == "tpu"
-    except Exception:  # pragma: no cover
-        return False
 
 
 @functools.partial(jax.jit, static_argnames=("b1", "b2", "eps", "interpret"))
@@ -150,7 +145,7 @@ def fused_adam(
     def update_fn(updates, state, params):
         if params is None:
             raise ValueError("fused_adam requires params")
-        run_interpret = (not _on_tpu()) if interpret is None else interpret
+        run_interpret = (not on_tpu()) if interpret is None else interpret
         count = state.count + 1
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
         lr = jnp.asarray(lr, jnp.float32)

@@ -164,6 +164,7 @@ class PipelinedLM:
             mlp_dim=model.mlp_ratio * model.hidden_dim,
             dtype=model.dtype,
             seq_axis=None,
+            attn_impl=model.attn_impl,
             name=None)
         shape = dict(zip(mesh.axis_names, mesh.devices.shape))
         self.pipe_size = shape.get(AXIS_PIPE, 1)

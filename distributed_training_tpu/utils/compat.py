@@ -1,6 +1,17 @@
-"""JAX API compatibility shims shared across the framework."""
+"""JAX API + platform shims shared across the framework."""
 
 from __future__ import annotations
+
+import jax
+
+
+def on_tpu() -> bool:
+    """True when the first visible device is a TPU (Pallas ops use this to
+    pick compiled vs interpret mode)."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover
+        return False
 
 try:  # jax >= 0.6 exposes shard_map at top level
     from jax import shard_map as _shard_map
