@@ -57,7 +57,8 @@ def ensure_live_backend(probe_timeout: int = 180) -> str:
     return "cpu"
 
 
-def build(model_name: str, batch_size: int, image_size: int, num_classes: int):
+def build(model_name: str, batch_size: int, image_size: int, num_classes: int,
+          zero_stage: int = 0):
     from distributed_training_tpu.config import PrecisionConfig
     from distributed_training_tpu.models import get_model
     from distributed_training_tpu.parallel.sharding import (
@@ -79,8 +80,8 @@ def build(model_name: str, batch_size: int, image_size: int, num_classes: int):
         model, jax.random.PRNGKey(0),
         (batch_size, image_size, image_size, 3), tx,
         loss_scale=LossScaleState.create(PrecisionConfig(dtype="bf16")))
-    state = place_state(state, state_shardings(state, mesh, zero_stage=0))
-    step = make_train_step(mesh, zero_stage=0, donate=True)
+    state = place_state(state, state_shardings(state, mesh, zero_stage=zero_stage))
+    step = make_train_step(mesh, zero_stage=zero_stage, donate=True)
     return mesh, state, step
 
 
@@ -91,6 +92,8 @@ def main():
                     help="per-chip batch size")
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--zero-stage", type=int, default=0, choices=[0, 1, 2, 3],
+                    help="ZeRO placement for the benched step")
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--steps", type=int, default=45)
     ap.add_argument("--sync-interval", type=int, default=15,
@@ -110,7 +113,8 @@ def main():
     global_batch = args.batch_size * n_chips
 
     mesh, state, step = build(
-        args.model, global_batch, args.image_size, args.num_classes)
+        args.model, global_batch, args.image_size, args.num_classes,
+        zero_stage=args.zero_stage)
 
     rng = np.random.RandomState(0)
     batch = {
@@ -146,8 +150,9 @@ def main():
     per_chip = images_per_sec / n_chips
     print(json.dumps({
         "metric": f"{args.model} synthetic-ImageNet train throughput "
-                  f"(bf16, batch {args.batch_size}/chip, {n_chips} "
-                  f"{platform} chip(s))",
+                  f"(bf16, batch {args.batch_size}/chip"
+                  f"{', zero-' + str(args.zero_stage) if args.zero_stage else ''}"
+                  f", {n_chips} {platform} chip(s))",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4),

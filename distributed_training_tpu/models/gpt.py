@@ -48,7 +48,13 @@ class MlpBlock(nn.Module):
 
 
 class DecoderBlock(nn.Module):
-    """Pre-LN causal decoder block: LN → ring-MHA → residual → LN → MLP."""
+    """Pre-LN causal decoder block: LN → ring-MHA → residual → LN → FFN.
+
+    The FFN is the dense :class:`MlpBlock`, or a GShard-style
+    :class:`~distributed_training_tpu.models.moe.MoEMlp` when
+    ``moe_num_experts > 0`` (expert-parallel over ``expert_axis``; the
+    aux load-balancing loss is sown and added by the train step).
+    """
 
     num_heads: int
     mlp_dim: int
@@ -56,6 +62,13 @@ class DecoderBlock(nn.Module):
     seq_axis: str | None = None
     dropout_rate: float = 0.0
     attn_impl: str = "exact"
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 0
+    moe_noisy_gate_policy: str | None = None
+    moe_mlp_type: str = "standard"
+    moe_expert_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -68,7 +81,22 @@ class DecoderBlock(nn.Module):
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
-        y = MlpBlock(mlp_dim=self.mlp_dim, dtype=self.dtype, name="mlp")(y)
+        if self.moe_num_experts > 0:
+            from distributed_training_tpu.models.moe import MoEMlp
+
+            y = MoEMlp(
+                num_experts=self.moe_num_experts,
+                hidden_dim=self.mlp_dim,
+                top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                min_capacity=self.moe_min_capacity,
+                noisy_gate_policy=self.moe_noisy_gate_policy,
+                mlp_type=self.moe_mlp_type,
+                expert_axis=self.moe_expert_axis,
+                dtype=self.dtype,
+                name="moe_mlp")(y, train=train)
+        else:
+            y = MlpBlock(mlp_dim=self.mlp_dim, dtype=self.dtype, name="mlp")(y)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         return x + y
@@ -111,6 +139,16 @@ class TransformerLM(nn.Module):
     seq_axis: str | None = None
     dropout_rate: float = 0.0
     attn_impl: str = "exact"  # exact | flash (pallas kernel, unsharded path)
+    # MoE: every ``moe_every``-th block (GShard convention: alternating)
+    # swaps its dense FFN for an expert-parallel MoEMlp. 0 experts = dense.
+    moe_num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_min_capacity: int = 0
+    moe_noisy_gate_policy: str | None = None
+    moe_mlp_type: str = "standard"
+    moe_expert_axis: str | None = None
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = False):
@@ -132,6 +170,8 @@ class TransformerLM(nn.Module):
             (self.max_len, self.hidden_dim))
         x = add_pos_embed(self, pos_tab, x, positions)
         for i in range(self.num_layers):
+            is_moe = (self.moe_num_experts > 0 and self.moe_every > 0
+                      and i % self.moe_every == self.moe_every - 1)
             x = DecoderBlock(
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_ratio * self.hidden_dim,
@@ -139,6 +179,13 @@ class TransformerLM(nn.Module):
                 seq_axis=self.seq_axis,
                 dropout_rate=self.dropout_rate,
                 attn_impl=self.attn_impl,
+                moe_num_experts=self.moe_num_experts if is_moe else 0,
+                moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
+                moe_min_capacity=self.moe_min_capacity,
+                moe_noisy_gate_policy=self.moe_noisy_gate_policy,
+                moe_mlp_type=self.moe_mlp_type,
+                moe_expert_axis=self.moe_expert_axis,
                 name=f"block{i}")(x, train=train)
         x = make_final_norm(self, name="ln_f")(x)
         return make_lm_head(self, name="lm_head")(x)
@@ -157,6 +204,14 @@ def make_transformer_lm(
     max_len: int = 2048,
     dropout_rate: float = 0.0,
     attn_impl: str = "exact",
+    moe_num_experts: int = 0,
+    moe_every: int = 2,
+    moe_top_k: int = 1,
+    moe_capacity_factor: float = 1.25,
+    moe_min_capacity: int = 0,
+    moe_noisy_gate_policy: str | None = None,
+    moe_mlp_type: str = "standard",
+    moe_expert_axis: str | None = None,
 ) -> TransformerLM:
     """Registry factory. ``num_classes`` doubles as vocab size; ``axis_name``
     (the registry's SyncBN slot) is unused — LM has no BatchNorm. Unknown
@@ -174,4 +229,12 @@ def make_transformer_lm(
         seq_axis=seq_axis,
         dropout_rate=dropout_rate,
         attn_impl=attn_impl,
+        moe_num_experts=moe_num_experts,
+        moe_every=moe_every,
+        moe_top_k=moe_top_k,
+        moe_capacity_factor=moe_capacity_factor,
+        moe_min_capacity=moe_min_capacity,
+        moe_noisy_gate_policy=moe_noisy_gate_policy,
+        moe_mlp_type=moe_mlp_type,
+        moe_expert_axis=moe_expert_axis,
     )

@@ -56,6 +56,29 @@ def _block(t: int, requested: int) -> int:
         f"any 128-multiple below it; pad the sequence to a multiple of 128")
 
 
+
+def _live_block(qi, ki, *, causal, block_q, block_k):
+    """False only for causal blocks that are entirely masked (k_start >
+    q_end) — the skip predicate shared by all three kernels."""
+    return (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+
+def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
+    """fp32 scaled q·kᵀ for one tile, causally masked by global positions."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(kpos > qpos, NEG_INF, s)
+    return s
+
+
 # -- forward -----------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
@@ -69,26 +92,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         m[:] = jnp.full_like(m, NEG_INF)
         l[:] = jnp.zeros_like(l)
 
-    # Causal block skip: a block with k_start > q_end is fully masked —
-    # skip its matmuls entirely (halves the causal FLOPs; the grid still
-    # visits the block, but the body is predicated out).
-    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
-
-    @pl.when(live)
+    # Causal block skip: a fully-masked block's matmuls are predicated out
+    # (halves the causal FLOPs; the grid still visits the block).
+    @pl.when(_live_block(qi, ki, causal=causal, block_q=block_q,
+                         block_k=block_k))
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos > qpos, NEG_INF, s)
-
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
         m_prev = m[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -161,21 +171,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq,
     def _():
         dq[:] = jnp.zeros_like(dq)
 
-    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
-
-    @pl.when(live)
+    @pl.when(_live_block(qi, ki, causal=causal, block_q=block_q,
+                         block_k=block_k))
     def _():
-        q = q_ref[0].astype(jnp.float32)
         k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos > qpos, NEG_INF, s)
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dp = jax.lax.dot_general(
             do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
@@ -201,21 +202,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk[:] = jnp.zeros_like(dk)
         dv[:] = jnp.zeros_like(dv)
 
-    live = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
-
-    @pl.when(live)
+    @pl.when(_live_block(qi, ki, causal=causal, block_q=block_q,
+                         block_k=block_k))
     def _():
         q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos > qpos, NEG_INF, s)
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
         p = jnp.exp(s - lse_ref[0][:, :1])
         do = do_ref[0].astype(jnp.float32)
         # dV += P^T dO

@@ -43,7 +43,7 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_training_tpu.runtime.mesh import AXIS_MODEL
+from distributed_training_tpu.runtime.mesh import AXIS_EXPERT, AXIS_MODEL
 from distributed_training_tpu.utils.tree import path_str
 
 # (path regex, spec) — first match wins; matched against "/".join(path keys).
@@ -60,6 +60,11 @@ LM_TP_RULES: tuple[tuple[str, P], ...] = (
     (r"lm_head/kernel$", P(None, AXIS_MODEL)),
     (r"lm_head/bias$", P(AXIS_MODEL)),
     (r"tok_embed/embedding$", P(AXIS_MODEL, None)),
+    # MoE expert weights: leading E dim sharded over the expert axis (the
+    # state-placement counterpart of the activation constraints in
+    # models/moe.py).
+    (r"experts/w[12]$", P(AXIS_EXPERT, None, None)),
+    (r"experts/b[12]$", P(AXIS_EXPERT, None, None)),
 )
 
 
@@ -88,11 +93,10 @@ def tp_tree_shardings(
     """
     from distributed_training_tpu.parallel.sharding import zero_leaf_sharding
 
-    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    tp_on = shape.get(AXIS_MODEL, 1) > 1
-
+    # Rules are applied unconditionally: a spec over a size-1 mesh axis is a
+    # no-op shard, so the same table serves pure-DP, TP, and EP meshes.
     def leaf_sharding(path, leaf):
-        spec = tp_spec_for_path(path_str(path)) if tp_on else P()
+        spec = tp_spec_for_path(path_str(path))
         if extra_axes:
             return zero_leaf_sharding(leaf, mesh, extra_axes, base=spec)
         return NamedSharding(mesh, spec)

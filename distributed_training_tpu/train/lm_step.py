@@ -43,32 +43,49 @@ _GRAD_AXES = (AXIS_DATA, AXIS_SEQUENCE)
 
 
 def _lm_loss_and_grads(state: TrainState, tokens, targets, rng, positions=None):
-    """Scaled-CE value-and-grad shared by every LM step variant."""
+    """Scaled-CE (+ MoE aux) value-and-grad shared by every LM step variant.
+
+    Returns ``(grads, ce, aux, logits)`` — CE and the MoE load-balancing
+    term separately, so metrics can report perplexity as ``exp(CE)``
+    (comparable to the CE-only eval loss) while the gradient flows through
+    ``CE + aux``.
+    """
     def loss_fn(params):
-        logits = state.apply_fn(
+        rngs = dict(zip(("dropout", "gate"), jax.random.split(rng)))
+        out = state.apply_fn(
             {"params": params}, tokens, positions=positions, train=True,
-            rngs={"dropout": rng})
-        loss = optax.softmax_cross_entropy_with_integer_labels(
+            rngs=rngs, mutable=["aux_loss"])
+        if isinstance(out, tuple):  # flax apply with a mutable collection
+            logits, mutated = out
+            aux = sum(jax.tree.leaves(dict(mutated).get("aux_loss", {})),
+                      jnp.float32(0))
+        else:  # PipelinedLM.apply_fn (no collections)
+            logits, aux = out, jnp.float32(0)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
             logits, targets).mean()
-        return state.loss_scale.scale_loss(loss), (loss, logits)
+        return state.loss_scale.scale_loss(ce + aux), (ce, aux, logits)
 
-    grads, (loss, logits) = jax.grad(loss_fn, has_aux=True)(state.params)
-    return grads, loss, logits
+    grads, (ce, aux, logits) = jax.grad(loss_fn, has_aux=True)(state.params)
+    return grads, ce, aux, logits
 
 
-def _lm_metrics(new_state: TrainState, loss, logits, targets, finite,
+def _lm_metrics(new_state: TrainState, ce, aux, logits, targets, finite,
                 pmean_axes=None):
     """The LM metrics contract; ``pmean_axes`` averages shard-local values
-    (the GSPMD path computes global values already)."""
+    (the GSPMD path computes global values already). ``loss`` is the full
+    objective (CE + MoE aux); ``perplexity`` is ``exp(CE)`` so it stays
+    comparable to eval perplexity."""
     accuracy = jnp.mean(
         (jnp.argmax(logits, -1) == targets).astype(jnp.float32))
     if pmean_axes:
-        loss = lax.pmean(loss, pmean_axes)
+        ce = lax.pmean(ce, pmean_axes)
+        aux = lax.pmean(aux, pmean_axes)
         accuracy = lax.pmean(accuracy, pmean_axes)
     return {
-        "loss": loss.astype(jnp.float32),
+        "loss": (ce + aux).astype(jnp.float32),
+        "aux_loss": jnp.asarray(aux, jnp.float32),
         "accuracy": accuracy,
-        "perplexity": jnp.exp(loss).astype(jnp.float32),
+        "perplexity": jnp.exp(ce).astype(jnp.float32),
         "loss_scale": new_state.loss_scale.scale,
         "grads_finite": finite.astype(jnp.float32),
     }
@@ -84,14 +101,14 @@ def _lm_step_body(state: TrainState, batch, rng):
     shard_rng = jax.random.fold_in(
         rng, seq_idx * lax.axis_size(AXIS_DATA) + lax.axis_index(AXIS_DATA))
 
-    grads, loss, logits = _lm_loss_and_grads(
+    grads, ce, aux, logits = _lm_loss_and_grads(
         state, tokens, targets, shard_rng, positions=positions)
     grads = lax.pmean(grads, _GRAD_AXES)
     grads = state.loss_scale.unscale_grads(grads)
 
     new_state, finite = commit_gradients(state, grads)
     return new_state, _lm_metrics(
-        new_state, loss, logits, targets, finite, pmean_axes=_GRAD_AXES)
+        new_state, ce, aux, logits, targets, finite, pmean_axes=_GRAD_AXES)
 
 
 def make_lm_train_step(
@@ -157,12 +174,12 @@ def _make_gspmd_lm_step(
                 "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
 
     def body(state: TrainState, batch, rng):
-        grads, loss, logits = _lm_loss_and_grads(
+        grads, ce, aux, logits = _lm_loss_and_grads(
             state, batch["tokens"], batch["targets"], rng)
         grads = state.loss_scale.unscale_grads(grads)
         new_state, finite = commit_gradients(state, grads)
         return new_state, _lm_metrics(
-            new_state, loss, logits, batch["targets"], finite)
+            new_state, ce, aux, logits, batch["targets"], finite)
 
     jitted = None  # built lazily: shardings need a concrete state's pytree
 

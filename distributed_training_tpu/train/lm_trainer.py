@@ -98,6 +98,16 @@ class LMTrainer:
                 "attn_impl='flash' is the unsharded kernel; the sequence "
                 "strategy rings K/V blocks itself (use exact)")
 
+        expert = shape.get("expert", 1)
+        if (cfg.moe.enabled or expert > 1) and self.strategy != "tensor/dp":
+            raise NotImplementedError(
+                "MoE/expert parallelism composes with the tensor/dp "
+                f"strategy only (got {self.strategy})")
+        if expert > 1 and not cfg.moe.enabled:
+            raise ValueError(
+                f"expert mesh axis sized {expert} with MoE disabled would "
+                "replicate the dense model over it (idle chips); enable "
+                "--moe or drop the expert axis")
         lm = cfg.lm
         if seq > 1 and lm.seq_len % seq:
             raise ValueError(
@@ -112,6 +122,12 @@ class LMTrainer:
                 raise ValueError(
                     f"num_microbatches {lm.num_microbatches} must divide "
                     f"the per-shard batch_size (= {cfg.data.batch_size})")
+        if cfg.moe.enabled and expert > 1:
+            ne = int(cfg.moe.num_experts[0])
+            if ne % expert:
+                raise ValueError(
+                    f"expert-parallel size {expert} must divide "
+                    f"num_experts (= {ne})")
         if model_par > 1:
             # The megatron rule table shards heads / mlp columns / vocab over
             # the model axis; device_put fails opaquely on non-divisible
@@ -124,6 +140,17 @@ class LMTrainer:
                         f"tensor parallelism size {model_par} must divide "
                         f"{what} (= {n})")
         policy = Policy.from_config(cfg.precision)
+        moe_kwargs = {}
+        if cfg.moe.enabled:
+            moe_kwargs = dict(
+                moe_num_experts=int(cfg.moe.num_experts[0]),
+                moe_top_k=cfg.moe.top_k,
+                moe_capacity_factor=cfg.moe.capacity_factor,
+                moe_min_capacity=cfg.moe.min_capacity,
+                moe_noisy_gate_policy=cfg.moe.noisy_gate_policy,
+                moe_mlp_type=cfg.moe.mlp_type,
+                moe_expert_axis="expert" if expert > 1 else None,
+            )
         self.model = get_model(
             "transformer_lm",
             num_classes=lm.vocab_size,
@@ -135,6 +162,7 @@ class LMTrainer:
             mlp_ratio=lm.mlp_ratio,
             max_len=lm.max_len,
             attn_impl=lm.attn_impl,
+            **moe_kwargs,
         )
         self.world_size = data_axis_size(self.mesh)
         self.tx = make_optimizer(cfg.optimizer, cfg.scheduler, self.world_size)

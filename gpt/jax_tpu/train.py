@@ -35,6 +35,18 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--attn-impl", type=str, default="exact",
                         choices=["exact", "flash"],
                         help="flash = Pallas blockwise kernel (not with --sp)")
+    # MoE surface (DeepSpeed flag names, resnet/deepspeed parity) — here
+    # they swap alternating decoder FFNs for expert-parallel MoE layers.
+    parser.add_argument("--moe", action="store_true", default=False)
+    parser.add_argument("--ep-world-size", type=int, default=1,
+                        help="expert mesh axis size")
+    parser.add_argument("--num-experts", type=int, nargs="+", default=[8])
+    parser.add_argument("--top-k", type=int, default=1)
+    parser.add_argument("--min-capacity", type=int, default=0)
+    parser.add_argument("--noisy-gate-policy", type=str, default=None,
+                        choices=[None, "RSample", "Jitter"])
+    parser.add_argument("--mlp-type", type=str, default="standard",
+                        choices=["standard", "residual"])
     parser.add_argument("--dtype", type=str, default="fp32",
                         choices=["bf16", "fp16", "fp32"])
     parser.add_argument("--stage", type=int, default=0, choices=[0, 1, 2, 3],
@@ -64,12 +76,22 @@ def build_config(args: argparse.Namespace):
         DataConfig,
         LMConfig,
         MeshSpec,
+        MoEConfig,
         TrainConfig,
         ZeroConfig,
     )
 
     cfg = TrainConfig(model="transformer_lm")
     return cfg.replace(
+        moe=MoEConfig(
+            enabled=args.moe,
+            ep_world_size=args.ep_world_size,
+            num_experts=tuple(args.num_experts),
+            top_k=args.top_k,
+            min_capacity=args.min_capacity,
+            noisy_gate_policy=args.noisy_gate_policy,
+            mlp_type=args.mlp_type,
+        ),
         num_epochs=args.epochs,
         seed=args.seed,
         log_interval=args.log_interval,
@@ -77,7 +99,8 @@ def build_config(args: argparse.Namespace):
         profile_dir=args.profile_dir,
         precision=dataclasses.replace(cfg.precision, dtype=args.dtype),
         zero=ZeroConfig(stage=args.stage),
-        mesh=MeshSpec(data=-1, model=args.tp, pipe=args.pp, sequence=args.sp),
+        mesh=MeshSpec(data=-1, model=args.tp, pipe=args.pp, sequence=args.sp,
+                      expert=args.ep_world_size),
         checkpoint=CheckpointConfig(
             directory=args.checkpoint,
             interval=args.interval,

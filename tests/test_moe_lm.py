@@ -1,0 +1,142 @@
+"""MoE decoder blocks in the TransformerLM (expert-parallel FFNs).
+
+The reference parses MoE flags but trains a dense model
+(``resnet/deepspeed/deepspeed_train.py:61-106`` vs ``:223``); here the same
+surface swaps alternating decoder FFNs for GShard-style expert layers. The
+invariants: expert parallelism is numerically invisible (EP placement == the
+single-device MoE model), aux load-balancing loss flows into the objective,
+and the LMTrainer drives it end-to-end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_tpu.config import (
+    DataConfig,
+    LMConfig,
+    MeshSpec,
+    MoEConfig,
+    TrainConfig,
+)
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+from distributed_training_tpu.train.lm_step import (
+    make_lm_batch,
+    make_tp_lm_train_step,
+)
+from distributed_training_tpu.train.lm_trainer import LMTrainer
+
+VOCAB = 64
+
+
+def _moe_model(expert_axis=None):
+    return get_model(
+        "transformer_lm", num_classes=VOCAB, seq_axis=None,
+        num_layers=2, num_heads=2, hidden_dim=32, max_len=64,
+        moe_num_experts=4, moe_top_k=2, moe_expert_axis=expert_axis)
+
+
+def test_moe_every_alternates():
+    model = _moe_model()
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           jnp.zeros((1, 8), jnp.int32), train=False)
+    params = variables["params"]
+    # moe_every=2 → block0 dense, block1 MoE.
+    assert "mlp" in params["block0"] and "moe_mlp" not in params["block0"]
+    assert "moe_mlp" in params["block1"] and "mlp" not in params["block1"]
+    assert params["block1"]["moe_mlp"]["experts"]["w1"].shape[0] == 4
+
+
+def test_moe_aux_loss_reaches_objective():
+    """The sown load-balancing loss contributes to the training loss."""
+    model = _moe_model()
+    variables = model.init({"params": jax.random.PRNGKey(0)},
+                           jnp.zeros((1, 8), jnp.int32), train=False)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, (2, 16)), jnp.int32)
+    logits, mutated = model.apply(
+        variables, tokens, train=True, mutable=["aux_loss"],
+        rngs={"gate": jax.random.PRNGKey(1)})
+    aux = jax.tree.leaves(dict(mutated)["aux_loss"])
+    assert aux and all(float(a) > 0 for a in aux)
+
+
+def test_ep_matches_single_device():
+    """(data=2 × expert=4) MoE step == the unsharded MoE step."""
+    mesh = create_mesh(MeshConfig(data=2, expert=4))
+    batch = make_lm_batch(
+        np.random.RandomState(0).randint(0, VOCAB, (4, 17)).astype(np.int32))
+    rng = jax.random.PRNGKey(3)
+
+    import optax
+    from distributed_training_tpu.config import PrecisionConfig
+    from distributed_training_tpu.train.precision import LossScaleState
+    from distributed_training_tpu.train.train_state import init_train_state
+
+    def make_state(expert_axis):
+        model = _moe_model(expert_axis)
+        return model, init_train_state(
+            model, jax.random.PRNGKey(0), (2, 8), optax.sgd(0.1),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+            input_dtype=jnp.int32)
+
+    # Oracle: unsharded MoE, plain jit on the full batch.
+    _, oracle = make_state(None)
+    from distributed_training_tpu.train.lm_step import _lm_loss_and_grads
+
+    def oracle_step(state, batch):
+        grads, ce, aux, _ = _lm_loss_and_grads(
+            state, jnp.asarray(batch["tokens"]),
+            jnp.asarray(batch["targets"]), rng)
+        return state.apply_gradients(grads), ce + aux
+
+    oracle_new, oracle_loss = jax.jit(oracle_step)(oracle, batch)
+
+    model, ep_state = make_state("expert")
+    step = make_tp_lm_train_step(mesh, model=model, donate=False)
+    gbatch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in batch.items()}, step.batch_shardings)
+    ep_new, metrics = step(ep_state, gbatch, rng)
+
+    np.testing.assert_allclose(
+        float(metrics["loss"]), float(oracle_loss), atol=1e-5, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+        ep_new.params, oracle_new.params)
+    # Expert weights really land sharded over the expert axis.
+    w1 = ep_new.params["block1"]["moe_mlp"]["experts"]["w1"]
+    assert w1.sharding.spec == P("expert", None, None)
+    assert w1.addressable_shards[0].data.shape[0] == 1  # 4 experts / 4 ranks
+
+
+def test_lm_trainer_moe_ep(tmp_path):
+    cfg = TrainConfig(model="transformer_lm").replace(
+        num_epochs=2, log_interval=4,
+        data=DataConfig(batch_size=8, max_steps_per_epoch=4),
+        lm=LMConfig(seq_len=32, num_layers=2, num_heads=4, hidden_dim=32,
+                    max_len=64, train_sequences=256, eval_sequences=64),
+        moe=MoEConfig(enabled=True, num_experts=(4,), top_k=2),
+        mesh=MeshSpec(data=4, expert=2),
+    )
+    result = LMTrainer(cfg).fit()
+    assert np.isfinite(result["final_perplexity"])
+    assert result["final_perplexity"] < 250
+
+
+def test_lm_trainer_moe_rejects_bad_mesh(tmp_path):
+    cfg = TrainConfig(model="transformer_lm").replace(
+        moe=MoEConfig(enabled=True, num_experts=(4,)),
+        mesh=MeshSpec(data=2, pipe=2, expert=2),
+        lm=LMConfig(num_layers=2))
+    with pytest.raises(NotImplementedError, match="expert"):
+        LMTrainer(cfg)
+    cfg = TrainConfig(model="transformer_lm").replace(
+        moe=MoEConfig(enabled=True, num_experts=(3,)),
+        mesh=MeshSpec(data=4, expert=2),
+        lm=LMConfig(num_layers=2))
+    with pytest.raises(ValueError, match="num_experts"):
+        LMTrainer(cfg)
