@@ -165,6 +165,9 @@ class DataConfig:
     drop_last: bool = True
     synthetic_ok: bool = True  # fall back to synthetic data if not on disk
     max_steps_per_epoch: int | None = None  # cap train steps (smoke/bench runs)
+    # Batches staged ahead of the step (host augment + device DMA overlap
+    # with compute; data/prefetch.py). 0 disables.
+    prefetch: int = 2
 
 
 @dataclasses.dataclass(frozen=True)

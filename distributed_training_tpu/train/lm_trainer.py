@@ -122,6 +122,12 @@ class LMTrainer:
                 raise ValueError(
                     f"num_microbatches {lm.num_microbatches} must divide "
                     f"the per-shard batch_size (= {cfg.data.batch_size})")
+        if cfg.moe.enabled and len(cfg.moe.num_experts) != 1:
+            # DeepSpeed's per-layer expert-count lists are not supported;
+            # refusing beats silently training with num_experts[0] only.
+            raise NotImplementedError(
+                f"per-layer expert counts {tuple(cfg.moe.num_experts)} are "
+                "not supported; pass a single num_experts value")
         if cfg.moe.enabled and expert > 1:
             ne = int(cfg.moe.num_experts[0])
             if ne % expert:
@@ -263,14 +269,27 @@ class LMTrainer:
         batch = make_lm_batch(host_batch["tokens"])
         return jax.device_put(batch, self.batch_shardings)
 
+    def _batches(self, loader: TokenLoader):
+        """Device-resident batches, prefetched ``cfg.data.prefetch`` ahead;
+        the synchronous path keeps per-batch 'data' wall-clock attribution."""
+        from distributed_training_tpu.data.prefetch import DevicePrefetcher
+
+        if self.cfg.data.prefetch < 1:
+            def sync_gen():
+                for b in loader:
+                    with self.clock.phase("data"):
+                        gb = self._place(b)
+                    yield gb
+            return sync_gen()
+        return DevicePrefetcher(loader, self._place,
+                                depth=self.cfg.data.prefetch)
+
     # -- train --------------------------------------------------------------
     def train_epoch(self, epoch: int, loader: TokenLoader) -> dict:
         loader.set_epoch(epoch)
         bar = EpochBar(len(loader), epoch, self.cfg.num_epochs,
                        self.coord.is_master())
-        for batch in loader:
-            with self.clock.phase("data"):
-                gbatch = self._place(batch)
+        for gbatch in self._batches(loader):
             with self.clock.phase("step"):
                 self.rng, step_rng = jax.random.split(self.rng)
                 self.state, metrics = self.train_step(
@@ -291,8 +310,7 @@ class LMTrainer:
     def evaluate(self, loader: TokenLoader) -> float:
         """Mean held-out perplexity (exp of the mean token CE)."""
         losses = []
-        for batch in loader:
-            gbatch = self._place(batch)
+        for gbatch in self._batches(loader):
             losses.append(float(self._eval_fn(self.state.params, gbatch)))
         if not losses:
             raise ValueError(
