@@ -1,0 +1,9 @@
+"""Inference: KV-cache autoregressive generation for the LM family."""
+
+from distributed_training_tpu.inference.sampler import (  # noqa: F401
+    Generator,
+    SampleConfig,
+    apply_top_k,
+    apply_top_p,
+    sample_token,
+)

@@ -69,14 +69,16 @@ class DecoderBlock(nn.Module):
     moe_noisy_gate_policy: str | None = None
     moe_mlp_type: str = "standard"
     moe_expert_axis: str | None = None
+    cache_len: int | None = None
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False):
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         y = RingSelfAttention(
             num_heads=self.num_heads, dtype=self.dtype,
             axis_name=self.seq_axis, causal=True,
-            attn_impl=self.attn_impl, name="attn")(y)
+            attn_impl=self.attn_impl, cache_len=self.cache_len,
+            name="attn")(y, decode=decode)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
@@ -149,9 +151,34 @@ class TransformerLM(nn.Module):
     moe_noisy_gate_policy: str | None = None
     moe_mlp_type: str = "standard"
     moe_expert_axis: str | None = None
+    # KV-cache slots for decode=True; None → max_len. Smaller values (the
+    # Generator sets prompt + max_new_tokens) shrink the scan carry and the
+    # per-step attention width without touching params.
+    cache_len: int | None = None
 
     @nn.compact
-    def __call__(self, tokens, positions=None, train: bool = False):
+    def __call__(self, tokens, positions=None, train: bool = False,
+                 decode: bool = False):
+        """``decode=True`` runs the cached autoregressive path: every block
+        appends K/V for this call's tokens to its ``cache`` collection
+        (length ``cache_len``, default ``max_len``) and attends against the
+        cache. The caller applies with ``mutable=['cache']`` (see
+        ``inference/sampler.py``). ``positions`` feeds ONLY the positional
+        embedding here — the causal offset and write slot come from each
+        layer's internal ``cache_index`` counter, so callers must keep
+        ``positions`` consistent with the number of tokens already decoded
+        (position t == t-th token fed to this cache)."""
+        if decode and positions is None:
+            raise ValueError(
+                "decode=True requires explicit positions (the pos-embed row "
+                "of each incoming token)")
+        if decode and self.cache_len is not None and (
+                self.cache_len > self.max_len):
+            # Cache slots past max_len would decode at silently-clamped
+            # pos-embed rows (gathers clamp), defeating the overflow poison.
+            raise ValueError(
+                f"cache_len={self.cache_len} exceeds the positional table "
+                f"(max_len={self.max_len})")
         if positions is None:
             # Unsharded path: the sequence length is static, so bound-check
             # it here — JAX gathers clamp out-of-range indices, which would
@@ -186,7 +213,8 @@ class TransformerLM(nn.Module):
                 moe_noisy_gate_policy=self.moe_noisy_gate_policy,
                 moe_mlp_type=self.moe_mlp_type,
                 moe_expert_axis=self.moe_expert_axis,
-                name=f"block{i}")(x, train=train)
+                cache_len=self.cache_len or self.max_len,
+                name=f"block{i}")(x, train=train, decode=decode)
         x = make_final_norm(self, name="ln_f")(x)
         return make_lm_head(self, name="lm_head")(x)
 

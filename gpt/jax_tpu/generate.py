@@ -1,0 +1,133 @@
+"""Text generation CLI for checkpoints trained by ``gpt/jax_tpu/train.py``.
+
+Completes the LM workload's lifecycle (train → checkpoint → generate); the
+reference has no inference surface at all (SURVEY.md §0). Model flags must
+match the training run so the checkpoint restores; sampling flags control
+the decode loop (``distributed_training_tpu/inference/sampler.py``).
+
+Byte-level I/O: prompts are encoded as UTF-8 bytes (the LM's default
+vocab is 256 = one token per byte), completions decoded the same way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def add_argument() -> argparse.Namespace:
+    parser = argparse.ArgumentParser(description="TransformerLM generation")
+    parser.add_argument("--prompt", type=str, default="The ",
+                        help="UTF-8 prompt, byte-tokenized")
+    parser.add_argument("--vocab-size", type=int, default=256)
+    parser.add_argument("--num-layers", type=int, default=4)
+    parser.add_argument("--num-heads", type=int, default=4)
+    parser.add_argument("--hidden-dim", type=int, default=256)
+    parser.add_argument("--max-len", type=int, default=2048)
+    parser.add_argument("--dtype", type=str, default="fp32",
+                        choices=["bf16", "fp16", "fp32"])
+    # MoE model flags (must match training, or the checkpoint tree won't
+    # restore — the decode path runs MoE FFNs position-wise like training).
+    parser.add_argument("--moe", action="store_true", default=False)
+    parser.add_argument("--num-experts", type=int, nargs="+", default=[8])
+    parser.add_argument("--moe-top-k", type=int, default=1,
+                        help="MoE gate top-k (train.py calls this --top-k; "
+                             "here --top-k is the sampling filter)")
+    parser.add_argument("--min-capacity", type=int, default=0)
+    parser.add_argument("--mlp-type", type=str, default="standard",
+                        choices=["standard", "residual"])
+    parser.add_argument("-c", "--checkpoint", type=str, default="./checkpoint")
+    parser.add_argument("-r", "--resume", type=int, default=-1,
+                        help="epoch to load; -1 = latest (random init if "
+                             "no checkpoint exists)")
+    parser.add_argument("--max-new-tokens", type=int, default=128)
+    parser.add_argument("--temperature", type=float, default=1.0,
+                        help="0 = greedy")
+    parser.add_argument("--top-k", type=int, default=None)
+    parser.add_argument("--top-p", type=float, default=None)
+    parser.add_argument("--eos-id", type=int, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    return parser.parse_args()
+
+
+def main() -> int:
+    args = add_argument()
+
+    import jax
+    import numpy as np
+
+    from distributed_training_tpu import checkpoint as ckpt_lib
+    from distributed_training_tpu.config import (
+        OptimizerConfig,
+        PrecisionConfig,
+        SchedulerConfig,
+    )
+    from distributed_training_tpu.inference import Generator, SampleConfig
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.train.optim import make_optimizer
+    from distributed_training_tpu.train.precision import LossScaleState, Policy
+    from distributed_training_tpu.train.train_state import init_train_state
+
+    precision = PrecisionConfig(dtype=args.dtype)
+    moe_kwargs = {}
+    if args.moe:
+        if len(args.num_experts) != 1:
+            raise SystemExit("per-layer expert counts are not supported; "
+                             "pass a single --num-experts value")
+        moe_kwargs = dict(
+            moe_num_experts=int(args.num_experts[0]),
+            moe_top_k=args.moe_top_k,
+            moe_min_capacity=args.min_capacity,
+            moe_mlp_type=args.mlp_type,
+        )
+    model = get_model(
+        "transformer_lm",
+        num_classes=args.vocab_size,
+        dtype=Policy.from_config(precision).compute_dtype,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        hidden_dim=args.hidden_dim,
+        max_len=args.max_len,
+        **moe_kwargs,
+    )
+
+    # Template state matching LMTrainer's tensor/dp construction — same
+    # optimizer factory, so the orbax opt-state tree round-trips; only
+    # params are consumed here.
+    tx = make_optimizer(OptimizerConfig(), SchedulerConfig(), world_size=1)
+    state = init_train_state(
+        model, jax.random.PRNGKey(args.seed), (1, 8), tx,
+        loss_scale=LossScaleState.create(precision), input_dtype=jax.numpy.int32)
+    epoch = args.resume
+    if epoch < 0:
+        latest = ckpt_lib.latest_epoch(args.checkpoint)
+        epoch = -1 if latest is None else latest
+    if epoch >= 0:
+        state, _ = ckpt_lib.restore_checkpoint(args.checkpoint, epoch, state)
+        print(f"[generate] restored epoch {epoch} from {args.checkpoint}")
+    else:
+        print("[generate] no checkpoint found; sampling from random init")
+
+    gen = Generator(model, state.params, SampleConfig(
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        eos_id=args.eos_id,
+    ))
+    prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)
+    if (prompt >= args.vocab_size).any():
+        bad = sorted(set(int(b) for b in prompt[prompt >= args.vocab_size]))
+        raise SystemExit(
+            f"prompt bytes {bad} are outside vocab_size={args.vocab_size}; "
+            "byte-level prompts need --vocab-size 256 (or an ASCII-only "
+            "prompt for smaller vocabs)")
+    prompt = prompt.astype(np.int32)
+    out = gen(prompt, rng=jax.random.PRNGKey(args.seed))[0]
+    text = bytes(int(t) % 256 for t in out).decode("utf-8", errors="replace")
+    print(f"[generate] {args.prompt!r} -> {text!r}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,262 @@
+"""Generation tests: KV-cache decode correctness + sampling transforms.
+
+The load-bearing property is *cache equivalence*: decode-mode forwards
+(chunked prefill + one-token steps against the KV cache) must produce the
+same logits as the ordinary full-sequence causal forward. Everything else
+(sampling filters, EOS handling) is unit-tested directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_tpu.inference import (
+    Generator,
+    SampleConfig,
+    apply_top_k,
+    apply_top_p,
+    sample_token,
+)
+from distributed_training_tpu.models import get_model
+
+VOCAB = 61  # deliberately not a power of two
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model(
+        "transformer_lm", num_classes=VOCAB, num_layers=2, num_heads=2,
+        hidden_dim=32, max_len=64)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return model, params
+
+
+def full_logits(model, params, tokens):
+    return model.apply({"params": params}, tokens, train=False)
+
+
+class TestCacheEquivalence:
+    def test_prefill_matches_full_forward(self, lm):
+        model, params = lm
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, VOCAB)
+        ref = full_logits(model, params, tokens)
+        positions = jnp.broadcast_to(jnp.arange(16), (2, 16))
+        got, _ = model.apply(
+            {"params": params}, tokens, positions=positions,
+            train=False, decode=True, mutable=["cache"])
+        np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+    def test_stepwise_decode_matches_full_forward(self, lm):
+        """Prefill 10 tokens, then 6 single-token steps == one 16-forward."""
+        model, params = lm
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, VOCAB)
+        ref = full_logits(model, params, tokens)
+
+        positions = jnp.broadcast_to(jnp.arange(10), (2, 10))
+        logits, vars_out = model.apply(
+            {"params": params}, tokens[:, :10], positions=positions,
+            train=False, decode=True, mutable=["cache"])
+        np.testing.assert_allclose(logits, ref[:, :10], rtol=2e-5, atol=2e-5)
+        cache = vars_out["cache"]
+        for t in range(10, 16):
+            pos = jnp.full((2, 1), t, jnp.int32)
+            logits, vars_out = model.apply(
+                {"params": params, "cache": cache}, tokens[:, t:t + 1],
+                positions=pos, train=False, decode=True, mutable=["cache"])
+            cache = vars_out["cache"]
+            np.testing.assert_allclose(
+                logits[:, 0], ref[:, t], rtol=2e-5, atol=2e-5)
+
+    def test_greedy_generation_matches_naive_rollout(self, lm):
+        """Cached greedy decode == re-running the full forward every step."""
+        model, params = lm
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0, VOCAB)
+        gen = Generator(model, params, SampleConfig(
+            max_new_tokens=8, temperature=0.0))
+        got = gen(prompt)
+
+        seq = prompt
+        for _ in range(8):
+            logits = full_logits(model, params, seq)
+            nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(got, np.asarray(seq[:, 5:]))
+
+
+class TestGenerator:
+    def test_1d_prompt_and_shapes(self, lm):
+        model, params = lm
+        out = Generator(model, params, SampleConfig(max_new_tokens=4))(
+            np.array([1, 2, 3]))
+        assert out.shape == (1, 4)
+        assert out.dtype == np.int32
+        assert ((0 <= out) & (out < VOCAB)).all()
+
+    def test_cache_overflow_rejected(self, lm):
+        model, params = lm
+        gen = Generator(model, params, SampleConfig(max_new_tokens=60))
+        with pytest.raises(ValueError, match="exceeds the KV cache"):
+            gen(np.zeros((1, 10), np.int32))
+
+    def test_seq_axis_model_rejected(self):
+        model = get_model(
+            "transformer_lm", num_classes=VOCAB, seq_axis="sequence",
+            num_layers=1, num_heads=2, hidden_dim=16, max_len=32)
+        with pytest.raises(ValueError, match="seq_axis=None"):
+            Generator(model, {}, SampleConfig())
+
+    def test_eos_pads_tail(self, lm):
+        """Force EOS as the argmax by construction: bias the lm_head."""
+        model, params = lm
+        eos = 7
+        biased = jax.tree.map(lambda x: x, params)  # shallow copy
+        head = dict(biased["lm_head"])
+        head["bias"] = head["bias"].at[eos].add(1e4)
+        biased = dict(biased)
+        biased["lm_head"] = head
+        gen = Generator(model, biased, SampleConfig(
+            max_new_tokens=6, temperature=0.0, eos_id=eos, pad_id=0))
+        out = gen(np.array([[1, 2]]))
+        # First emission is EOS (it is the argmax everywhere); rest is pad.
+        assert out[0, 0] == eos
+        assert (out[0, 1:] == 0).all()
+
+    def test_moe_model_decode_matches_full_forward(self):
+        """MoE FFNs run position-wise in decode; cache equivalence holds."""
+        model = get_model(
+            "transformer_lm", num_classes=VOCAB, num_layers=2, num_heads=2,
+            hidden_dim=32, max_len=32, moe_num_experts=4, moe_top_k=2)
+        tokens = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0, VOCAB)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        ref = model.apply({"params": params}, tokens, train=False)
+        positions = jnp.broadcast_to(jnp.arange(8), (2, 8))
+        got, _ = model.apply(
+            {"params": params}, tokens, positions=positions,
+            train=False, decode=True, mutable=["cache"])
+        # MoE capacity dispatch sees different token sets per call shape, so
+        # only the dense-block positions are bit-comparable; loose tolerance
+        # still pins the wiring (garbage cache → order-of-magnitude error).
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    def test_decode_past_cache_end_is_loud(self, lm):
+        """Steps beyond max_len must NaN-poison, not silently clamp."""
+        model, params = lm
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(8), (1, model.max_len), 0, VOCAB)
+        positions = jnp.broadcast_to(
+            jnp.arange(model.max_len), (1, model.max_len))
+        _, vars_out = model.apply(
+            {"params": params}, tokens, positions=positions,
+            train=False, decode=True, mutable=["cache"])
+        logits, _ = model.apply(
+            {"params": params, "cache": vars_out["cache"]},
+            tokens[:, :1], positions=jnp.full((1, 1), model.max_len),
+            train=False, decode=True, mutable=["cache"])
+        assert np.isnan(np.asarray(logits)).all()
+
+    def test_chunk_straddling_cache_end_is_loud(self, lm):
+        """A multi-token chunk that overflows poisons the WHOLE call: the
+        clamped write corrupts history, so even in-bounds rows are wrong."""
+        model, params = lm
+        n = model.max_len - 2
+        tokens = jax.random.randint(jax.random.PRNGKey(9), (1, n), 0, VOCAB)
+        _, vars_out = model.apply(
+            {"params": params}, tokens,
+            positions=jnp.broadcast_to(jnp.arange(n), (1, n)),
+            train=False, decode=True, mutable=["cache"])
+        chunk = jax.random.randint(jax.random.PRNGKey(10), (1, 4), 0, VOCAB)
+        logits, _ = model.apply(
+            {"params": params, "cache": vars_out["cache"]}, chunk,
+            positions=jnp.broadcast_to(n + jnp.arange(4), (1, 4)),
+            train=False, decode=True, mutable=["cache"])
+        assert np.isnan(np.asarray(logits)).all()
+
+    def test_cache_len_beyond_pos_table_rejected(self, lm):
+        model, params = lm
+        big = model.clone(cache_len=model.max_len + 8)
+        with pytest.raises(ValueError, match="exceeds the positional table"):
+            big.apply({"params": params}, jnp.zeros((1, 1), jnp.int32),
+                      positions=jnp.zeros((1, 1), jnp.int32),
+                      train=False, decode=True, mutable=["cache"])
+
+    def test_single_new_token(self, lm):
+        """max_new_tokens=1 is the scan-length-0 edge of the decode loop."""
+        model, params = lm
+        gen = Generator(model, params, SampleConfig(
+            max_new_tokens=1, temperature=0.0))
+        prompt = np.array([[1, 2, 3]])
+        out = gen(prompt)
+        ref = jnp.argmax(full_logits(model, params, jnp.asarray(prompt))[:, -1],
+                         axis=-1)
+        np.testing.assert_array_equal(out[:, 0], np.asarray(ref))
+
+    def test_default_rng_varies_per_call(self, lm):
+        model, params = lm
+        gen = Generator(model, params, SampleConfig(
+            max_new_tokens=6, temperature=1.0))
+        a = gen(np.array([[1, 2, 3]]))
+        b = gen(np.array([[1, 2, 3]]))
+        assert (a != b).any()
+
+    def test_sampled_generation_deterministic_under_rng(self, lm):
+        model, params = lm
+        gen = Generator(model, params, SampleConfig(
+            max_new_tokens=6, temperature=1.0, top_k=10))
+        a = gen(np.array([[1, 2, 3]]), rng=jax.random.PRNGKey(5))
+        b = gen(np.array([[1, 2, 3]]), rng=jax.random.PRNGKey(5))
+        c = gen(np.array([[1, 2, 3]]), rng=jax.random.PRNGKey(6))
+        np.testing.assert_array_equal(a, b)
+        assert (a != c).any()  # 61^6 sequences; collision ≈ impossible
+
+
+class TestSamplingTransforms:
+    def test_top_k_keeps_k(self):
+        logits = jnp.asarray([[1.0, 3.0, 2.0, 0.0, -1.0]])
+        out = apply_top_k(logits, 2)
+        np.testing.assert_array_equal(
+            np.isfinite(np.asarray(out))[0], [False, True, True, False, False])
+
+    def test_top_k_ties_keep_at_least_k(self):
+        logits = jnp.asarray([[2.0, 2.0, 2.0, 0.0]])
+        assert int(np.isfinite(np.asarray(apply_top_k(logits, 2))).sum()) >= 2
+
+    def test_top_p_nucleus(self):
+        # probs ≈ [0.643, 0.237, 0.087, 0.032] — p=0.8 keeps the first two
+        # (exclusive cumsum at rank2 = 0.88 >= 0.8).
+        logits = jnp.log(jnp.asarray([[0.643, 0.237, 0.087, 0.032]]))
+        out = apply_top_p(logits, 0.8)
+        np.testing.assert_array_equal(
+            np.isfinite(np.asarray(out))[0], [True, True, False, False])
+
+    def test_top_p_always_keeps_argmax(self):
+        logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]])
+        out = apply_top_p(logits, 0.01)
+        finite = np.isfinite(np.asarray(out))[0]
+        assert finite[0] and finite.sum() == 1
+
+    def test_greedy_is_argmax(self):
+        logits = jnp.asarray([[0.0, 5.0, 1.0], [9.0, 0.0, 1.0]])
+        out = sample_token(
+            jax.random.PRNGKey(0), logits, SampleConfig(temperature=0.0))
+        np.testing.assert_array_equal(np.asarray(out), [1, 0])
+
+    def test_filtered_sampling_stays_in_support(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(4, 32), jnp.float32)
+        cfg = SampleConfig(temperature=0.7, top_k=4)
+        allowed = np.argsort(np.asarray(logits), axis=-1)[:, -4:]
+        for seed in range(5):
+            toks = np.asarray(
+                sample_token(jax.random.PRNGKey(seed), logits, cfg))
+            for b in range(4):
+                assert toks[b] in allowed[b]
+
+    def test_invalid_args_rejected(self):
+        logits = jnp.zeros((1, 4))
+        with pytest.raises(ValueError):
+            apply_top_k(logits, 0)
+        with pytest.raises(ValueError):
+            apply_top_p(logits, 0.0)
+        with pytest.raises(ValueError):
+            apply_top_p(logits, 1.5)
