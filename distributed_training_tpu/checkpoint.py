@@ -25,12 +25,21 @@ def _epoch_dir(directory: str, epoch: int) -> str:
     return os.path.join(os.path.abspath(directory), f"epoch_{epoch}")
 
 
-def save_checkpoint(directory: str, epoch: int, state: Any) -> str:
-    """Save the train state after ``epoch``; returns the checkpoint path."""
+def save_checkpoint(directory: str, epoch: int, state: Any,
+                    next_epoch: int | None = None) -> str:
+    """Save the train state tagged ``epoch``; returns the checkpoint path.
+
+    ``next_epoch`` is the epoch a resume should start at — ``epoch + 1``
+    for the normal end-of-epoch save, or ``epoch`` itself for a preemption
+    save taken *mid*-epoch (the partial epoch re-runs from its
+    deterministic shuffle; see ``runtime/preemption.py``).
+    """
     path = _epoch_dir(directory, epoch)
     payload = {
         "state": serialization.to_state_dict(state),
-        "meta": {"epoch": np.int32(epoch)},
+        "meta": {"epoch": np.int32(epoch),
+                 "next_epoch": np.int32(
+                     epoch + 1 if next_epoch is None else next_epoch)},
     }
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, payload, force=True)
@@ -38,10 +47,10 @@ def save_checkpoint(directory: str, epoch: int, state: Any) -> str:
 
 
 def restore_checkpoint(directory: str, epoch: int, state: Any) -> tuple[Any, int]:
-    """Restore state saved after ``epoch``; returns (state, start_epoch).
+    """Restore the checkpoint tagged ``epoch``; returns (state, start_epoch).
 
-    ``start_epoch = epoch + 1`` — training resumes at the next epoch, which
-    is the semantics the Colossal CLI implies (``--resume <epoch>``).
+    ``start_epoch`` comes from the checkpoint's ``next_epoch`` meta
+    (normally ``epoch + 1`` — the Colossal ``--resume <epoch>`` semantics).
     """
     path = _epoch_dir(directory, epoch)
     if not os.path.isdir(path):
@@ -49,11 +58,33 @@ def restore_checkpoint(directory: str, epoch: int, state: Any) -> tuple[Any, int
     ckptr = ocp.PyTreeCheckpointer()
     template = {
         "state": serialization.to_state_dict(state),
-        "meta": {"epoch": np.int32(0)},
+        "meta": {"epoch": np.int32(0), "next_epoch": np.int32(0)},
     }
-    restored = ckptr.restore(path, item=template)
+    try:
+        restored = ckptr.restore(path, item=template)
+        next_epoch = int(restored["meta"]["next_epoch"])
+    except Exception:
+        # Pre-next_epoch checkpoints carry only {epoch}; restore with the
+        # old template and apply the old epoch+1 semantics.
+        template["meta"] = {"epoch": np.int32(0)}
+        restored = ckptr.restore(path, item=template)
+        next_epoch = int(restored["meta"]["epoch"]) + 1
     new_state = serialization.from_state_dict(state, restored["state"])
-    return new_state, int(restored["meta"]["epoch"]) + 1
+    return new_state, next_epoch
+
+
+def resolve_resume(ckpt_cfg) -> int:
+    """Resume epoch for a :class:`CheckpointConfig`: an explicit
+    ``resume >= 0`` wins; else ``auto_resume`` finds the newest save
+    (the preemption-restart pairing, ``runtime/preemption.py``); -1 = fresh.
+    """
+    if ckpt_cfg.resume >= 0:
+        return ckpt_cfg.resume
+    if ckpt_cfg.auto_resume:
+        latest = latest_epoch(ckpt_cfg.directory)
+        if latest is not None:
+            return latest
+    return -1
 
 
 def latest_epoch(directory: str) -> int | None:

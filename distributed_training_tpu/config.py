@@ -150,11 +150,18 @@ class CheckpointConfig:
     interval: int = 5          # epochs between saves
     resume: int = -1           # epoch to resume from; -1 = fresh
     keep: int = 3              # retained checkpoints
+    # Preemption safety (the failure-handling subsystem the reference lacks,
+    # SURVEY.md §5): resume from the newest checkpoint in `directory` when
+    # present, and save one on SIGTERM before returning.
+    auto_resume: bool = False
+    save_on_preemption: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
 class DataConfig:
-    dataset: str = "cifar10"   # cifar10 | synthetic_imagenet | synthetic_cifar
+    # cifar10 | synthetic_cifar | synthetic_imagenet | imagefolder
+    # (imagefolder = lazy <data_path>/{train,val}/<class>/<img> trees)
+    dataset: str = "cifar10"
     data_path: str | None = None  # None → $DATA or ../data (ddp_train.py:34)
     batch_size: int = 100      # per-device (ddp_train.py:111)
     global_batch_size: int | None = None  # ds-style; overrides batch_size
@@ -211,6 +218,13 @@ class TrainConfig:
     model: str = "resnet18"
     plugin: str = "torch_ddp"
     num_epochs: int = 5        # all three trainers (ddp_train.py:108)
+    # DeepSpeed semantics: effective batch = micro/device × accum × world.
+    # The step consumes one effective batch and scans accum microbatches
+    # through fwd/bwd before the single optimizer update.
+    gradient_accumulation_steps: int = 1
+    # Uniform label smoothing for the classification CE (ImageNet recipe);
+    # 0 = the reference's plain nn.CrossEntropyLoss.
+    label_smoothing: float = 0.0
     seed: int = 0
     log_interval: int = 100    # steps between host-side loss fetches
     target_acc: float | None = None  # colossal_train.py:43-46, wired here
@@ -228,6 +242,9 @@ class TrainConfig:
     # Profiling: ds_config "wall_clock_breakdown" (deepspeed_train.py:209).
     wall_clock_breakdown: bool = False
     profile_dir: str | None = None
+    # Durable metric sinks (master-only, written at log_interval flushes).
+    tensorboard_dir: str | None = None
+    metrics_jsonl: str | None = None
 
     def replace(self, **kw: Any) -> "TrainConfig":
         return dataclasses.replace(self, **kw)
@@ -265,6 +282,44 @@ class TrainConfig:
         return cfg.replace(**overrides) if overrides else cfg
 
 
+def effective_batch_sizes(cfg: TrainConfig, world: int,
+                          allow_derive: bool = True) -> tuple[int, int, int]:
+    """Resolve ``(train_global_batch, eval_global_batch, accum_steps)``.
+
+    DeepSpeed's batch triple semantics (train_batch_size = micro × accum ×
+    world), resolved at the one place world size is known (the trainers):
+
+    - no ``global_batch_size``: effective = batch_size × world × accum.
+    - ``global_batch_size`` set and an exact >1 multiple of batch_size ×
+      world while accum was left at 1: accum is *derived* (DeepSpeed:
+      ``accum = train_batch_size / (micro × world)``). Callers whose step
+      cannot accumulate (shard_map local-BN, sequence/pipeline LM
+      strategies) pass ``allow_derive=False`` to keep the whole global
+      batch as one step instead of failing on an unsupported accum.
+    - otherwise ``global_batch_size`` wins as the effective batch (the
+      reference's ds_config sets only ``train_batch_size: 96``,
+      ``deepspeed_train.py:173``) and must divide by accum.
+
+    Eval always runs micro-sized batches: the optimizer never sees an eval
+    batch, and accumulation exists precisely because effective-batch
+    forwards don't fit.
+    """
+    accum = cfg.gradient_accumulation_steps
+    if accum < 1:
+        raise ValueError(f"gradient_accumulation_steps must be >= 1, got {accum}")
+    micro_gbs = cfg.data.batch_size * world
+    gbs = cfg.data.global_batch_size
+    if gbs is None:
+        return micro_gbs * accum, micro_gbs, accum
+    if allow_derive and accum == 1 and gbs > micro_gbs and gbs % micro_gbs == 0:
+        accum = gbs // micro_gbs
+    if gbs % accum:
+        raise ValueError(
+            f"global batch {gbs} not divisible by "
+            f"gradient_accumulation_steps={accum}")
+    return gbs, gbs // accum, accum
+
+
 def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> TrainConfig:
     """Ingest a DeepSpeed-style config dict.
 
@@ -275,6 +330,7 @@ def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> Tr
     cfg = base or TrainConfig.from_plugin("deepspeed")
     known = {
         "train_batch_size", "train_micro_batch_size_per_gpu", "steps_per_print",
+        "gradient_accumulation_steps",
         "optimizer", "scheduler", "gradient_clipping", "prescale_gradients",
         "bf16", "fp16", "wall_clock_breakdown", "zero_optimization",
     }
@@ -352,6 +408,9 @@ def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> Tr
 
     return cfg.replace(
         optimizer=opt, scheduler=sched, precision=prec, zero=zero, data=data,
+        gradient_accumulation_steps=int(
+            ds.get("gradient_accumulation_steps",
+                   cfg.gradient_accumulation_steps)),
         log_interval=int(ds.get("steps_per_print", cfg.log_interval)),
         wall_clock_breakdown=bool(ds.get("wall_clock_breakdown", cfg.wall_clock_breakdown)),
     )

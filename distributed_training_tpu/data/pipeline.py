@@ -18,6 +18,7 @@ TPU-native replacement for ``DataLoader + DistributedSampler``
 
 from __future__ import annotations
 
+import os
 from typing import Iterator
 
 import jax
@@ -135,7 +136,9 @@ def to_global_batch(batch: dict, mesh: Mesh, shardings: dict) -> dict:
     }
 
 
-def build_dataloaders(cfg, coordinator=None, *, seed: int = 0):
+def build_dataloaders(cfg, coordinator=None, *, seed: int = 0,
+                      global_batch_size: int | None = None,
+                      eval_global_batch_size: int | None = None):
     """Build (train_loader, eval_loader) per the data config.
 
     Mirrors the reference's ``build_dataloader(batch_size)`` surface
@@ -143,10 +146,48 @@ def build_dataloaders(cfg, coordinator=None, *, seed: int = 0):
     download serialization (here: any expensive materialization) via
     ``coordinator.priority_execution()``
     (``resnet/colossal/colossal_train.py:65-73``).
+
+    ``global_batch_size`` / ``eval_global_batch_size`` override the config
+    derivation — the trainers pass ``config.effective_batch_sizes`` results
+    so gradient accumulation scales only the train loader.
     """
     data = cfg.data
     world = jax.device_count()
-    global_bs = data.global_batch_size or data.batch_size * world
+    global_bs = (global_batch_size or
+                 data.global_batch_size or data.batch_size * world)
+    eval_bs = eval_global_batch_size or global_bs
+
+    if data.dataset == "imagefolder":
+        # Lazy directory-tree datasets (ImageNet layout): root/train and
+        # root/val (torchvision convention), decoded per batch on threads.
+        from distributed_training_tpu.data.imagefolder import (
+            ImageFolderLoader,
+            scan_imagefolder,
+        )
+
+        if not data.data_path:
+            raise ValueError("dataset='imagefolder' requires data_path")
+        common = dict(image_size=data.image_size, seed=seed,
+                      num_workers=data.num_workers, augment=data.augment)
+        tr_paths, tr_labels, classes = scan_imagefolder(
+            os.path.join(data.data_path, "train"))
+        ev_paths, ev_labels, ev_classes = scan_imagefolder(
+            os.path.join(data.data_path, "val"))
+        if classes != ev_classes:
+            raise ValueError(
+                f"train/val class mismatch: {classes} vs {ev_classes}")
+        if len(classes) != data.num_classes:
+            raise ValueError(
+                f"found {len(classes)} classes under {data.data_path}, "
+                f"config says num_classes={data.num_classes}")
+        train_loader = ImageFolderLoader(
+            tr_paths, tr_labels, global_batch_size=global_bs, shuffle=True,
+            drop_last=data.drop_last, train=True,
+            max_steps=data.max_steps_per_epoch, **common)
+        eval_loader = ImageFolderLoader(
+            ev_paths, ev_labels, global_batch_size=eval_bs, shuffle=False,
+            drop_last=False, train=False, **common)
+        return train_loader, eval_loader
 
     def _load():
         if data.dataset == "cifar10":
@@ -175,6 +216,6 @@ def build_dataloaders(cfg, coordinator=None, *, seed: int = 0):
         drop_last=data.drop_last, augment=data.augment, train=True, seed=seed,
         max_steps=data.max_steps_per_epoch)
     eval_loader = ShardedDataLoader(
-        eval_x, eval_y, global_batch_size=global_bs, shuffle=False,
+        eval_x, eval_y, global_batch_size=eval_bs, shuffle=False,
         drop_last=False, augment=data.augment, train=False, seed=seed)
     return train_loader, eval_loader

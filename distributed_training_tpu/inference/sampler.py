@@ -41,6 +41,15 @@ class SampleConfig:
     eos_id: int | None = None  # stop emitting after this token appears
     pad_id: int = 0            # filler after EOS
 
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature} "
+                "(negative values would invert the distribution)")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+
 
 def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     """Mask all but the k highest logits to -inf. [..., V] -> [..., V]."""

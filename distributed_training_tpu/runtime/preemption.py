@@ -1,0 +1,84 @@
+"""Preemption handling: catch SIGTERM, checkpoint, exit clean.
+
+The reference has no failure handling at all (SURVEY.md §5 "Failure
+detection / elastic recovery": absent — ``destroy_process_group`` on clean
+exit is the entire lifecycle). The TPU-native story the survey plans is
+"checkpoint-restart on preemption": cloud TPU VMs get a SIGTERM grace
+window before eviction, so the trainer flips a flag on SIGTERM, finishes
+the in-flight step, saves a checkpoint, and returns — paired with
+``auto_resume`` (restore the latest checkpoint at startup) the run is
+preemption-safe end to end.
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Iterable
+
+
+class PreemptionGuard:
+    """Latches termination signals into a poll-able flag.
+
+    Usage::
+
+        with PreemptionGuard() as guard:
+            for batch in loader:
+                step(batch)
+                if guard.triggered:
+                    save_checkpoint(...)
+                    break
+
+    The first signal sets the flag (graceful path); a second one re-raises
+    via the previous handler — repeated SIGTERM means "now", and the default
+    disposition terminates.
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,)):
+        self._signals = tuple(signals)
+        self._previous: dict[int, object] = {}
+        self.triggered = False
+
+    def _handle(self, signum, frame):
+        if self.triggered:  # second signal: defer to the previous handler
+            prev = self._previous.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signum, signal.SIG_DFL)
+                signal.raise_signal(signum)
+            return
+        self.triggered = True
+
+    def __enter__(self) -> "PreemptionGuard":
+        for s in self._signals:
+            self._previous[s] = signal.signal(s, self._handle)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous.clear()
+
+    def should_stop(self, at_sync_point: bool = True) -> bool:
+        """Whether the step loop should break NOW.
+
+        Single-process: the local flag, checked every step. Multi-host: the
+        eviction signal lands on each host at a different time, so a local
+        break would desync the hosts — one blocks in the next step's
+        gradient collective, the other in the checkpoint save, and both
+        hang out the grace window. Instead the flag is agreed on via an
+        all-gather-max, but only at ``at_sync_point`` steps (the trainers
+        pass their log-interval flush boundaries, which are deterministic
+        and common across hosts) so the steady-state step stays sync-free.
+        """
+        import jax
+
+        if jax.process_count() == 1:
+            return self.triggered
+        if not at_sync_point:
+            return False
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        flag = np.asarray([np.float32(self.triggered)])
+        return bool(multihost_utils.process_allgather(flag).max() > 0)

@@ -70,13 +70,16 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng, positions=None):
 
 
 def _lm_metrics(new_state: TrainState, ce, aux, logits, targets, finite,
-                pmean_axes=None):
+                pmean_axes=None, accuracy=None):
     """The LM metrics contract; ``pmean_axes`` averages shard-local values
     (the GSPMD path computes global values already). ``loss`` is the full
     objective (CE + MoE aux); ``perplexity`` is ``exp(CE)`` so it stays
-    comparable to eval perplexity."""
-    accuracy = jnp.mean(
-        (jnp.argmax(logits, -1) == targets).astype(jnp.float32))
+    comparable to eval perplexity. ``accuracy`` may be precomputed (the
+    grad-accum path averages it across microbatches; pass logits/targets as
+    None then) — keep this dict the single source of the metric key set."""
+    if accuracy is None:
+        accuracy = jnp.mean(
+            (jnp.argmax(logits, -1) == targets).astype(jnp.float32))
     if pmean_axes:
         ce = lax.pmean(ce, pmean_axes)
         aux = lax.pmean(aux, pmean_axes)
@@ -165,15 +168,42 @@ def _make_gspmd_lm_step(
     *,
     max_len: int | None = None,
     donate: bool = True,
+    grad_accum_steps: int = 1,
 ) -> Callable:
     """Shared GSPMD LM step builder (the TP and PP steps differ only in how
     the train state is placed): batch over ``data``, lazy jit once a
     concrete state's pytree is known, placements from ``state_shardings_fn``.
+
+    ``grad_accum_steps > 1`` scans microbatches through fwd/bwd inside the
+    compiled step before the single update (DeepSpeed
+    ``gradient_accumulation_steps`` semantics; see ``train/step.py``).
     """
+    from distributed_training_tpu.train.step import accumulate_grads
+
+    if grad_accum_steps < 1:
+        raise ValueError(
+            f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     batch_sh = {"tokens": NamedSharding(mesh, P(AXIS_DATA, None)),
                 "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
 
     def body(state: TrainState, batch, rng):
+        if grad_accum_steps > 1:
+            def micro_fn(params, mbatch, r, carry):
+                grads, ce, aux, logits = _lm_loss_and_grads(
+                    state.replace(params=params), mbatch["tokens"],
+                    mbatch["targets"], r)
+                acc = jnp.mean((jnp.argmax(logits, -1) ==
+                                mbatch["targets"]).astype(jnp.float32))
+                return grads, carry, (ce, aux, acc)
+
+            grads, _, (ces, auxs, accs) = accumulate_grads(
+                state.params, batch, rng, grad_accum_steps, mesh, micro_fn,
+                init_carry=jnp.zeros(()))
+            grads = state.loss_scale.unscale_grads(grads)
+            new_state, finite = commit_gradients(state, grads)
+            return new_state, _lm_metrics(
+                new_state, ces.mean(), auxs.mean(), None, None, finite,
+                accuracy=accs.mean())
         grads, ce, aux, logits = _lm_loss_and_grads(
             state, batch["tokens"], batch["targets"], rng)
         grads = state.loss_scale.unscale_grads(grads)
@@ -206,6 +236,7 @@ def _make_gspmd_lm_step(
 
 def make_tp_lm_train_step(
     mesh: Mesh, *, model, zero_stage: int = 0, donate: bool = True,
+    grad_accum_steps: int = 1,
 ) -> Callable:
     """Tensor-parallel (megatron-style) LM train step via GSPMD placement.
 
@@ -239,7 +270,8 @@ def make_tp_lm_train_step(
     return _make_gspmd_lm_step(
         mesh,
         lambda state: tp_state_shardings(state, mesh, zero_stage=zero_stage),
-        max_len=model.max_len, donate=donate)
+        max_len=model.max_len, donate=donate,
+        grad_accum_steps=grad_accum_steps)
 
 
 def make_pp_lm_train_step(

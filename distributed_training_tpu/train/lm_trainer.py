@@ -29,7 +29,7 @@ import numpy as np
 import optax
 
 from distributed_training_tpu import checkpoint as ckpt_lib
-from distributed_training_tpu.config import TrainConfig
+from distributed_training_tpu.config import TrainConfig, effective_batch_sizes
 from distributed_training_tpu.data.lm_text import (
     TokenLoader,
     byte_corpus,
@@ -60,7 +60,9 @@ from distributed_training_tpu.train.train_state import (
     init_train_state,
     param_count,
 )
+from distributed_training_tpu.runtime.preemption import PreemptionGuard
 from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
+from distributed_training_tpu.utils.metrics_io import MetricsWriter
 from distributed_training_tpu.utils.profiling import WallClock, trace
 
 
@@ -171,6 +173,14 @@ class LMTrainer:
             **moe_kwargs,
         )
         self.world_size = data_axis_size(self.mesh)
+        self.train_gbs, self.eval_gbs, self.grad_accum = effective_batch_sizes(
+            cfg, self.world_size,
+            allow_derive=self.strategy == "tensor/dp")
+        if self.grad_accum > 1 and self.strategy != "tensor/dp":
+            raise NotImplementedError(
+                "gradient accumulation composes with the tensor/dp strategy "
+                f"only (the {self.strategy} step has its own microbatching "
+                f"story); got gradient_accumulation_steps={self.grad_accum}")
         self.tx = make_optimizer(cfg.optimizer, cfg.scheduler, self.world_size)
         loss_scale = LossScaleState.create(cfg.precision)
 
@@ -195,7 +205,8 @@ class LMTrainer:
             self.shardings = jax.tree.map(lambda _: repl, state)
         else:
             self.train_step = make_tp_lm_train_step(
-                self.mesh, model=self.model, zero_stage=cfg.zero.stage)
+                self.mesh, model=self.model, zero_stage=cfg.zero.stage,
+                grad_accum_steps=self.grad_accum)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -228,12 +239,17 @@ class LMTrainer:
 
         self.meter = MetricMeter(cfg.log_interval)
         self.clock = WallClock(cfg.wall_clock_breakdown)
+        self.metrics_writer = MetricsWriter(
+            cfg.tensorboard_dir, cfg.metrics_jsonl,
+            enabled=self.coord.is_master())
+        self._guard: PreemptionGuard | None = None
         self._global_step = 0
         self.coord.print(
             f"[lm_trainer] params={param_count(state.params):,} "
             f"mesh={shape} strategy={self.strategy} "
             f"zero_stage={cfg.zero.stage} dtype={cfg.precision.dtype} "
-            f"seq_len={lm.seq_len}")
+            f"seq_len={lm.seq_len}"
+            + (f" grad_accum={self.grad_accum}" if self.grad_accum > 1 else ""))
 
     # -- data ---------------------------------------------------------------
     def make_loaders(self) -> tuple[TokenLoader, TokenLoader]:
@@ -253,11 +269,13 @@ class LMTrainer:
             evals = synthetic_tokens(
                 lm.eval_sequences, lm.seq_len, lm.vocab_size,
                 seed=self.cfg.seed + 1)
-        gbs = (self.cfg.data.global_batch_size or
-               self.cfg.data.batch_size * self.world_size)
         def mk(toks, train_mode):
+            # Train consumes effective batches; eval stays micro-sized.
             return TokenLoader(
-                toks, global_batch_size=gbs, shuffle=train_mode,
+                toks,
+                global_batch_size=(self.train_gbs if train_mode
+                                   else self.eval_gbs),
+                shuffle=train_mode,
                 seed=self.cfg.seed,
                 max_steps=(self.cfg.data.max_steps_per_epoch
                            if train_mode else None))
@@ -300,7 +318,17 @@ class LMTrainer:
                 bar.update()
                 if fetched:
                     bar.set_postfix(self.meter.last)
-        bar.set_postfix(self.meter.flush())
+                    self.metrics_writer.write(
+                        self.meter.last["step"], self.meter.last)
+            if self._guard is not None and self._guard.should_stop(
+                    at_sync_point=fetched):
+                break
+        # Flush the epoch tail only if steps are actually pending — an
+        # unconditional write would duplicate the last interval's point.
+        if self.meter.pending:
+            flushed = self.meter.flush()
+            self.metrics_writer.write(flushed["step"], flushed)
+        bar.set_postfix(self.meter.last)
         bar.close()
         if self.cfg.wall_clock_breakdown:
             self.coord.print(f"[wall_clock] {self.clock.report()}")
@@ -317,24 +345,48 @@ class LMTrainer:
                 "eval loader yielded no batches (eval_sequences "
                 f"{self.cfg.lm.eval_sequences} < global batch "
                 f"{loader.global_batch_size}? drop_last discards partials)")
-        return float(np.exp(np.mean(losses)))
+        ppl = float(np.exp(np.mean(losses)))
+        self.metrics_writer.write(
+            self._global_step, {"perplexity": ppl}, prefix="eval")
+        return ppl
 
     # -- full run -----------------------------------------------------------
     def fit(self) -> dict:
+        try:
+            return self._fit()
+        finally:
+            self.metrics_writer.close()
+
+    def _fit(self) -> dict:
         cfg = self.cfg
         train_loader, eval_loader = self.make_loaders()
 
         start_epoch = 0
-        if cfg.checkpoint.resume >= 0:
+        resume = ckpt_lib.resolve_resume(cfg.checkpoint)
+        if resume >= 0:
             self.state, start_epoch = ckpt_lib.restore_checkpoint(
-                cfg.checkpoint.directory, cfg.checkpoint.resume, self.state)
+                cfg.checkpoint.directory, resume, self.state)
             self.state = place_state(self.state, self.shardings)
+            # Metric sinks continue the restored step axis (see trainer.py).
+            self._global_step = int(jax.device_get(self.state.step))
             self.coord.print(f"[lm_trainer] resumed at epoch {start_epoch}")
 
         ppl = None
-        with trace(cfg.profile_dir):
+        preempted = False
+        with trace(cfg.profile_dir), PreemptionGuard() as guard:
+            self._guard = guard
             for epoch in range(start_epoch, cfg.num_epochs):
                 self.train_epoch(epoch, train_loader)
+                if guard.should_stop():
+                    preempted = True
+                    if cfg.checkpoint.save_on_preemption:
+                        ckpt_lib.save_checkpoint(
+                            cfg.checkpoint.directory, epoch, self.state,
+                            next_epoch=epoch)
+                        self.coord.print(
+                            f"[lm_trainer] SIGTERM: saved preemption "
+                            f"checkpoint (resumes at epoch {epoch})")
+                    break
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                     ppl = self.evaluate(eval_loader)
                     self.coord.print(
@@ -345,6 +397,7 @@ class LMTrainer:
                         cfg.checkpoint.directory, epoch, self.state)
                     ckpt_lib.prune_checkpoints(
                         cfg.checkpoint.directory, cfg.checkpoint.keep)
-
-        return {"final_perplexity": ppl, "last_metrics": self.meter.last,
+        self._guard = None
+        return {"final_perplexity": ppl, "preempted": preempted,
+                "last_metrics": self.meter.last,
                 "steps": int(jax.device_get(self.state.step))}

@@ -49,12 +49,21 @@ from distributed_training_tpu.train.train_state import TrainState
 from distributed_training_tpu.utils.compat import shard_map
 
 
-def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """Mean softmax CE over the (local) batch — ``nn.CrossEntropyLoss`` parity."""
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
+                       label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Mean softmax CE over the (local) batch — ``nn.CrossEntropyLoss``
+    parity; ``label_smoothing`` blends the one-hot target with uniform mass
+    (the standard ImageNet-recipe regularizer)."""
+    if label_smoothing:
+        n = logits.shape[-1]
+        targets = optax.smooth_labels(
+            jax.nn.one_hot(labels, n), label_smoothing)
+        return optax.softmax_cross_entropy(logits, targets).mean()
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
-def _forward_and_loss(state: TrainState, params, batch, rng, train: bool):
+def _forward_and_loss(state: TrainState, params, batch, rng, train: bool,
+                      label_smoothing: float = 0.0):
     variables = {"params": params}
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
@@ -72,21 +81,108 @@ def _forward_and_loss(state: TrainState, params, batch, rng, train: bool):
         logits = state.apply_fn(variables, batch["image"], train=False)
         new_batch_stats = state.batch_stats
         aux = jnp.float32(0)
-    loss = cross_entropy_loss(logits, batch["label"]) + aux
+    loss = cross_entropy_loss(logits, batch["label"], label_smoothing) + aux
     return loss, logits, new_batch_stats
 
 
-def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None):
+def microbatches(batch, accum_steps: int, mesh: Mesh | None = None):
+    """Reshape batch leaves [G, ...] -> [accum, G/accum, ...].
+
+    Under GSPMD (``mesh`` given) the microbatch dim is constrained unsharded
+    with ``data`` moved to dim 1, so every microbatch stays sharded the way
+    a full batch would be (one redistribution of the input batch per step —
+    cheap next to accum× the compute).
+    """
+    def resh(x):
+        if x.shape[0] % accum_steps:
+            raise ValueError(
+                f"batch dim {x.shape[0]} not divisible by "
+                f"gradient_accumulation_steps={accum_steps}")
+        x = x.reshape((accum_steps, x.shape[0] // accum_steps) + x.shape[1:])
+        if mesh is not None:
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(
+                    mesh, P(None, AXIS_DATA, *([None] * (x.ndim - 2)))))
+        return x
+    return jax.tree.map(resh, batch)
+
+
+def accumulate_grads(params, batch, rng, accum_steps: int, mesh: Mesh | None,
+                     micro_fn, init_carry):
+    """Shared gradient-accumulation scan (used by the image and LM steps).
+
+    ``micro_fn(params, mbatch, r, carry) -> (grads, new_carry, aux_tuple)``
+    runs one microbatch's fwd/bwd; grads are summed across the scan and
+    averaged, ``carry`` threads sequentially (e.g. BatchNorm EMA state),
+    and each ``aux_tuple`` element comes back stacked along the scan dim.
+    Returns ``(avg_grads, final_carry, stacked_aux)``.
+    """
+    mb = microbatches(batch, accum_steps, mesh)
+    rngs = jax.random.split(rng, accum_steps)
+
+    def body(c, xs):
+        gsum, carry = c
+        mbatch, r = xs
+        grads, carry, aux = micro_fn(params, mbatch, r, carry)
+        return (jax.tree.map(jnp.add, gsum, grads), carry), aux
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (gsum, carry), aux = jax.lax.scan(body, (zeros, init_carry), (mb, rngs))
+    return jax.tree.map(lambda g: g / accum_steps, gsum), carry, aux
+
+
+def _accum_grads_and_stats(state: TrainState, batch, rng, accum_steps: int,
+                           mesh: Mesh | None, label_smoothing: float = 0.0):
+    """Image-step accumulation: BatchNorm running stats thread sequentially
+    through the scan (torch grad-accum semantics: every microbatch forward
+    ticks the EMA). Returns (avg grads, mean loss, mean accuracy, stats)."""
+
+    def micro_fn(params, mbatch, r, bs):
+        def loss_fn(p):
+            loss, logits, new_bs = _forward_and_loss(
+                state.replace(batch_stats=bs), p, mbatch, r, train=True,
+                label_smoothing=label_smoothing)
+            return state.loss_scale.scale_loss(loss), (loss, logits, new_bs)
+
+        grads, (loss, logits, new_bs) = jax.grad(
+            loss_fn, has_aux=True)(params)
+        acc = jnp.mean(
+            (jnp.argmax(logits, -1) == mbatch["label"]).astype(jnp.float32))
+        return grads, new_bs, (loss, acc)
+
+    grads, new_bs, (losses, accs) = accumulate_grads(
+        state.params, batch, rng, accum_steps, mesh, micro_fn,
+        state.batch_stats)
+    return grads, losses.mean(), accs.mean(), new_bs
+
+
+def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
+               accum_steps: int = 1, mesh: Mesh | None = None,
+               label_smoothing: float = 0.0):
     """Shared step body for the GSPMD and shard_map paths.
 
     When ``axis_name`` is set (shard_map path), gradients/metrics are
     explicitly ``lax.pmean``-ed over that axis — the hand-written analogue of
     DDP's bucketed NCCL all-reduce. When None (GSPMD path), the same
-    collective is inserted by the partitioner.
+    collective is inserted by the partitioner. ``accum_steps > 1`` (GSPMD
+    only) scans microbatches through fwd/bwd before the single update.
     """
+    if accum_steps > 1:
+        grads, loss, accuracy, new_batch_stats = _accum_grads_and_stats(
+            state, batch, rng, accum_steps, mesh, label_smoothing)
+        grads = state.loss_scale.unscale_grads(grads)
+        new_state, finite = commit_gradients(state, grads, new_batch_stats)
+        return new_state, {
+            "loss": loss.astype(jnp.float32),
+            "accuracy": accuracy,
+            "loss_scale": new_state.loss_scale.scale,
+            "grads_finite": finite.astype(jnp.float32),
+        }
 
     def loss_fn(params):
-        loss, logits, new_bs = _forward_and_loss(state, params, batch, rng, train=True)
+        loss, logits, new_bs = _forward_and_loss(
+            state, params, batch, rng, train=True,
+            label_smoothing=label_smoothing)
         return state.loss_scale.scale_loss(loss), (loss, logits, new_bs)
 
     grads, (loss, logits, new_batch_stats) = jax.grad(
@@ -128,13 +224,23 @@ def make_train_step(
     *,
     zero_stage: int = 0,
     donate: bool = True,
+    grad_accum_steps: int = 1,
+    label_smoothing: float = 0.0,
 ) -> Callable:
     """Build the GSPMD jitted train step for a mesh + ZeRO stage.
 
     Returns ``step(state, batch, rng) -> (state, metrics)``. Shardings are
     resolved lazily from the first state's structure (abstract eval — no
     device transfer) and cached on the returned closure.
+
+    ``grad_accum_steps > 1``: the batch is the *effective* batch
+    (micro × accum × world); the step scans accum microbatches through
+    fwd/bwd and applies ONE optimizer update on the averaged gradient —
+    DeepSpeed's ``gradient_accumulation_steps`` semantics, but as a single
+    XLA program instead of engine-level micro-steps.
     """
+    if grad_accum_steps < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     cache: dict[Any, Callable] = {}
 
     def step(state: TrainState, batch, rng):
@@ -147,7 +253,11 @@ def make_train_step(
                 "label": batch_sharding(mesh, batch["label"].ndim),
             }
             fn = jax.jit(
-                functools.partial(_step_body, axis_name=None),
+                functools.partial(
+                    _step_body, axis_name=None,
+                    accum_steps=grad_accum_steps,
+                    mesh=mesh if grad_accum_steps > 1 else None,
+                    label_smoothing=label_smoothing),
                 in_shardings=(sshard, bshard, replicated(mesh)),
                 out_shardings=(sshard, replicated(mesh)),
                 donate_argnums=(0,) if donate else (),
@@ -158,7 +268,8 @@ def make_train_step(
     return step
 
 
-def make_shard_map_train_step(mesh: Mesh, donate: bool = True) -> Callable:
+def make_shard_map_train_step(mesh: Mesh, donate: bool = True,
+                              label_smoothing: float = 0.0) -> Callable:
     """Explicit-collective DP train step (``shard_map`` + ``lax.pmean``).
 
     The hand-written formulation of DDP's gradient all-reduce
@@ -172,7 +283,8 @@ def make_shard_map_train_step(mesh: Mesh, donate: bool = True) -> Callable:
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: TrainState, batch, rng):
         sharded = shard_map(
-            functools.partial(_step_body, axis_name=AXIS_DATA),
+            functools.partial(_step_body, axis_name=AXIS_DATA,
+                              label_smoothing=label_smoothing),
             mesh,
             in_specs=(
                 jax.tree.map(lambda _: P(), state),
@@ -187,7 +299,7 @@ def make_shard_map_train_step(mesh: Mesh, donate: bool = True) -> Callable:
 
 
 def make_eval_step(mesh: Mesh | None = None) -> Callable:
-    """Jitted eval step: per-batch (correct_count, example_count).
+    """Jitted eval step: per-batch (top1_count, top5_count, example_count).
 
     The reference builds a ``test_dataloader`` but never consumes it
     (SURVEY.md §2.5); this wires the missing eval pass so the
@@ -199,11 +311,18 @@ def make_eval_step(mesh: Mesh | None = None) -> Callable:
     def eval_body(state: TrainState, batch):
         _, logits, _ = _forward_and_loss(
             state, state.params, batch, jax.random.PRNGKey(0), train=False)
-        correct = (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32)
+        labels = batch["label"]
+        correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+        # Top-5 (the second ImageNet-standard metric); degenerates to top-1
+        # when the label space is smaller than 5.
+        k = min(5, logits.shape[-1])
+        _, topk = jax.lax.top_k(logits, k)
+        correct5 = jnp.any(topk == labels[:, None], axis=-1).astype(jnp.float32)
         mask = batch.get("mask")
         if mask is None:
             mask = jnp.ones_like(correct)
-        return jnp.sum(correct * mask), jnp.sum(mask)
+        return (jnp.sum(correct * mask), jnp.sum(correct5 * mask),
+                jnp.sum(mask))
 
     if mesh is None:
         return jax.jit(eval_body)
@@ -220,7 +339,7 @@ def make_eval_step(mesh: Mesh | None = None) -> Callable:
             fn = jax.jit(
                 eval_body,
                 in_shardings=(None, shardings),
-                out_shardings=(replicated(mesh), replicated(mesh)),
+                out_shardings=(replicated(mesh),) * 3,
             )
             cache[key] = fn
         return fn(state, batch)

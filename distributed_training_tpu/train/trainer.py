@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_training_tpu import checkpoint as ckpt_lib
-from distributed_training_tpu.config import TrainConfig
+from distributed_training_tpu.config import TrainConfig, effective_batch_sizes
 from distributed_training_tpu.data.pipeline import build_dataloaders, to_global_batch
 from distributed_training_tpu.data.prefetch import DevicePrefetcher
 from distributed_training_tpu.models import get_model
@@ -37,7 +37,9 @@ from distributed_training_tpu.train.step import (
     make_train_step,
 )
 from distributed_training_tpu.train.train_state import init_train_state, param_count
+from distributed_training_tpu.runtime.preemption import PreemptionGuard
 from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
+from distributed_training_tpu.utils.metrics_io import MetricsWriter
 from distributed_training_tpu.utils.profiling import WallClock, trace
 
 
@@ -107,29 +109,57 @@ class Trainer:
         # BatchNorm state; BN-free models (ViT, MoE-MLP) always take the
         # GSPMD path, where ZeRO placement composes.
         has_bn = bool(jax.tree.leaves(state.batch_stats))
-        if cfg.sync_batchnorm or not has_bn:
+        uses_gspmd_step = cfg.sync_batchnorm or not has_bn
+        # Resolve DeepSpeed batch-triple semantics once, where world size is
+        # known (accum may be derived from global_batch_size here — GSPMD
+        # step only; the shard_map local-BN step can't accumulate).
+        # batch_size is per *chip* (DDP parity: per-GPU mini-batch ×
+        # world), so scale by every mesh device — under a data×expert mesh
+        # the data axis is smaller than the chip count, but each chip still
+        # contributes batch_size examples of work.
+        self.train_gbs, self.eval_gbs, self.grad_accum = effective_batch_sizes(
+            cfg, int(self.mesh.devices.size), allow_derive=uses_gspmd_step)
+        if uses_gspmd_step:
             self.train_step = make_train_step(
-                self.mesh, zero_stage=cfg.zero.stage)
+                self.mesh, zero_stage=cfg.zero.stage,
+                grad_accum_steps=self.grad_accum,
+                label_smoothing=cfg.label_smoothing)
         else:
             if cfg.zero.stage != 0:
                 raise NotImplementedError(
                     "sync_batchnorm=False uses the explicit shard_map DP "
                     "step, which has no ZeRO sharding; use zero stage 0 "
                     "with local BN")
-            self.train_step = make_shard_map_train_step(self.mesh)
+            if self.grad_accum > 1:
+                raise NotImplementedError(
+                    "gradient accumulation is built on the GSPMD step; use "
+                    "sync_batchnorm=True with it")
+            self.train_step = make_shard_map_train_step(
+                self.mesh, label_smoothing=cfg.label_smoothing)
         self.eval_step = make_eval_step(self.mesh)
         self.meter = MetricMeter(cfg.log_interval)
         self.clock = WallClock(cfg.wall_clock_breakdown)
+        self.metrics_writer = MetricsWriter(
+            cfg.tensorboard_dir, cfg.metrics_jsonl,
+            enabled=self.coord.is_master())
+        self._guard: PreemptionGuard | None = None
         self._global_step = 0
         self.coord.print(
             f"[trainer] model={cfg.model} params={param_count(state.params):,} "
             f"mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))} "
             f"plugin={cfg.plugin} zero_stage={cfg.zero.stage} "
-            f"dtype={cfg.precision.dtype}")
+            f"dtype={cfg.precision.dtype}"
+            + (f" grad_accum={self.grad_accum}" if self.grad_accum > 1 else ""))
 
     # -- data ---------------------------------------------------------------
     def make_loaders(self):
-        return build_dataloaders(self.cfg, self.coord, seed=self.cfg.seed)
+        # Train consumes effective batches (micro × accum × world); eval
+        # stays micro-sized — accumulation exists because effective-batch
+        # forwards don't fit.
+        return build_dataloaders(
+            self.cfg, self.coord, seed=self.cfg.seed,
+            global_batch_size=self.train_gbs,
+            eval_global_batch_size=self.eval_gbs)
 
     def _batch_shardings(self, batch):
         return {k: batch_sharding(self.mesh, v.ndim) for k, v in batch.items()}
@@ -168,7 +198,17 @@ class Trainer:
                 bar.update()
                 if fetched:
                     bar.set_postfix(self.meter.last)
-        bar.set_postfix(self.meter.flush())
+                    self.metrics_writer.write(
+                        self.meter.last["step"], self.meter.last)
+            if self._guard is not None and self._guard.should_stop(
+                    at_sync_point=fetched):
+                break
+        # Flush the epoch tail only if steps are actually pending — an
+        # unconditional write would duplicate the last interval's point.
+        if self.meter.pending:
+            flushed = self.meter.flush()
+            self.metrics_writer.write(flushed["step"], flushed)
+        bar.set_postfix(self.meter.last)
         bar.close()
         if self.cfg.wall_clock_breakdown:
             self.coord.print(f"[wall_clock] {self.clock.report()}")
@@ -176,31 +216,65 @@ class Trainer:
 
     # -- eval ---------------------------------------------------------------
     def evaluate(self, loader) -> float:
-        correct = 0.0
-        total = 0.0
+        """Top-1 accuracy (the ``target_acc`` metric); top-5 is kept on
+        ``self.last_eval`` and written to the metric sinks."""
+        correct = correct5 = total = 0.0
         for gbatch in self._batches(loader):
-            c, t = self.eval_step(self.state, gbatch)
+            c, c5, t = self.eval_step(self.state, gbatch)
             correct += float(c)
+            correct5 += float(c5)
             total += float(t)
-        return correct / max(total, 1.0)
+        self.last_eval = {"top1": correct / max(total, 1.0),
+                          "top5": correct5 / max(total, 1.0)}
+        self.metrics_writer.write(
+            self._global_step, self.last_eval, prefix="eval")
+        return self.last_eval["top1"]
 
     # -- full run -----------------------------------------------------------
     def fit(self) -> dict:
+        try:
+            return self._fit()
+        finally:
+            # Both exits (incl. preemption — the process is about to die in
+            # its SIGTERM grace window — and the target_acc raise) must
+            # flush buffered TensorBoard events.
+            self.metrics_writer.close()
+
+    def _fit(self) -> dict:
         cfg = self.cfg
         train_loader, eval_loader = self.make_loaders()
 
         start_epoch = 0
-        if cfg.checkpoint.resume >= 0:
+        resume = ckpt_lib.resolve_resume(cfg.checkpoint)
+        if resume >= 0:
             self.state, start_epoch = ckpt_lib.restore_checkpoint(
-                cfg.checkpoint.directory, cfg.checkpoint.resume, self.state)
+                cfg.checkpoint.directory, resume, self.state)
             self.state = place_state(self.state, self.shardings)
+            # Metric sinks must continue the restored step axis, not restart
+            # at 1 and double back over the pre-preemption history.
+            self._global_step = int(jax.device_get(self.state.step))
             self.coord.print(f"[trainer] resumed at epoch {start_epoch}")
 
         final_acc = None
         last_eval_epoch = -1
-        with trace(cfg.profile_dir):
+        preempted = False
+        with trace(cfg.profile_dir), PreemptionGuard() as guard:
+            self._guard = guard
             for epoch in range(start_epoch, cfg.num_epochs):
                 self.train_epoch(epoch, train_loader)
+                if guard.should_stop():
+                    # Preempted mid-epoch: next_epoch points back at this
+                    # (partial) epoch, which re-runs from its deterministic
+                    # shuffle on resume.
+                    preempted = True
+                    if cfg.checkpoint.save_on_preemption:
+                        ckpt_lib.save_checkpoint(
+                            cfg.checkpoint.directory, epoch, self.state,
+                            next_epoch=epoch)
+                        self.coord.print(
+                            f"[trainer] SIGTERM: saved preemption checkpoint "
+                            f"(resumes at epoch {epoch})")
+                    break
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                     final_acc = self.evaluate(eval_loader)
                     last_eval_epoch = epoch + 1
@@ -212,6 +286,11 @@ class Trainer:
                         cfg.checkpoint.directory, epoch, self.state)
                     ckpt_lib.prune_checkpoints(
                         cfg.checkpoint.directory, cfg.checkpoint.keep)
+        self._guard = None
+        if preempted:
+            return {"final_acc": None, "preempted": True,
+                    "last_metrics": self.meter.last,
+                    "steps": int(jax.device_get(self.state.step))}
 
         # --target_acc gate, parsed-but-never-used in the reference
         # (colossal_train.py:43-46) — functional here. Re-evaluate if the
@@ -224,5 +303,6 @@ class Trainer:
                 raise RuntimeError(
                     f"target accuracy {cfg.target_acc} not reached "
                     f"(got {final_acc:.4f})")
-        return {"final_acc": final_acc, "last_metrics": self.meter.last,
+        return {"final_acc": final_acc, "preempted": False,
+                "last_metrics": self.meter.last,
                 "steps": int(jax.device_get(self.state.step))}

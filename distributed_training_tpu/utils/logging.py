@@ -29,6 +29,12 @@ class MetricMeter:
         self._pending: list[tuple[int, dict[str, Any]]] = []
         self.last: dict[str, float] = {}
 
+    @property
+    def pending(self) -> bool:
+        """True when unfetched device metrics are queued (a flush now would
+        materialize new values rather than repeat ``last``)."""
+        return bool(self._pending)
+
     def push(self, step: int, metrics: dict[str, Any]) -> bool:
         """Record device metrics; returns True when a fetch happened."""
         self._pending.append((step, metrics))

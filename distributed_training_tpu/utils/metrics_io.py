@@ -1,0 +1,65 @@
+"""Metric sinks: TensorBoard scalars and/or JSONL event lines.
+
+The reference's only observability is a per-step tqdm loss postfix
+(SURVEY.md §5 "Metrics / logging": "No W&B/TensorBoard"); this module is
+the durable-sink extension the survey plans ("optional TensorBoard
+scalars"). Writes happen only at the meter's ``log_interval`` flushes —
+the values are already on host then, so sinks add no device syncs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+
+class MetricsWriter:
+    """Fan-out writer for flushed metric dicts (master process only).
+
+    - ``tensorboard_dir``: scalar summaries via ``torch.utils.tensorboard``
+      (imported lazily — it drags in protobuf/tensorboard only when asked).
+    - ``jsonl_path``: one ``{"step": N, ...}`` object per line, appended;
+      trivially greppable/plottable without any reader dependency.
+
+    Both optional; with neither this is a no-op sink.
+    """
+
+    def __init__(self, tensorboard_dir: str | None = None,
+                 jsonl_path: str | None = None, enabled: bool = True):
+        self._tb = None
+        self._jsonl = None
+        if not enabled:
+            return
+        if tensorboard_dir:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(log_dir=tensorboard_dir)
+        if jsonl_path:
+            d = os.path.dirname(os.path.abspath(jsonl_path))
+            os.makedirs(d, exist_ok=True)
+            self._jsonl = open(jsonl_path, "a", buffering=1)
+
+    def write(self, step: int, metrics: dict[str, Any],
+              prefix: str = "train") -> None:
+        scalars = {k: float(v) for k, v in metrics.items() if k != "step"}
+        if self._tb is not None:
+            for k, v in scalars.items():
+                self._tb.add_scalar(f"{prefix}/{k}", v, global_step=step)
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps(
+                {"step": int(step), "prefix": prefix, **scalars}) + "\n")
+
+    def close(self) -> None:
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -24,6 +24,9 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("-b", "--batch_size", type=int, default=32,
                         help="per-data-shard batch size")
     parser.add_argument("-e", "--epochs", type=int, default=5)
+    parser.add_argument("--gradient-accumulation-steps", type=int, default=1,
+                        help="microbatches per optimizer update (tensor/dp "
+                             "strategy; effective batch scales by this)")
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--vocab-size", type=int, default=256)
     parser.add_argument("--num-layers", type=int, default=4)
@@ -67,6 +70,10 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--wall-clock-breakdown", action="store_true")
     parser.add_argument("--profile-dir", type=str, default=None)
+    parser.add_argument("--auto-resume", action="store_true", default=False,
+                        help="resume from the newest checkpoint if present")
+    parser.add_argument("--tensorboard-dir", type=str, default=None)
+    parser.add_argument("--metrics-jsonl", type=str, default=None)
     return parser.parse_args()
 
 
@@ -93,10 +100,13 @@ def build_config(args: argparse.Namespace):
             mlp_type=args.mlp_type,
         ),
         num_epochs=args.epochs,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
         seed=args.seed,
         log_interval=args.log_interval,
         wall_clock_breakdown=args.wall_clock_breakdown,
         profile_dir=args.profile_dir,
+        tensorboard_dir=args.tensorboard_dir,
+        metrics_jsonl=args.metrics_jsonl,
         precision=dataclasses.replace(cfg.precision, dtype=args.dtype),
         zero=ZeroConfig(stage=args.stage),
         mesh=MeshSpec(data=-1, model=args.tp, pipe=args.pp, sequence=args.sp,
@@ -105,6 +115,7 @@ def build_config(args: argparse.Namespace):
             directory=args.checkpoint,
             interval=args.interval,
             resume=args.resume,
+            auto_resume=args.auto_resume,
         ),
         data=DataConfig(
             batch_size=args.batch_size,

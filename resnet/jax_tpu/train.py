@@ -57,6 +57,11 @@ def add_argument() -> argparse.Namespace:
                         help="per-device mini-batch size")
     parser.add_argument("-e", "--epochs", type=int, default=5,
                         help="number of total epochs")
+    parser.add_argument("--gradient-accumulation-steps", type=int, default=1,
+                        help="microbatches accumulated per optimizer update "
+                             "(effective batch = batch_size × world × this)")
+    parser.add_argument("--label-smoothing", type=float, default=0.0,
+                        help="uniform label smoothing for the train CE")
     parser.add_argument("--log-interval", type=int, default=100,
                         help="steps between metric fetches/logs")
     parser.add_argument("--dtype", type=str, default="fp32",
@@ -93,9 +98,16 @@ def add_argument() -> argparse.Namespace:
     # -- data / misc --------------------------------------------------------
     parser.add_argument("--dataset", type=str, default="cifar10",
                         choices=["cifar10", "synthetic_cifar",
-                                 "synthetic_imagenet"])
+                                 "synthetic_imagenet", "imagefolder"])
     parser.add_argument("--data-path", type=str, default=None,
-                        help="dataset root (default: $DATA or ../data)")
+                        help="dataset root (default: $DATA or ../data); "
+                             "imagefolder expects <root>/train and "
+                             "<root>/val class-directory trees")
+    parser.add_argument("--image-size", type=int, default=None,
+                        help="square input size (default: 224 for "
+                             "imagenet-style datasets, 32 for CIFAR)")
+    parser.add_argument("--num-classes", type=int, default=None,
+                        help="label count (default by dataset)")
     parser.add_argument("--steps-per-epoch", type=int, default=None,
                         help="cap train steps per epoch (smoke runs)")
     parser.add_argument("--seed", type=int, default=0)
@@ -103,6 +115,13 @@ def add_argument() -> argparse.Namespace:
                         default=False)
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="jax.profiler trace output directory")
+    parser.add_argument("--auto-resume", action="store_true", default=False,
+                        help="resume from the newest checkpoint if present "
+                             "(pairs with SIGTERM preemption saves)")
+    parser.add_argument("--tensorboard-dir", type=str, default=None,
+                        help="TensorBoard scalar log directory")
+    parser.add_argument("--metrics-jsonl", type=str, default=None,
+                        help="append metric flushes to this JSONL file")
 
     return parser.parse_args()
 
@@ -187,23 +206,29 @@ def build_config(args: argparse.Namespace):
             precision=dataclasses.replace(cfg.precision, dtype=args.dtype)
             if args.dtype != "fp32" else cfg.precision)
 
-    num_classes = 1000 if args.dataset == "synthetic_imagenet" else 10
-    image_size = 224 if args.dataset == "synthetic_imagenet" else 32
+    imagenet_style = args.dataset in ("synthetic_imagenet", "imagefolder")
+    num_classes = args.num_classes or (1000 if imagenet_style else 10)
+    image_size = args.image_size or (224 if imagenet_style else 32)
     augment = ("normalize_only" if args.plugin == "deepspeed"
                else "pad_crop_flip")  # DS normalizes; DDP/Colossal crop+flip
 
     cfg = cfg.replace(
         model=args.model,
         num_epochs=args.epochs,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+        label_smoothing=args.label_smoothing,
         seed=args.seed,
         log_interval=args.log_interval,
         target_acc=args.target_acc,
         wall_clock_breakdown=args.wall_clock_breakdown,
         profile_dir=args.profile_dir,
+        tensorboard_dir=args.tensorboard_dir,
+        metrics_jsonl=args.metrics_jsonl,
         checkpoint=CheckpointConfig(
             directory=args.checkpoint,
             interval=args.interval,
             resume=args.resume,
+            auto_resume=args.auto_resume,
         ),
         data=DataConfig(
             dataset=args.dataset,

@@ -260,3 +260,7 @@ class TestSamplingTransforms:
             apply_top_p(logits, 0.0)
         with pytest.raises(ValueError):
             apply_top_p(logits, 1.5)
+        with pytest.raises(ValueError, match="temperature"):
+            SampleConfig(temperature=-1.0)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            SampleConfig(max_new_tokens=0)

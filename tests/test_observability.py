@@ -1,0 +1,221 @@
+"""Metric sinks (TensorBoard/JSONL) + preemption checkpoint-restart.
+
+The reference has neither durable metrics nor any failure handling
+(SURVEY.md §5); these tests pin the extensions: MetricsWriter fan-out,
+PreemptionGuard signal latching, and the Trainer's SIGTERM →
+save-checkpoint → auto_resume round trip.
+"""
+
+import json
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_tpu.config import (
+    CheckpointConfig,
+    DataConfig,
+    TrainConfig,
+)
+from distributed_training_tpu.runtime.preemption import PreemptionGuard
+from distributed_training_tpu.utils.metrics_io import MetricsWriter
+
+
+class TestMetricsWriter:
+    def test_jsonl_lines(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with MetricsWriter(jsonl_path=path) as w:
+            w.write(10, {"loss": 1.5, "step": 10})
+            w.write(20, {"loss": 0.5, "step": 20}, prefix="eval")
+        rows = [json.loads(l) for l in open(path)]
+        assert rows == [
+            {"step": 10, "prefix": "train", "loss": 1.5},
+            {"step": 20, "prefix": "eval", "loss": 0.5},
+        ]
+
+    def test_jsonl_appends_across_writers(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with MetricsWriter(jsonl_path=path) as w:
+            w.write(1, {"loss": 1.0})
+        with MetricsWriter(jsonl_path=path) as w:
+            w.write(2, {"loss": 2.0})
+        assert len(open(path).readlines()) == 2
+
+    def test_tensorboard_events_written(self, tmp_path):
+        tb = pytest.importorskip("torch.utils.tensorboard")
+        del tb
+        d = str(tmp_path / "tb")
+        with MetricsWriter(tensorboard_dir=d) as w:
+            w.write(1, {"loss": 3.0})
+        files = [f for f in os.listdir(d) if "tfevents" in f]
+        assert files, f"no event files in {os.listdir(d)}"
+
+    def test_disabled_is_noop(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with MetricsWriter(jsonl_path=path, enabled=False) as w:
+            w.write(1, {"loss": 1.0})
+        assert not os.path.exists(path)
+
+
+class TestPreemptionGuard:
+    def test_sigterm_latches(self):
+        with PreemptionGuard() as guard:
+            assert not guard.triggered
+            signal.raise_signal(signal.SIGTERM)
+            assert guard.triggered
+
+    def test_handler_restored_on_exit(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with PreemptionGuard():
+            assert signal.getsignal(signal.SIGTERM) != before
+        assert signal.getsignal(signal.SIGTERM) == before
+
+    def test_should_stop_single_process_every_step(self):
+        with PreemptionGuard() as guard:
+            assert not guard.should_stop(at_sync_point=False)
+            signal.raise_signal(signal.SIGTERM)
+            # Single process: no cross-host agreement needed; stop anywhere.
+            assert guard.should_stop(at_sync_point=False)
+
+    def test_custom_previous_handler_gets_second_signal(self):
+        hits = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+        try:
+            with PreemptionGuard() as guard:
+                signal.raise_signal(signal.SIGTERM)
+                assert guard.triggered and not hits
+                signal.raise_signal(signal.SIGTERM)
+                assert hits == [signal.SIGTERM]
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+
+def _cfg(tmp_path, **kw):
+    return TrainConfig(
+        model="resnet18",
+        num_epochs=2,
+        log_interval=2,
+        eval_every=0,
+        data=DataConfig(dataset="synthetic_cifar", batch_size=4,
+                        max_steps_per_epoch=4, prefetch=0),
+        checkpoint=CheckpointConfig(
+            directory=str(tmp_path / "ckpt"), interval=0, **kw),
+    )
+
+
+class TestTrainerPreemption:
+    def test_sigterm_saves_and_auto_resume_completes(self, mesh, tmp_path):
+        from distributed_training_tpu import checkpoint as ckpt_lib
+        from distributed_training_tpu.train.trainer import Trainer
+
+        cfg = _cfg(tmp_path, auto_resume=True)
+        tr = Trainer(cfg, mesh=mesh)
+
+        # Deliver SIGTERM from inside the 2nd step of epoch 0: wrap the
+        # train step so the signal arrives while the guard is installed.
+        real_step = tr.train_step
+        calls = []
+
+        def step_then_signal(state, batch, rng):
+            out = real_step(state, batch, rng)
+            calls.append(1)
+            if len(calls) == 2:
+                signal.raise_signal(signal.SIGTERM)
+            return out
+
+        tr.train_step = step_then_signal
+        result = tr.fit()
+        assert result["preempted"] is True
+        assert calls, "no steps ran"
+        # Preemption checkpoint exists and resumes at epoch 0 (partial).
+        assert ckpt_lib.latest_epoch(cfg.checkpoint.directory) == 0
+        steps_before = result["steps"]
+
+        # Fresh trainer with auto_resume picks it up and runs to completion.
+        tr2 = Trainer(cfg, mesh=mesh)
+        result2 = tr2.fit()
+        assert result2["preempted"] is False
+        assert result2["steps"] > steps_before
+        # Epoch 0 re-ran fully + epoch 1: 2 epochs × 4 steps on top of the
+        # restored optimizer step counter.
+        assert result2["steps"] == steps_before + 8
+
+    def test_metrics_jsonl_written_by_trainer(self, mesh, tmp_path):
+        from distributed_training_tpu.train.trainer import Trainer
+
+        cfg = _cfg(tmp_path).replace(
+            num_epochs=1, metrics_jsonl=str(tmp_path / "metrics.jsonl"))
+        Trainer(cfg, mesh=mesh).fit()
+        rows = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+        assert rows and all("loss" in r for r in rows)
+        assert rows[-1]["step"] == 4
+
+
+class TestCheckpointNextEpoch:
+    def test_mid_epoch_save_resumes_same_epoch(self, mesh, tmp_path):
+        import optax
+
+        from distributed_training_tpu import checkpoint as ckpt_lib
+        from distributed_training_tpu.config import PrecisionConfig
+        from distributed_training_tpu.models import get_model
+        from distributed_training_tpu.train.precision import LossScaleState
+        from distributed_training_tpu.train.train_state import init_train_state
+
+        model = get_model("resnet18", num_classes=10, stem="cifar")
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), (1, 8, 8, 3), optax.adam(1e-3),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+        d = str(tmp_path / "c")
+        ckpt_lib.save_checkpoint(d, 3, state, next_epoch=3)
+        _, start = ckpt_lib.restore_checkpoint(d, 3, state)
+        assert start == 3
+        ckpt_lib.save_checkpoint(d, 3, state)  # normal end-of-epoch save
+        _, start = ckpt_lib.restore_checkpoint(d, 3, state)
+        assert start == 4
+
+    def test_old_format_checkpoint_restores_with_epoch_plus_one(
+            self, mesh, tmp_path):
+        """Pre-next_epoch checkpoints (meta = {epoch} only) still restore,
+        with the old epoch+1 resume semantics."""
+        import optax
+        import orbax.checkpoint as ocp
+        from flax import serialization
+
+        from distributed_training_tpu import checkpoint as ckpt_lib
+        from distributed_training_tpu.config import PrecisionConfig
+        from distributed_training_tpu.models import get_model
+        from distributed_training_tpu.train.precision import LossScaleState
+        from distributed_training_tpu.train.train_state import init_train_state
+
+        model = get_model("resnet18", num_classes=10, stem="cifar")
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), (1, 8, 8, 3), optax.adam(1e-3),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+        path = str(tmp_path / "c" / "epoch_2")
+        ocp.PyTreeCheckpointer().save(path, {
+            "state": serialization.to_state_dict(state),
+            "meta": {"epoch": np.int32(2)},
+        })
+        _, start = ckpt_lib.restore_checkpoint(str(tmp_path / "c"), 2, state)
+        assert start == 3
+
+    def test_preempt_during_first_epoch_roundtrips(self, mesh, tmp_path):
+        import optax
+
+        from distributed_training_tpu import checkpoint as ckpt_lib
+        from distributed_training_tpu.config import PrecisionConfig
+        from distributed_training_tpu.models import get_model
+        from distributed_training_tpu.train.precision import LossScaleState
+        from distributed_training_tpu.train.train_state import init_train_state
+
+        model = get_model("resnet18", num_classes=10, stem="cifar")
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), (1, 8, 8, 3), optax.adam(1e-3),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+        d = str(tmp_path / "c")
+        ckpt_lib.save_checkpoint(d, 0, state, next_epoch=0)
+        assert ckpt_lib.latest_epoch(d) == 0
+        _, start = ckpt_lib.restore_checkpoint(d, 0, state)
+        assert start == 0
