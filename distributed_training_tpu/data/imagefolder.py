@@ -1,0 +1,191 @@
+"""Directory-tree image datasets (torchvision ``ImageFolder`` layout).
+
+The reference only ever loads CIFAR-10 through torchvision's dataset class
+(``resnet/pytorch_ddp/ddp_train.py:34-44``); real ImageNet-scale training
+(the BASELINE.json north-star workload) needs the ``root/<class>/<img>``
+directory layout with *lazy* decode — the dataset does not fit in RAM.
+
+TPU-native concerns (SURVEY.md §7 "Input pipeline at ≥6000 img/s/chip"):
+the host CPU is the bottleneck, so decode/resize/augment run in a thread
+pool per batch (PIL releases the GIL around decode), and the loader plugs
+into ``DevicePrefetcher`` so host work overlaps device compute. Sharding
+and epoch shuffling follow ``ShardedDataLoader`` exactly: one global
+permutation per (seed, epoch), contiguous per-process slices.
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Sequence
+
+import jax
+import numpy as np
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+
+def scan_imagefolder(root: str) -> tuple[list[str], np.ndarray, list[str]]:
+    """Scan ``root/<class>/<image>`` into (paths, labels, class_names).
+
+    Classes are sorted alphabetically (torchvision parity: class index =
+    rank in sorted dir listing); files sorted within each class so the
+    index→example mapping is stable across processes and runs.
+    """
+    root = os.path.abspath(root)
+    if not os.path.isdir(root):
+        raise FileNotFoundError(f"imagefolder root {root} does not exist")
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        raise ValueError(f"imagefolder root {root} has no class directories")
+    paths: list[str] = []
+    labels: list[int] = []
+    for idx, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fname in sorted(os.listdir(cdir)):
+            if fname.lower().endswith(IMAGE_EXTENSIONS):
+                paths.append(os.path.join(cdir, fname))
+                labels.append(idx)
+    if not paths:
+        raise ValueError(f"no images with {IMAGE_EXTENSIONS} under {root}")
+    return paths, np.asarray(labels, np.int32), classes
+
+
+def _decode(path: str, size: int, randomize: bool, rng_seed: int) -> np.ndarray:
+    """Decode one image to f32 [size, size, 3] in [0, 1].
+
+    randomize: resize shortest side to 1.15×size, random crop + horizontal
+    flip (the ImageNet-standard recipe's crop geometry, deterministic in
+    ``rng_seed``). Otherwise: same resize, center crop.
+    """
+    from PIL import Image
+
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        w, h = im.size
+        short = int(round(size * 1.15))
+        scale = short / min(w, h)
+        im = im.resize((max(size, int(round(w * scale))),
+                        max(size, int(round(h * scale)))), Image.BILINEAR)
+        w, h = im.size
+        if randomize:
+            rng = np.random.RandomState(rng_seed % (2 ** 31))
+            x0 = rng.randint(0, w - size + 1)
+            y0 = rng.randint(0, h - size + 1)
+            im = im.crop((x0, y0, x0 + size, y0 + size))
+            if rng.randint(2):
+                im = im.transpose(Image.FLIP_LEFT_RIGHT)
+        else:
+            x0 = (w - size) // 2
+            y0 = (h - size) // 2
+            im = im.crop((x0, y0, x0 + size, y0 + size))
+        return np.asarray(im, np.float32) / 255.0
+
+
+class ImageFolderLoader:
+    """Lazy sharded loader over an image directory tree.
+
+    Same contract as :class:`~distributed_training_tpu.data.pipeline.
+    ShardedDataLoader`: yields ``{'image': f32[NHWC], 'label': i32[N]}``
+    (+ ``mask`` when ``drop_last=False``) per-process slices; ``set_epoch``
+    reseeds the global shuffle. Decode runs on ``num_workers`` threads.
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        labels: np.ndarray,
+        *,
+        global_batch_size: int,
+        image_size: int = 224,
+        shuffle: bool = True,
+        drop_last: bool = True,
+        train: bool = True,
+        augment: str = "pad_crop_flip",
+        seed: int = 0,
+        num_workers: int = 8,
+        process_index: int | None = None,
+        process_count: int | None = None,
+        max_steps: int | None = None,
+    ):
+        self.paths = list(paths)
+        self.labels = np.asarray(labels, np.int32)
+        if len(self.paths) != len(self.labels):
+            raise ValueError(
+                f"{len(self.paths)} paths vs {len(self.labels)} labels")
+        self.global_batch_size = global_batch_size
+        self.image_size = image_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.train = train
+        if augment not in ("pad_crop_flip", "normalize_only", "none"):
+            raise ValueError(f"unknown augment mode {augment!r}")
+        self.augment = augment
+        self.seed = seed
+        self.num_workers = max(1, num_workers)
+        self.epoch = 0
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index)
+        self.process_count = (
+            jax.process_count() if process_count is None else process_count)
+        if global_batch_size % self.process_count:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{self.process_count} processes")
+        self.local_batch_size = global_batch_size // self.process_count
+        self.max_steps = max_steps
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle — ``sampler.set_epoch`` parity."""
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        n = len(self.labels)
+        steps = (n // self.global_batch_size if self.drop_last
+                 else -(-n // self.global_batch_size))
+        if self.max_steps is not None:
+            steps = min(steps, self.max_steps)
+        return steps
+
+    def __iter__(self) -> Iterator[dict]:
+        n = len(self.labels)
+        order = np.arange(n)
+        if self.shuffle:
+            order = np.random.RandomState(
+                (self.seed * 100_003 + self.epoch) % (2 ** 31)).permutation(n)
+        # Per-example decode seeds: (seed, epoch, global index) so crops are
+        # deterministic, distinct per example, and fresh every epoch.
+        seed_base = (self.seed * 7 + self.epoch * 13) % (2 ** 31)
+
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            for i in range(len(self)):
+                gstart = i * self.global_batch_size
+                gidx = order[gstart:gstart + self.global_batch_size]
+                lstart = self.process_index * self.local_batch_size
+                lidx = gidx[lstart:lstart + self.local_batch_size]
+
+                # Random crop/flip only in pad_crop_flip train mode; the
+                # DS-parity normalize_only mode (and 'none') center-crops.
+                randomize = self.train and self.augment == "pad_crop_flip"
+                decoded = list(pool.map(
+                    lambda j: _decode(self.paths[j], self.image_size,
+                                      randomize, seed_base + int(j)),
+                    lidx))
+                labels = self.labels[lidx]
+                mask = np.ones(len(lidx), np.float32)
+                if len(lidx) < self.local_batch_size:  # ragged final batch
+                    pad = self.local_batch_size - len(lidx)
+                    decoded.extend(
+                        [np.zeros((self.image_size, self.image_size, 3),
+                                  np.float32)] * pad)
+                    labels = np.concatenate([labels, np.zeros(pad, np.int32)])
+                    mask = np.concatenate([mask, np.zeros(pad, np.float32)])
+                images = np.stack(decoded)
+                if self.augment == "normalize_only":
+                    # Normalize(0.5,0.5,0.5) parity -> [-1, 1] (transforms.py).
+                    images = (images - 0.5) / 0.5
+                batch = {"image": images, "label": labels.astype(np.int32)}
+                if not self.drop_last:
+                    batch["mask"] = mask
+                yield batch

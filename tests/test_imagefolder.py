@@ -1,0 +1,166 @@
+"""ImageFolder dataset tests: scan, lazy sharded decode, trainer wiring."""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributed_training_tpu.data.imagefolder import (
+    ImageFolderLoader,
+    scan_imagefolder,
+)
+
+
+def make_tree(root, classes=("cat", "dog"), per_class=6, size=(40, 30)):
+    rng = np.random.RandomState(0)
+    for cls in classes:
+        d = os.path.join(root, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.randint(0, 255, (size[1], size[0], 3), np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"img_{i}.png"))
+    return root
+
+
+class TestScan:
+    def test_layout_and_labels(self, tmp_path):
+        make_tree(str(tmp_path))
+        paths, labels, classes = scan_imagefolder(str(tmp_path))
+        assert classes == ["cat", "dog"]  # sorted
+        assert len(paths) == 12
+        assert (labels[:6] == 0).all() and (labels[6:] == 1).all()
+
+    def test_non_image_files_skipped(self, tmp_path):
+        make_tree(str(tmp_path), per_class=2)
+        open(tmp_path / "cat" / "notes.txt", "w").write("x")
+        paths, _, _ = scan_imagefolder(str(tmp_path))
+        assert len(paths) == 4
+
+    def test_missing_root_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            scan_imagefolder(str(tmp_path / "nope"))
+
+    def test_empty_root_raises(self, tmp_path):
+        os.makedirs(tmp_path / "empty_cls")
+        with pytest.raises(ValueError, match="no images"):
+            scan_imagefolder(str(tmp_path))
+
+
+class TestLoader:
+    def _loader(self, tmp_path, **kw):
+        make_tree(str(tmp_path))
+        paths, labels, _ = scan_imagefolder(str(tmp_path))
+        defaults = dict(global_batch_size=4, image_size=16, seed=1,
+                        process_index=0, process_count=1, num_workers=2)
+        defaults.update(kw)
+        return ImageFolderLoader(paths, labels, **defaults)
+
+    def test_shapes_and_range(self, tmp_path):
+        loader = self._loader(tmp_path)
+        batch = next(iter(loader))
+        assert batch["image"].shape == (4, 16, 16, 3)
+        assert batch["image"].dtype == np.float32
+        assert 0.0 <= batch["image"].min() and batch["image"].max() <= 1.0
+        assert batch["label"].shape == (4,)
+
+    def test_epoch_reshuffle_and_determinism(self, tmp_path):
+        loader = self._loader(tmp_path)
+        loader.set_epoch(0)
+        a = [b["label"].tolist() for b in loader]
+        loader.set_epoch(0)
+        b = [b["label"].tolist() for b in loader]
+        assert a == b  # same epoch -> same order
+        loader.set_epoch(1)
+        c = [b["label"].tolist() for b in loader]
+        assert a != c  # new epoch -> reshuffled
+
+    def test_process_sharding_partitions_batch(self, tmp_path):
+        full = self._loader(tmp_path, shuffle=False)
+        p0 = self._loader(tmp_path, shuffle=False,
+                          process_index=0, process_count=2)
+        p1 = self._loader(tmp_path, shuffle=False,
+                          process_index=1, process_count=2)
+        f, a, b = (next(iter(x)) for x in (full, p0, p1))
+        np.testing.assert_array_equal(
+            f["label"], np.concatenate([a["label"], b["label"]]))
+        np.testing.assert_allclose(
+            f["image"], np.concatenate([a["image"], b["image"]]))
+
+    def test_eval_crop_is_deterministic(self, tmp_path):
+        loader = self._loader(tmp_path, train=False, shuffle=False)
+        a = next(iter(loader))["image"]
+        b = next(iter(loader))["image"]
+        np.testing.assert_array_equal(a, b)
+
+    def test_train_crops_vary_across_epochs(self, tmp_path):
+        loader = self._loader(tmp_path, shuffle=False)
+        loader.set_epoch(0)
+        a = next(iter(loader))["image"]
+        loader.set_epoch(1)
+        b = next(iter(loader))["image"]
+        assert not np.array_equal(a, b)
+
+    def test_normalize_only_mode_is_deterministic_and_centered(self, tmp_path):
+        """DS-parity augment: no random crop/flip, values in [-1, 1]."""
+        a_loader = self._loader(tmp_path, augment="normalize_only",
+                                shuffle=False)
+        a_loader.set_epoch(0)
+        a = next(iter(a_loader))["image"]
+        a_loader.set_epoch(1)
+        b = next(iter(a_loader))["image"]
+        np.testing.assert_array_equal(a, b)  # no train-time randomness
+        assert a.min() < 0 <= 1.0 >= a.max() and a.min() >= -1.0
+
+    def test_unknown_augment_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="augment"):
+            self._loader(tmp_path, augment="mixup")
+
+    def test_ragged_final_batch_masked(self, tmp_path):
+        loader = self._loader(tmp_path, global_batch_size=5, drop_last=False,
+                              shuffle=False)
+        batches = list(loader)
+        assert len(batches) == 3  # ceil(12 / 5)
+        last = batches[-1]
+        np.testing.assert_array_equal(last["mask"], [1, 1, 0, 0, 0])
+        assert last["image"].shape == (5, 16, 16, 3)
+
+
+class TestTrainerWiring:
+    def test_imagefolder_end_to_end(self, mesh, tmp_path):
+        from distributed_training_tpu.config import DataConfig, TrainConfig
+        from distributed_training_tpu.train.trainer import Trainer
+
+        make_tree(str(tmp_path / "train"), per_class=8)
+        make_tree(str(tmp_path / "val"), per_class=2)
+        cfg = TrainConfig(
+            model="resnet18",
+            num_epochs=1,
+            log_interval=1,
+            eval_every=1,
+            data=DataConfig(
+                dataset="imagefolder", data_path=str(tmp_path),
+                batch_size=1, image_size=16, num_classes=2,
+                num_workers=2, prefetch=1),
+            checkpoint=__import__(
+                "distributed_training_tpu.config",
+                fromlist=["CheckpointConfig"]).CheckpointConfig(interval=0),
+        )
+        tr = Trainer(cfg, mesh=mesh)
+        result = tr.fit()
+        assert result["final_acc"] is not None
+        assert np.isfinite(result["last_metrics"]["loss"])
+
+    def test_class_count_mismatch_raises(self, mesh, tmp_path):
+        from distributed_training_tpu.config import DataConfig, TrainConfig
+        from distributed_training_tpu.train.trainer import Trainer
+
+        make_tree(str(tmp_path / "train"), per_class=2)
+        make_tree(str(tmp_path / "val"), per_class=1)
+        cfg = TrainConfig(
+            model="resnet18", num_epochs=1,
+            data=DataConfig(dataset="imagefolder", data_path=str(tmp_path),
+                            batch_size=1, image_size=16, num_classes=10),
+        )
+        with pytest.raises(ValueError, match="num_classes"):
+            Trainer(cfg, mesh=mesh).make_loaders()
