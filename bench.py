@@ -58,7 +58,7 @@ def ensure_live_backend(probe_timeout: int = 180) -> str:
 
 
 def build(model_name: str, batch_size: int, image_size: int, num_classes: int,
-          zero_stage: int = 0):
+          zero_stage: int = 0, remat: bool = False):
     from distributed_training_tpu.config import PrecisionConfig
     from distributed_training_tpu.models import get_model
     from distributed_training_tpu.parallel.sharding import (
@@ -71,7 +71,9 @@ def build(model_name: str, batch_size: int, image_size: int, num_classes: int,
     from distributed_training_tpu.train.train_state import init_train_state
 
     mesh = create_mesh(MeshConfig(data=-1))
-    model = get_model(model_name, num_classes=num_classes, dtype=jnp.bfloat16)
+    kwargs = {"remat": True} if remat else {}
+    model = get_model(model_name, num_classes=num_classes, dtype=jnp.bfloat16,
+                      **kwargs)
     # SGD+momentum per the BASELINE.json north-star spec ("forward, backward,
     # gradient all-reduce, SGD+momentum update"); Adam measures within noise
     # of this (the step is HBM-bound in the convs, not the optimizer).
@@ -94,6 +96,8 @@ def main():
     ap.add_argument("--num-classes", type=int, default=1000)
     ap.add_argument("--zero-stage", type=int, default=0, choices=[0, 1, 2, 3],
                     help="ZeRO placement for the benched step")
+    ap.add_argument("--remat", action="store_true", default=False,
+                    help="activation-checkpoint blocks (fits larger batches)")
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--steps", type=int, default=45)
     ap.add_argument("--sync-interval", type=int, default=15,
@@ -114,7 +118,7 @@ def main():
 
     mesh, state, step = build(
         args.model, global_batch, args.image_size, args.num_classes,
-        zero_stage=args.zero_stage)
+        zero_stage=args.zero_stage, remat=args.remat)
 
     rng = np.random.RandomState(0)
     batch = {
@@ -152,6 +156,7 @@ def main():
         "metric": f"{args.model} synthetic-ImageNet train throughput "
                   f"(bf16, batch {args.batch_size}/chip"
                   f"{', zero-' + str(args.zero_stage) if args.zero_stage else ''}"
+                  f"{', remat' if args.remat else ''}"
                   f", {n_chips} {platform} chip(s))",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",

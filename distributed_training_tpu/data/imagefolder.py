@@ -19,8 +19,9 @@ import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Sequence
 
-import jax
 import numpy as np
+
+from distributed_training_tpu.data.pipeline import ShardedBatchIndexer
 
 IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
 
@@ -83,13 +84,15 @@ def _decode(path: str, size: int, randomize: bool, rng_seed: int) -> np.ndarray:
         return np.asarray(im, np.float32) / 255.0
 
 
-class ImageFolderLoader:
+class ImageFolderLoader(ShardedBatchIndexer):
     """Lazy sharded loader over an image directory tree.
 
     Same contract as :class:`~distributed_training_tpu.data.pipeline.
-    ShardedDataLoader`: yields ``{'image': f32[NHWC], 'label': i32[N]}``
-    (+ ``mask`` when ``drop_last=False``) per-process slices; ``set_epoch``
-    reseeds the global shuffle. Decode runs on ``num_workers`` threads.
+    ShardedDataLoader` (both share the :class:`ShardedBatchIndexer`
+    shard/shuffle/pad skeleton): yields ``{'image': f32[NHWC], 'label':
+    i32[N]}`` (+ ``mask`` when ``drop_last=False``) per-process slices;
+    ``set_epoch`` reseeds the global shuffle. Decode runs on
+    ``num_workers`` threads.
     """
 
     def __init__(
@@ -109,73 +112,38 @@ class ImageFolderLoader:
         process_count: int | None = None,
         max_steps: int | None = None,
     ):
+        if len(paths) != len(labels):
+            raise ValueError(f"{len(paths)} paths vs {len(labels)} labels")
+        super().__init__(
+            len(labels), global_batch_size=global_batch_size, shuffle=shuffle,
+            drop_last=drop_last, seed=seed, process_index=process_index,
+            process_count=process_count, max_steps=max_steps)
         self.paths = list(paths)
         self.labels = np.asarray(labels, np.int32)
-        if len(self.paths) != len(self.labels):
-            raise ValueError(
-                f"{len(self.paths)} paths vs {len(self.labels)} labels")
-        self.global_batch_size = global_batch_size
         self.image_size = image_size
-        self.shuffle = shuffle
-        self.drop_last = drop_last
         self.train = train
         if augment not in ("pad_crop_flip", "normalize_only", "none"):
             raise ValueError(f"unknown augment mode {augment!r}")
         self.augment = augment
-        self.seed = seed
         self.num_workers = max(1, num_workers)
-        self.epoch = 0
-        self.process_index = (
-            jax.process_index() if process_index is None else process_index)
-        self.process_count = (
-            jax.process_count() if process_count is None else process_count)
-        if global_batch_size % self.process_count:
-            raise ValueError(
-                f"global batch {global_batch_size} not divisible by "
-                f"{self.process_count} processes")
-        self.local_batch_size = global_batch_size // self.process_count
-        self.max_steps = max_steps
-
-    def set_epoch(self, epoch: int) -> None:
-        """Reseed the shuffle — ``sampler.set_epoch`` parity."""
-        self.epoch = epoch
-
-    def __len__(self) -> int:
-        n = len(self.labels)
-        steps = (n // self.global_batch_size if self.drop_last
-                 else -(-n // self.global_batch_size))
-        if self.max_steps is not None:
-            steps = min(steps, self.max_steps)
-        return steps
 
     def __iter__(self) -> Iterator[dict]:
-        n = len(self.labels)
-        order = np.arange(n)
-        if self.shuffle:
-            order = np.random.RandomState(
-                (self.seed * 100_003 + self.epoch) % (2 ** 31)).permutation(n)
         # Per-example decode seeds: (seed, epoch, global index) so crops are
         # deterministic, distinct per example, and fresh every epoch.
         seed_base = (self.seed * 7 + self.epoch * 13) % (2 ** 31)
+        # Random crop/flip only in pad_crop_flip train mode; the DS-parity
+        # normalize_only mode (and 'none') center-crops.
+        randomize = self.train and self.augment == "pad_crop_flip"
 
         with ThreadPoolExecutor(self.num_workers) as pool:
-            for i in range(len(self)):
-                gstart = i * self.global_batch_size
-                gidx = order[gstart:gstart + self.global_batch_size]
-                lstart = self.process_index * self.local_batch_size
-                lidx = gidx[lstart:lstart + self.local_batch_size]
-
-                # Random crop/flip only in pad_crop_flip train mode; the
-                # DS-parity normalize_only mode (and 'none') center-crops.
-                randomize = self.train and self.augment == "pad_crop_flip"
+            for lidx, pad in self.batches():
                 decoded = list(pool.map(
                     lambda j: _decode(self.paths[j], self.image_size,
                                       randomize, seed_base + int(j)),
                     lidx))
                 labels = self.labels[lidx]
                 mask = np.ones(len(lidx), np.float32)
-                if len(lidx) < self.local_batch_size:  # ragged final batch
-                    pad = self.local_batch_size - len(lidx)
+                if pad:  # ragged final batch
                     decoded.extend(
                         [np.zeros((self.image_size, self.image_size, 3),
                                   np.float32)] * pad)

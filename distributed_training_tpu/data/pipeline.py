@@ -29,7 +29,76 @@ from distributed_training_tpu.data import cifar10, transforms
 from distributed_training_tpu.data.synthetic import synthetic_imagenet
 
 
-class ShardedDataLoader:
+class ShardedBatchIndexer:
+    """The shard/shuffle/pad skeleton shared by every loader.
+
+    Owns the contract the reference gets from ``DistributedSampler``
+    (``resnet/pytorch_ddp/ddp_train.py:46-47``): one global permutation per
+    (seed, epoch) — identical on every process, so shards never overlap and
+    never miss an example — a contiguous per-process slice of each global
+    batch, and a 0/1 validity mask for the ragged final batch. Loaders
+    (in-memory arrays, lazy image trees) differ only in how an index slice
+    becomes pixels.
+    """
+
+    def __init__(
+        self,
+        num_examples: int,
+        *,
+        global_batch_size: int,
+        shuffle: bool,
+        drop_last: bool,
+        seed: int,
+        process_index: int | None = None,
+        process_count: int | None = None,
+        max_steps: int | None = None,
+    ):
+        self.num_examples = num_examples
+        self.global_batch_size = global_batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index)
+        self.process_count = (
+            jax.process_count() if process_count is None else process_count)
+        if global_batch_size % self.process_count:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{self.process_count} processes")
+        self.local_batch_size = global_batch_size // self.process_count
+        self.max_steps = max_steps
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle — ``sampler.set_epoch`` parity."""
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        steps = (self.num_examples // self.global_batch_size if self.drop_last
+                 else -(-self.num_examples // self.global_batch_size))
+        if self.max_steps is not None:
+            steps = min(steps, self.max_steps)
+        return steps
+
+    def batches(self) -> Iterator[tuple[np.ndarray, int]]:
+        """Yield ``(local_indices, pad)`` per step; ``pad`` is how many
+        padding examples the ragged final batch needs (0 otherwise)."""
+        order = np.arange(self.num_examples)
+        if self.shuffle:
+            order = np.random.RandomState(
+                (self.seed * 100_003 + self.epoch) % (2 ** 31)).permutation(
+                    self.num_examples)
+        for i in range(len(self)):
+            gstart = i * self.global_batch_size
+            gidx = order[gstart:gstart + self.global_batch_size]
+            # Contiguous per-process slice of the global batch.
+            lstart = self.process_index * self.local_batch_size
+            lidx = gidx[lstart:lstart + self.local_batch_size]
+            yield lidx, self.local_batch_size - len(lidx)
+
+
+class ShardedDataLoader(ShardedBatchIndexer):
     """Deterministic sharded loader over in-memory arrays.
 
     Yields dict batches ``{'image': f32[NHWC], 'label': i32[N]}`` (+ ``mask``
@@ -52,62 +121,23 @@ class ShardedDataLoader:
         process_count: int | None = None,
         max_steps: int | None = None,
     ):
+        super().__init__(
+            len(labels), global_batch_size=global_batch_size, shuffle=shuffle,
+            drop_last=drop_last, seed=seed, process_index=process_index,
+            process_count=process_count, max_steps=max_steps)
         self.images = images
         self.labels = labels
-        self.global_batch_size = global_batch_size
-        self.shuffle = shuffle
-        self.drop_last = drop_last
         self.augment = augment
         self.train = train
-        self.seed = seed
-        self.epoch = 0
-        self.process_index = (
-            jax.process_index() if process_index is None else process_index)
-        self.process_count = (
-            jax.process_count() if process_count is None else process_count)
-        if global_batch_size % self.process_count:
-            raise ValueError(
-                f"global batch {global_batch_size} not divisible by "
-                f"{self.process_count} processes")
-        self.local_batch_size = global_batch_size // self.process_count
-        self.max_steps = max_steps
-
-    def set_epoch(self, epoch: int) -> None:
-        """Reseed the shuffle — ``sampler.set_epoch`` parity."""
-        self.epoch = epoch
-
-    def __len__(self) -> int:
-        n = len(self.labels)
-        steps = (n // self.global_batch_size if self.drop_last
-                 else -(-n // self.global_batch_size))
-        if self.max_steps is not None:
-            steps = min(steps, self.max_steps)
-        return steps
 
     def __iter__(self) -> Iterator[dict]:
-        n = len(self.labels)
-        order = np.arange(n)
-        if self.shuffle:
-            # Same permutation on every process — the global batch is a
-            # deterministic function of (seed, epoch), so shards never
-            # overlap and never miss an example.
-            order = np.random.RandomState(
-                (self.seed * 100_003 + self.epoch) % (2 ** 31)).permutation(n)
         aug_rng = np.random.RandomState(
             (self.seed * 7 + self.epoch * 13 + self.process_index) % (2 ** 31))
-
-        steps = len(self)
-        for i in range(steps):
-            gstart = i * self.global_batch_size
-            gidx = order[gstart:gstart + self.global_batch_size]
-            # Contiguous per-process slice of the global batch.
-            lstart = self.process_index * self.local_batch_size
-            lidx = gidx[lstart:lstart + self.local_batch_size]
+        for lidx, pad in self.batches():
             images = self.images[lidx]
             labels = self.labels[lidx]
             mask = np.ones(len(lidx), dtype=np.float32)
-            if len(lidx) < self.local_batch_size:  # ragged final batch
-                pad = self.local_batch_size - len(lidx)
+            if pad:  # ragged final batch
                 images = np.concatenate([images, np.zeros((pad, *images.shape[1:]), images.dtype)])
                 labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
                 mask = np.concatenate([mask, np.zeros(pad, np.float32)])

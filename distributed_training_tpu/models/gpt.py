@@ -155,6 +155,12 @@ class TransformerLM(nn.Module):
     # Generator sets prompt + max_new_tokens) shrink the scan carry and the
     # per-step attention width without touching params.
     cache_len: int | None = None
+    # Rematerialize each decoder block in the backward pass (activation
+    # checkpointing: O(depth) activation memory for ~30% extra FLOPs).
+    # Ignored in decode mode (no backward). Does NOT compose with the
+    # pipeline executor (LMTrainer rejects remat + pipe; the pipeline's
+    # microbatch scan manages its own recomputation).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = False,
@@ -196,10 +202,14 @@ class TransformerLM(nn.Module):
             "pos_embed", nn.initializers.normal(0.02),
             (self.max_len, self.hidden_dim))
         x = add_pos_embed(self, pos_tab, x, positions)
+        # static_argnums: train/decode are Python bools (2 and 3 counting
+        # self); remat only matters when a backward pass exists.
+        block_cls = (nn.remat(DecoderBlock, static_argnums=(2, 3))
+                     if self.remat and not decode else DecoderBlock)
         for i in range(self.num_layers):
             is_moe = (self.moe_num_experts > 0 and self.moe_every > 0
                       and i % self.moe_every == self.moe_every - 1)
-            x = DecoderBlock(
+            x = block_cls(
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_ratio * self.hidden_dim,
                 dtype=self.dtype,
@@ -214,7 +224,7 @@ class TransformerLM(nn.Module):
                 moe_mlp_type=self.moe_mlp_type,
                 moe_expert_axis=self.moe_expert_axis,
                 cache_len=self.cache_len or self.max_len,
-                name=f"block{i}")(x, train=train, decode=decode)
+                name=f"block{i}")(x, train, decode)
         x = make_final_norm(self, name="ln_f")(x)
         return make_lm_head(self, name="lm_head")(x)
 
@@ -240,6 +250,7 @@ def make_transformer_lm(
     moe_noisy_gate_policy: str | None = None,
     moe_mlp_type: str = "standard",
     moe_expert_axis: str | None = None,
+    remat: bool = False,
 ) -> TransformerLM:
     """Registry factory. ``num_classes`` doubles as vocab size; ``axis_name``
     (the registry's SyncBN slot) is unused — LM has no BatchNorm. Unknown
@@ -265,4 +276,5 @@ def make_transformer_lm(
         moe_noisy_gate_policy=moe_noisy_gate_policy,
         moe_mlp_type=moe_mlp_type,
         moe_expert_axis=moe_expert_axis,
+        remat=remat,
     )

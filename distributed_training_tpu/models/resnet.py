@@ -107,6 +107,10 @@ class ResNet(nn.Module):
     param_dtype: Any = jnp.float32
     axis_name: str | None = None
     act: Callable = nn.relu
+    # Rematerialize each residual block in the backward pass (activation
+    # checkpointing): trades ~30% more FLOPs for O(depth) activation
+    # memory — the jax.checkpoint lever from SURVEY.md's HBM notes.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -141,15 +145,20 @@ class ResNet(nn.Module):
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
 
+        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = self.block_cls(
+                x = block_cls(
                     filters=self.num_filters * 2 ** i,
                     strides=strides,
                     conv=conv,
                     norm=norm,
                     act=self.act,
+                    # Explicit name: nn.remat prefixes auto-names
+                    # ("CheckpointBasicBlock_0"), which would make remat
+                    # and plain param trees checkpoint-incompatible.
+                    name=f"stage{i}_block{j}",
                 )(x)
 
         x = jnp.mean(x, axis=(1, 2))

@@ -81,6 +81,11 @@ class ViT(nn.Module):
     dropout_rate: float = 0.0
     axis_name: str | None = None      # accepted for registry uniformity (no BN)
     seq_axis_name: str | None = None  # sequence-parallel mesh axis
+    # Rematerialize each encoder block in the backward pass (activation
+    # checkpointing): O(depth) activation memory for ~30% extra FLOPs —
+    # measured to unlock batch 512/chip on v5e where plain bf16 OOMs by
+    # 16 MB (BASELINE.md).
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -110,15 +115,19 @@ class ViT(nn.Module):
         x = x + pos.astype(self.dtype)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=not train)
 
+        # static_argnums: `deterministic` is a Python bool — tracing it
+        # through the checkpoint boundary would fail inside nn.Dropout.
+        block_cls = (nn.remat(EncoderBlock, static_argnums=(2,))
+                     if self.remat else EncoderBlock)
         for i in range(self.num_layers):
-            x = EncoderBlock(
+            x = block_cls(
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_dim,
                 dtype=self.dtype,
                 dropout_rate=self.dropout_rate,
                 seq_axis_name=self.seq_axis_name,
                 name=f"encoder_{i}",
-            )(x, deterministic=not train)
+            )(x, not train)
 
         x = nn.LayerNorm(dtype=self.dtype, name="encoder_norm")(x)
         x = x[:, 0]

@@ -159,10 +159,15 @@ class LMTrainer:
                 moe_mlp_type=cfg.moe.mlp_type,
                 moe_expert_axis="expert" if expert > 1 else None,
             )
+        if cfg.remat and self.strategy == "pipeline":
+            raise NotImplementedError(
+                "remat does not compose with the pipeline executor (its "
+                "microbatch scan manages its own recomputation)")
         self.model = get_model(
             "transformer_lm",
             num_classes=lm.vocab_size,
             dtype=policy.compute_dtype,
+            remat=cfg.remat,
             seq_axis=AXIS_SEQUENCE if seq > 1 else None,
             num_layers=lm.num_layers,
             num_heads=lm.num_heads,

@@ -68,9 +68,13 @@ class Trainer:
 
         policy = Policy.from_config(cfg.precision)
         model_kwargs = {}
+        if cfg.remat:
+            # Only set when asked: models without a remat attr (moe_mlp)
+            # then raise loudly instead of silently not checkpointing.
+            model_kwargs["remat"] = True
         if cfg.model.startswith("moe"):
             mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
-            model_kwargs = dict(
+            model_kwargs |= dict(
                 num_experts=tuple(cfg.moe.num_experts),
                 top_k=cfg.moe.top_k,
                 capacity_factor=cfg.moe.capacity_factor,

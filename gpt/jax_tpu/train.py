@@ -27,6 +27,8 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--gradient-accumulation-steps", type=int, default=1,
                         help="microbatches per optimizer update (tensor/dp "
                              "strategy; effective batch scales by this)")
+    parser.add_argument("--remat", action="store_true", default=False,
+                        help="activation-checkpoint each decoder block")
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--vocab-size", type=int, default=256)
     parser.add_argument("--num-layers", type=int, default=4)
@@ -101,6 +103,7 @@ def build_config(args: argparse.Namespace):
         ),
         num_epochs=args.epochs,
         gradient_accumulation_steps=args.gradient_accumulation_steps,
+        remat=args.remat,
         seed=args.seed,
         log_interval=args.log_interval,
         wall_clock_breakdown=args.wall_clock_breakdown,

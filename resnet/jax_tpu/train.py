@@ -62,6 +62,9 @@ def add_argument() -> argparse.Namespace:
                              "(effective batch = batch_size × world × this)")
     parser.add_argument("--label-smoothing", type=float, default=0.0,
                         help="uniform label smoothing for the train CE")
+    parser.add_argument("--remat", action="store_true", default=False,
+                        help="activation checkpointing per block (fit "
+                             "bigger batches; ~30% extra backward FLOPs)")
     parser.add_argument("--log-interval", type=int, default=100,
                         help="steps between metric fetches/logs")
     parser.add_argument("--dtype", type=str, default="fp32",
@@ -217,6 +220,7 @@ def build_config(args: argparse.Namespace):
         num_epochs=args.epochs,
         gradient_accumulation_steps=args.gradient_accumulation_steps,
         label_smoothing=args.label_smoothing,
+        remat=args.remat,
         seed=args.seed,
         log_interval=args.log_interval,
         target_acc=args.target_acc,

@@ -1,0 +1,142 @@
+"""Rematerialization (activation checkpointing) tests.
+
+remat must be a pure memory/FLOPs trade: forward outputs, gradients, and
+the resulting training trajectory are bit-compatible with the plain model
+(same params, same math — only the backward's recompute schedule differs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_training_tpu.config import PrecisionConfig
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.parallel.sharding import place_state, state_shardings
+from distributed_training_tpu.train.precision import LossScaleState
+from distributed_training_tpu.train.step import make_train_step
+from distributed_training_tpu.train.train_state import init_train_state
+
+VIT_KW = dict(hidden_size=32, num_layers=2, num_heads=2, mlp_dim=64,
+              patch_size=8, dropout_rate=0.0)
+
+
+class TestEquivalence:
+    @pytest.mark.parametrize("name,kw,shape", [
+        ("vit_b16", VIT_KW, (2, 16, 16, 3)),
+        ("resnet18", dict(stem="cifar"), (2, 8, 8, 3)),
+        ("transformer_lm", dict(num_layers=2, num_heads=2, hidden_dim=32,
+                                max_len=32), (2, 8)),
+    ])
+    def test_outputs_and_grads_match_plain(self, name, kw, shape):
+        plain = get_model(name, num_classes=10, **kw)
+        ckpt = get_model(name, num_classes=10, remat=True, **kw)
+        if name == "transformer_lm":
+            x = jax.random.randint(jax.random.PRNGKey(0), shape, 0, 10)
+        else:
+            x = jax.random.uniform(jax.random.PRNGKey(0), shape)
+        variables = plain.init(jax.random.PRNGKey(1), x, train=False)
+        params = variables["params"]
+        # Param trees are layout-identical: remat only changes the backward.
+        chex = __import__("chex")
+        chex.assert_trees_all_equal_shapes(
+            params, ckpt.init(jax.random.PRNGKey(1), x, train=False)["params"])
+
+        out_a = plain.apply(variables, x, train=False)
+        out_b = ckpt.apply(variables, x, train=False)
+        np.testing.assert_allclose(out_a, out_b, rtol=1e-6, atol=1e-6)
+
+        extra = {k: v for k, v in variables.items() if k != "params"}
+
+        def loss_grads(m):
+            def f(p):
+                logits = m.apply({"params": p, **extra}, x, train=False)
+                return logits.sum()
+            return jax.grad(f)(params)
+
+        ga, gb = loss_grads(plain), loss_grads(ckpt)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+            ga, gb)
+
+
+class TestTrainStepIntegration:
+    def test_vit_remat_train_step_matches_plain(self, mesh):
+        batch = {
+            "image": jnp.asarray(
+                np.random.RandomState(0).rand(8, 16, 16, 3), jnp.float32),
+            "label": jnp.asarray(
+                np.random.RandomState(0).randint(0, 10, 8), jnp.int32),
+        }
+
+        def run(remat):
+            model = get_model("vit_b16", num_classes=10, remat=remat, **VIT_KW)
+            state = init_train_state(
+                model, jax.random.PRNGKey(0), (8, 16, 16, 3),
+                optax.adam(1e-3),
+                loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+            state = place_state(state, state_shardings(state, mesh, 0))
+            step = make_train_step(mesh, donate=False)
+            new_state, m = step(state, batch, jax.random.PRNGKey(1))
+            return jax.device_get(new_state.params), float(m["loss"])
+
+        pa, la = run(False)
+        pb, lb = run(True)
+        assert la == pytest.approx(lb, rel=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7),
+            pa, pb)
+
+    def test_resnet_with_bn_remat_trains(self, mesh):
+        """BatchNorm's mutable batch_stats must thread through nn.remat."""
+        model = get_model("resnet18", num_classes=10, stem="cifar", remat=True)
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), (8, 8, 8, 3), optax.adam(1e-3),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+        state = place_state(state, state_shardings(state, mesh, 0))
+        step = make_train_step(mesh, donate=False)
+        batch = {
+            "image": jnp.asarray(
+                np.random.RandomState(0).rand(8, 8, 8, 3), jnp.float32),
+            "label": jnp.asarray(
+                np.random.RandomState(0).randint(0, 10, 8), jnp.int32),
+        }
+        new_state, m = step(state, batch, jax.random.PRNGKey(1))
+        assert np.isfinite(float(m["loss"]))
+        changed = jax.tree.map(
+            lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+            jax.device_get(state.batch_stats),
+            jax.device_get(new_state.batch_stats))
+        assert max(jax.tree.leaves(changed)) > 0
+
+    def test_lm_trainer_remat_pipeline_rejected(self, mesh):
+        from distributed_training_tpu.config import (
+            DataConfig,
+            LMConfig,
+            MeshSpec,
+            TrainConfig,
+        )
+        from distributed_training_tpu.train.lm_trainer import LMTrainer
+
+        cfg = TrainConfig(
+            model="transformer_lm", remat=True,
+            mesh=MeshSpec(data=-1, pipe=2),
+            data=DataConfig(batch_size=4),
+            lm=LMConfig(seq_len=16, vocab_size=32, num_layers=2, num_heads=2,
+                        hidden_dim=16, max_len=32, num_microbatches=2),
+        )
+        with pytest.raises(NotImplementedError, match="remat"):
+            LMTrainer(cfg)
+
+    def test_generation_with_remat_model(self):
+        """Decode path bypasses remat (no backward) and still works."""
+        from distributed_training_tpu.inference import Generator, SampleConfig
+
+        model = get_model("transformer_lm", num_classes=32, remat=True,
+                          num_layers=2, num_heads=2, hidden_dim=32, max_len=32)
+        tokens = jnp.zeros((1, 4), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        out = Generator(model, params, SampleConfig(
+            max_new_tokens=4, temperature=0.0))(np.array([[1, 2]]))
+        assert out.shape == (1, 4)
