@@ -208,6 +208,13 @@ class LMConfig:
     max_len: int = 2048
     num_microbatches: int = 1
     attn_impl: str = "exact"  # exact | flash (Pallas kernel; not w/ sequence)
+    # Chunked cross-entropy: apply the lm_head + CE over time chunks of
+    # this many tokens so the [B, T, vocab] logits never materialize
+    # (B8·T16k·V50k fp32 = 26 GB — the memory wall for long-context ×
+    # large-vocab training). None = whole-sequence logits. Must divide
+    # the (per-shard) sequence length; not supported with the pipeline
+    # executor.
+    ce_chunk_size: int | None = None
     corpus_path: str | None = None  # byte-level text file; None → synthetic
     train_sequences: int = 2048     # synthetic dataset size
     eval_sequences: int = 256

@@ -164,7 +164,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = False,
-                 decode: bool = False):
+                 decode: bool = False, return_hidden: bool = False):
         """``decode=True`` runs the cached autoregressive path: every block
         appends K/V for this call's tokens to its ``cache`` collection
         (length ``cache_len``, default ``max_len``) and attends against the
@@ -173,7 +173,14 @@ class TransformerLM(nn.Module):
         embedding here — the causal offset and write slot come from each
         layer's internal ``cache_index`` counter, so callers must keep
         ``positions`` consistent with the number of tokens already decoded
-        (position t == t-th token fed to this cache)."""
+        (position t == t-th token fed to this cache).
+
+        ``return_hidden=True`` returns the final-norm hidden states
+        [B, T, D] *instead of* logits — the hook for chunked
+        cross-entropy, which applies the (untouched) ``lm_head`` params
+        chunk-by-chunk so the [B, T, vocab] logits tensor never
+        materializes (``train/lm_step.py::chunked_ce_and_accuracy``).
+        Init always runs the head (default False) so its params exist."""
         if decode and positions is None:
             raise ValueError(
                 "decode=True requires explicit positions (the pos-embed row "
@@ -226,6 +233,8 @@ class TransformerLM(nn.Module):
                 cache_len=self.cache_len or self.max_len,
                 name=f"block{i}")(x, train, decode)
         x = make_final_norm(self, name="ln_f")(x)
+        if return_hidden:
+            return x
         return make_lm_head(self, name="lm_head")(x)
 
 

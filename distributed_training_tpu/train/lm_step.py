@@ -42,16 +42,64 @@ from distributed_training_tpu.utils.compat import shard_map
 _GRAD_AXES = (AXIS_DATA, AXIS_SEQUENCE)
 
 
-def _lm_loss_and_grads(state: TrainState, tokens, targets, rng, positions=None):
+def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int):
+    """CE + token accuracy WITHOUT materializing the [B, T, vocab] logits.
+
+    For long contexts × large vocabs the logits tensor dominates memory
+    (B8·T16384·V50304 fp32 = 26 GB — measured OOM on v5e, BASELINE.md):
+    scan over time chunks, apply the lm_head to one [B, C, D] slice at a
+    time, and reduce CE/accuracy to scalars. The body is
+    ``jax.checkpoint``-ed so the backward also recomputes each chunk's
+    logits instead of saving softmax residuals (which would re-create the
+    full tensor). Math matches ``make_lm_head`` exactly: fp32 matmul
+    (``gpt.py::make_lm_head`` sets dtype=fp32, which promotes inputs).
+    """
+    b, t, d = hidden.shape
+    if t % chunk:
+        raise ValueError(f"ce_chunk {chunk} must divide sequence length {t}")
+    n = t // chunk
+    w = head_params["kernel"].astype(jnp.float32)
+    bias = head_params["bias"].astype(jnp.float32)
+    hs = jnp.swapaxes(hidden.reshape(b, n, chunk, d), 0, 1)  # [n, B, C, D]
+    ts = jnp.swapaxes(targets.reshape(b, n, chunk), 0, 1)    # [n, B, C]
+
+    @jax.checkpoint
+    def body(carry, xs):
+        ce_sum, acc_sum = carry
+        hc, tc = xs
+        logits = hc.astype(jnp.float32) @ w + bias
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc).sum()
+        acc = jnp.sum((jnp.argmax(logits, -1) == tc).astype(jnp.float32))
+        return (ce_sum + ce, acc_sum + acc), None
+
+    (ce_sum, acc_sum), _ = lax.scan(
+        body, (jnp.float32(0), jnp.float32(0)), (hs, ts))
+    denom = jnp.float32(b * t)
+    return ce_sum / denom, acc_sum / denom
+
+
+def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
+                       positions=None, ce_chunk: int | None = None):
     """Scaled-CE (+ MoE aux) value-and-grad shared by every LM step variant.
 
-    Returns ``(grads, ce, aux, logits)`` — CE and the MoE load-balancing
+    Returns ``(grads, ce, aux, accuracy)`` — CE and the MoE load-balancing
     term separately, so metrics can report perplexity as ``exp(CE)``
     (comparable to the CE-only eval loss) while the gradient flows through
-    ``CE + aux``.
+    ``CE + aux``. ``ce_chunk`` computes the CE through
+    :func:`chunked_ce_and_accuracy` (the model returns hidden states and
+    the head applies per chunk).
     """
     def loss_fn(params):
         rngs = dict(zip(("dropout", "gate"), jax.random.split(rng)))
+        if ce_chunk:
+            hidden, mutated = state.apply_fn(
+                {"params": params}, tokens, positions=positions, train=True,
+                rngs=rngs, mutable=["aux_loss"], return_hidden=True)
+            aux = sum(jax.tree.leaves(dict(mutated).get("aux_loss", {})),
+                      jnp.float32(0))
+            ce, accuracy = chunked_ce_and_accuracy(
+                hidden, params["lm_head"], targets, ce_chunk)
+            return state.loss_scale.scale_loss(ce + aux), (ce, aux, accuracy)
         out = state.apply_fn(
             {"params": params}, tokens, positions=positions, train=True,
             rngs=rngs, mutable=["aux_loss"])
@@ -63,23 +111,21 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng, positions=None):
             logits, aux = out, jnp.float32(0)
         ce = optax.softmax_cross_entropy_with_integer_labels(
             logits, targets).mean()
-        return state.loss_scale.scale_loss(ce + aux), (ce, aux, logits)
+        accuracy = jnp.mean(
+            (jnp.argmax(logits, -1) == targets).astype(jnp.float32))
+        return state.loss_scale.scale_loss(ce + aux), (ce, aux, accuracy)
 
-    grads, (ce, aux, logits) = jax.grad(loss_fn, has_aux=True)(state.params)
-    return grads, ce, aux, logits
+    grads, (ce, aux, accuracy) = jax.grad(loss_fn, has_aux=True)(state.params)
+    return grads, ce, aux, accuracy
 
 
-def _lm_metrics(new_state: TrainState, ce, aux, logits, targets, finite,
-                pmean_axes=None, accuracy=None):
+def _lm_metrics(new_state: TrainState, ce, aux, accuracy, finite,
+                pmean_axes=None):
     """The LM metrics contract; ``pmean_axes`` averages shard-local values
     (the GSPMD path computes global values already). ``loss`` is the full
     objective (CE + MoE aux); ``perplexity`` is ``exp(CE)`` so it stays
-    comparable to eval perplexity. ``accuracy`` may be precomputed (the
-    grad-accum path averages it across microbatches; pass logits/targets as
-    None then) — keep this dict the single source of the metric key set."""
-    if accuracy is None:
-        accuracy = jnp.mean(
-            (jnp.argmax(logits, -1) == targets).astype(jnp.float32))
+    comparable to eval perplexity. Keep this dict the single source of
+    the metric key set."""
     if pmean_axes:
         ce = lax.pmean(ce, pmean_axes)
         aux = lax.pmean(aux, pmean_axes)
@@ -94,7 +140,7 @@ def _lm_metrics(new_state: TrainState, ce, aux, logits, targets, finite,
     }
 
 
-def _lm_step_body(state: TrainState, batch, rng):
+def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None):
     tokens = batch["tokens"]
     targets = batch["targets"]
     t_local = tokens.shape[1]
@@ -104,19 +150,20 @@ def _lm_step_body(state: TrainState, batch, rng):
     shard_rng = jax.random.fold_in(
         rng, seq_idx * lax.axis_size(AXIS_DATA) + lax.axis_index(AXIS_DATA))
 
-    grads, ce, aux, logits = _lm_loss_and_grads(
-        state, tokens, targets, shard_rng, positions=positions)
+    grads, ce, aux, accuracy = _lm_loss_and_grads(
+        state, tokens, targets, shard_rng, positions=positions,
+        ce_chunk=ce_chunk)
     grads = lax.pmean(grads, _GRAD_AXES)
     grads = state.loss_scale.unscale_grads(grads)
 
     new_state, finite = commit_gradients(state, grads)
     return new_state, _lm_metrics(
-        new_state, ce, aux, logits, targets, finite, pmean_axes=_GRAD_AXES)
+        new_state, ce, aux, accuracy, finite, pmean_axes=_GRAD_AXES)
 
 
 def make_lm_train_step(
     mesh: Mesh, *, model=None, max_len: int | None = None,
-    donate: bool = True,
+    donate: bool = True, ce_chunk: int | None = None,
 ) -> Callable:
     """Build the (data × sequence)-parallel jitted LM train step.
 
@@ -145,7 +192,7 @@ def make_lm_train_step(
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def jitted(state: TrainState, batch, rng):
         sharded = shard_map(
-            _lm_step_body, mesh,
+            functools.partial(_lm_step_body, ce_chunk=ce_chunk), mesh,
             in_specs=(jax.tree.map(lambda _: P(), state), batch_spec, P()),
             out_specs=(jax.tree.map(lambda _: P(), state), P()),
         )
@@ -169,6 +216,7 @@ def _make_gspmd_lm_step(
     max_len: int | None = None,
     donate: bool = True,
     grad_accum_steps: int = 1,
+    ce_chunk: int | None = None,
 ) -> Callable:
     """Shared GSPMD LM step builder (the TP and PP steps differ only in how
     the train state is placed): batch over ``data``, lazy jit once a
@@ -189,11 +237,9 @@ def _make_gspmd_lm_step(
     def body(state: TrainState, batch, rng):
         if grad_accum_steps > 1:
             def micro_fn(params, mbatch, r, carry):
-                grads, ce, aux, logits = _lm_loss_and_grads(
+                grads, ce, aux, acc = _lm_loss_and_grads(
                     state.replace(params=params), mbatch["tokens"],
-                    mbatch["targets"], r)
-                acc = jnp.mean((jnp.argmax(logits, -1) ==
-                                mbatch["targets"]).astype(jnp.float32))
+                    mbatch["targets"], r, ce_chunk=ce_chunk)
                 return grads, carry, (ce, aux, acc)
 
             grads, _, (ces, auxs, accs) = accumulate_grads(
@@ -202,14 +248,12 @@ def _make_gspmd_lm_step(
             grads = state.loss_scale.unscale_grads(grads)
             new_state, finite = commit_gradients(state, grads)
             return new_state, _lm_metrics(
-                new_state, ces.mean(), auxs.mean(), None, None, finite,
-                accuracy=accs.mean())
-        grads, ce, aux, logits = _lm_loss_and_grads(
-            state, batch["tokens"], batch["targets"], rng)
+                new_state, ces.mean(), auxs.mean(), accs.mean(), finite)
+        grads, ce, aux, accuracy = _lm_loss_and_grads(
+            state, batch["tokens"], batch["targets"], rng, ce_chunk=ce_chunk)
         grads = state.loss_scale.unscale_grads(grads)
         new_state, finite = commit_gradients(state, grads)
-        return new_state, _lm_metrics(
-            new_state, ce, aux, logits, batch["targets"], finite)
+        return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
 
     jitted = None  # built lazily: shardings need a concrete state's pytree
 
@@ -236,7 +280,7 @@ def _make_gspmd_lm_step(
 
 def make_tp_lm_train_step(
     mesh: Mesh, *, model, zero_stage: int = 0, donate: bool = True,
-    grad_accum_steps: int = 1,
+    grad_accum_steps: int = 1, ce_chunk: int | None = None,
 ) -> Callable:
     """Tensor-parallel (megatron-style) LM train step via GSPMD placement.
 
@@ -271,7 +315,7 @@ def make_tp_lm_train_step(
         mesh,
         lambda state: tp_state_shardings(state, mesh, zero_stage=zero_stage),
         max_len=model.max_len, donate=donate,
-        grad_accum_steps=grad_accum_steps)
+        grad_accum_steps=grad_accum_steps, ce_chunk=ce_chunk)
 
 
 def make_pp_lm_train_step(

@@ -115,6 +115,21 @@ class LMTrainer:
             raise ValueError(
                 f"sequence-parallel size {seq} must divide seq_len "
                 f"(= {lm.seq_len})")
+        if lm.ce_chunk_size is not None:
+            if lm.ce_chunk_size < 1:
+                raise ValueError(
+                    f"ce_chunk_size must be >= 1, got {lm.ce_chunk_size}")
+            if self.strategy == "pipeline":
+                raise NotImplementedError(
+                    "ce_chunk_size does not compose with the pipeline "
+                    "executor (its apply returns logits directly)")
+            # Token datasets yield seq_len+1 tokens so the shifted loss
+            # length is exactly seq_len (seq_len/sp per sequence shard).
+            t_loss = lm.seq_len // seq
+            if t_loss % lm.ce_chunk_size:
+                raise ValueError(
+                    f"ce_chunk_size {lm.ce_chunk_size} must divide the "
+                    f"per-shard loss sequence length (= {t_loss})")
         if pipe > 1:
             if lm.num_layers % pipe:
                 raise ValueError(
@@ -202,7 +217,8 @@ class LMTrainer:
         elif self.strategy == "sequence":
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            self.train_step = make_lm_train_step(self.mesh, model=self.model)
+            self.train_step = make_lm_train_step(
+                self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -211,7 +227,8 @@ class LMTrainer:
         else:
             self.train_step = make_tp_lm_train_step(
                 self.mesh, model=self.model, zero_stage=cfg.zero.stage,
-                grad_accum_steps=self.grad_accum)
+                grad_accum_steps=self.grad_accum,
+                ce_chunk=lm.ce_chunk_size)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -234,11 +251,24 @@ class LMTrainer:
         else:
             eval_apply = self.state.apply_fn
 
-        def eval_loss(params, batch):
-            logits = eval_apply({"params": params}, batch["tokens"],
-                                train=False)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, batch["targets"]).mean()
+        if lm.ce_chunk_size:
+            from distributed_training_tpu.train.lm_step import (
+                chunked_ce_and_accuracy,
+            )
+
+            def eval_loss(params, batch):
+                hidden = eval_apply({"params": params}, batch["tokens"],
+                                    train=False, return_hidden=True)
+                ce, _ = chunked_ce_and_accuracy(
+                    hidden, params["lm_head"], batch["targets"],
+                    lm.ce_chunk_size)
+                return ce
+        else:
+            def eval_loss(params, batch):
+                logits = eval_apply({"params": params}, batch["tokens"],
+                                    train=False)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch["targets"]).mean()
 
         self._eval_fn = jax.jit(eval_loss)
 

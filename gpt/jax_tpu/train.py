@@ -40,6 +40,10 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--attn-impl", type=str, default="exact",
                         choices=["exact", "flash"],
                         help="flash = Pallas blockwise kernel (not with --sp)")
+    parser.add_argument("--ce-chunk-size", type=int, default=None,
+                        help="chunked cross-entropy: tokens per lm_head+CE "
+                             "chunk (never materializes [B,T,vocab] logits; "
+                             "for long-context × large-vocab runs)")
     # MoE surface (DeepSpeed flag names, resnet/deepspeed parity) — here
     # they swap alternating decoder FFNs for expert-parallel MoE layers.
     parser.add_argument("--moe", action="store_true", default=False)
@@ -133,6 +137,7 @@ def build_config(args: argparse.Namespace):
             max_len=args.max_len,
             num_microbatches=args.microbatches,
             attn_impl=args.attn_impl,
+            ce_chunk_size=args.ce_chunk_size,
             corpus_path=args.corpus,
         ),
     )
