@@ -40,11 +40,19 @@ class OptimizerConfig:
     ``colossal_train.py:116-122``) — expressed here via ``scale_lr_by_world``.
     """
 
+    # adam | adamw | sgd | lamb | hybrid_adam (Pallas fused)
     name: str = "adam"
     lr: float = 1e-3
     betas: tuple = (0.9, 0.999)
     eps: float = 1e-8
     weight_decay: float = 0.0
+    # The ImageNet-recipe convention: don't decay biases/BN/LayerNorm
+    # params. "all" decays everything (torch default); "no_1d" masks out
+    # rank-<2 params (biases, norm scales/offsets).
+    weight_decay_mask: str = "all"  # all | no_1d
+    # SGD-family knobs (ignored by the Adam family).
+    momentum: float = 0.9
+    nesterov: bool = False
     scale_lr_by_world: bool = False
     # Gradient clipping: ds_config "gradient_clipping": 1.0
     # (deepspeed_train.py:195). None disables.

@@ -89,14 +89,17 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
     :func:`chunked_ce_and_accuracy` (the model returns hidden states and
     the head applies per chunk).
     """
+    def sown_aux(mutated):
+        return sum(jax.tree.leaves(dict(mutated).get("aux_loss", {})),
+                   jnp.float32(0))
+
     def loss_fn(params):
         rngs = dict(zip(("dropout", "gate"), jax.random.split(rng)))
         if ce_chunk:
             hidden, mutated = state.apply_fn(
                 {"params": params}, tokens, positions=positions, train=True,
                 rngs=rngs, mutable=["aux_loss"], return_hidden=True)
-            aux = sum(jax.tree.leaves(dict(mutated).get("aux_loss", {})),
-                      jnp.float32(0))
+            aux = sown_aux(mutated)
             ce, accuracy = chunked_ce_and_accuracy(
                 hidden, params["lm_head"], targets, ce_chunk)
             return state.loss_scale.scale_loss(ce + aux), (ce, aux, accuracy)
@@ -105,8 +108,7 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
             rngs=rngs, mutable=["aux_loss"])
         if isinstance(out, tuple):  # flax apply with a mutable collection
             logits, mutated = out
-            aux = sum(jax.tree.leaves(dict(mutated).get("aux_loss", {})),
-                      jnp.float32(0))
+            aux = sown_aux(mutated)
         else:  # PipelinedLM.apply_fn (no collections)
             logits, aux = out, jnp.float32(0)
         ce = optax.softmax_cross_entropy_with_integer_labels(

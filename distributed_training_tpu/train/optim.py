@@ -49,6 +49,38 @@ def make_schedule(opt: OptimizerConfig, sched: SchedulerConfig, world_size: int 
     raise ValueError(f"unknown scheduler {sched.name!r}")
 
 
+def decay_mask(opt: OptimizerConfig):
+    """optax weight-decay mask per ``weight_decay_mask``.
+
+    ``no_1d`` implements the standard ImageNet-recipe exclusion: biases
+    and normalization scales/offsets are not decayed. The test is rank>=2
+    AND leaf name not in {bias, scale} — the name check matters because
+    stacked executors (the pipeline strategy stacks per-layer params with
+    a leading layer dim) turn [D] norm params into rank-2 [L, D]; a pure
+    rank heuristic would decay them under one mesh and not another.
+    ``all`` (torch default semantics) returns None — decay everything.
+    """
+    if opt.weight_decay_mask == "all":
+        return None
+    if opt.weight_decay_mask == "no_1d":
+        import jax
+
+        def mask(params):
+            def leaf(path, p):
+                last = path[-1]
+                name = getattr(last, "key", None) or str(last)
+                return p.ndim >= 2 and name not in ("bias", "scale")
+            return jax.tree_util.tree_map_with_path(leaf, params)
+
+        return mask
+    raise ValueError(
+        f"unknown weight_decay_mask {opt.weight_decay_mask!r}")
+
+
+def _decay(opt: OptimizerConfig):
+    return optax.add_decayed_weights(opt.weight_decay, mask=decay_mask(opt))
+
+
 def make_optimizer(
     opt: OptimizerConfig,
     sched: SchedulerConfig | None = None,
@@ -57,9 +89,11 @@ def make_optimizer(
     """Build the full gradient transformation chain.
 
     Chain order mirrors the engines' semantics: clip the (already unscaled,
-    already all-reduced) global grad norm, then the Adam update. Weight decay
-    uses additive L2 (torch Adam ``weight_decay`` semantics, which is what
-    DeepSpeed's config maps to) rather than decoupled AdamW.
+    already all-reduced) global grad norm, then the update. 'adam' uses
+    additive L2 before the moments (torch Adam ``weight_decay`` semantics,
+    which is what DeepSpeed's config maps to); 'adamw' decouples it;
+    'sgd' adds L2 to the gradient before momentum (torch SGD semantics);
+    'lamb' is AdamW + per-layer trust ratios (large-batch training).
     """
     sched = sched or SchedulerConfig()
     lr = make_schedule(opt, sched, world_size)
@@ -72,22 +106,32 @@ def make_optimizer(
         from distributed_training_tpu.ops.fused_adam import fused_adam
 
         if opt.weight_decay:
-            parts.append(optax.add_decayed_weights(opt.weight_decay))
+            parts.append(_decay(opt))
         parts.append(fused_adam(
             lr, b1=opt.betas[0], b2=opt.betas[1], eps=opt.eps))
         return optax.chain(*parts)
     if opt.name == "adam":
         if opt.weight_decay:
-            parts.append(optax.add_decayed_weights(opt.weight_decay))
+            parts.append(_decay(opt))
         parts.append(
             optax.scale_by_adam(b1=opt.betas[0], b2=opt.betas[1], eps=opt.eps))
     elif opt.name == "adamw":
         parts.append(
             optax.scale_by_adam(b1=opt.betas[0], b2=opt.betas[1], eps=opt.eps))
         if opt.weight_decay:
-            parts.append(optax.add_decayed_weights(opt.weight_decay))
+            parts.append(_decay(opt))
     elif opt.name == "sgd":
-        parts.append(optax.trace(decay=0.9, nesterov=False))
+        if opt.weight_decay:
+            parts.append(_decay(opt))
+        if opt.momentum:
+            parts.append(optax.trace(decay=opt.momentum,
+                                     nesterov=opt.nesterov))
+    elif opt.name == "lamb":
+        parts.append(
+            optax.scale_by_adam(b1=opt.betas[0], b2=opt.betas[1], eps=opt.eps))
+        if opt.weight_decay:
+            parts.append(_decay(opt))
+        parts.append(optax.scale_by_trust_ratio())
     else:
         raise ValueError(f"unknown optimizer {opt.name!r}")
     parts.append(optax.scale_by_learning_rate(lr))

@@ -65,6 +65,20 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--remat", action="store_true", default=False,
                         help="activation checkpointing per block (fit "
                              "bigger batches; ~30% extra backward FLOPs)")
+
+    # -- optimizer overrides (None = keep the plugin preset) ----------------
+    parser.add_argument("--optimizer", type=str, default=None,
+                        choices=["adam", "adamw", "sgd", "lamb",
+                                 "hybrid_adam"])
+    parser.add_argument("--lr", type=float, default=None)
+    parser.add_argument("--momentum", type=float, default=None,
+                        help="SGD momentum (sgd only)")
+    parser.add_argument("--nesterov", action="store_true", default=False)
+    parser.add_argument("--weight-decay", type=float, default=None)
+    parser.add_argument("--weight-decay-mask", type=str, default=None,
+                        choices=["all", "no_1d"],
+                        help="no_1d = don't decay biases/norm params "
+                             "(ImageNet recipe)")
     parser.add_argument("--log-interval", type=int, default=100,
                         help="steps between metric fetches/logs")
     parser.add_argument("--dtype", type=str, default="fp32",
@@ -254,6 +268,22 @@ def build_config(args: argparse.Namespace):
             moe_param_group=args.moe_param_group,
         ),
     )
+
+    # Optimizer overrides on top of the plugin preset (None = keep preset).
+    opt_overrides = {
+        k: v for k, v in (
+            ("name", args.optimizer),
+            ("lr", args.lr),
+            ("momentum", args.momentum),
+            ("weight_decay", args.weight_decay),
+            ("weight_decay_mask", args.weight_decay_mask),
+        ) if v is not None
+    }
+    if args.nesterov:
+        opt_overrides["nesterov"] = True
+    if opt_overrides:
+        cfg = cfg.replace(
+            optimizer=dataclasses.replace(cfg.optimizer, **opt_overrides))
     return cfg
 
 

@@ -118,7 +118,7 @@ class TestStepEquivalence:
             np.testing.assert_allclose(
                 float(ma[k]), float(mb[k]), rtol=1e-5)
 
-    def test_sequence_step_chunked_matches_plain(self, mesh8x1=None):
+    def test_sequence_step_chunked_matches_plain(self):
         from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
 
         mesh = create_mesh(MeshConfig(data=2, sequence=4))

@@ -1,0 +1,155 @@
+"""Optimizer-factory tests: SGD/LAMB families, weight-decay masking."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_training_tpu.config import OptimizerConfig, SchedulerConfig
+from distributed_training_tpu.train.optim import decay_mask, make_optimizer
+
+PARAMS = {
+    "dense": {"kernel": jnp.ones((3, 4)), "bias": jnp.ones((4,))},
+    "bn": {"scale": jnp.ones((4,)), "bias": jnp.zeros((4,))},
+}
+
+
+def _step(tx, params, grads=None):
+    grads = grads if grads is not None else jax.tree.map(jnp.ones_like, params)
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    return optax.apply_updates(params, updates)
+
+
+class TestSGD:
+    def test_matches_optax_sgd_momentum(self):
+        cfg = OptimizerConfig(name="sgd", lr=0.1, momentum=0.9,
+                              weight_decay=0.0)
+        ours = make_optimizer(cfg)
+        ref = optax.sgd(0.1, momentum=0.9)
+        p1, p2 = dict(PARAMS), dict(PARAMS)
+        s1, s2 = ours.init(p1), ref.init(p2)
+        g = jax.tree.map(lambda x: 0.5 * jnp.ones_like(x), PARAMS)
+        for _ in range(3):
+            u1, s1 = ours.update(g, s1, p1)
+            u2, s2 = ref.update(g, s2, p2)
+            p1 = optax.apply_updates(p1, u1)
+            p2 = optax.apply_updates(p2, u2)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), p1, p2)
+
+    def test_nesterov_differs_from_plain(self):
+        plain = make_optimizer(OptimizerConfig(name="sgd", lr=0.1))
+        nest = make_optimizer(OptimizerConfig(name="sgd", lr=0.1,
+                                              nesterov=True))
+        g = jax.tree.map(jnp.ones_like, PARAMS)
+        sp, sn = plain.init(PARAMS), nest.init(PARAMS)
+        # Second step: momentum buffers populated, nesterov lookahead shows.
+        up, sp = plain.update(g, sp, PARAMS)
+        up2, _ = plain.update(g, sp, PARAMS)
+        un, sn = nest.update(g, sn, PARAMS)
+        un2, _ = nest.update(g, sn, PARAMS)
+        a = float(up2["dense"]["kernel"][0, 0])
+        b = float(un2["dense"]["kernel"][0, 0])
+        assert a != pytest.approx(b)
+
+    def test_weight_decay_torch_semantics(self):
+        """L2 joins the gradient BEFORE momentum (torch SGD)."""
+        cfg = OptimizerConfig(name="sgd", lr=1.0, momentum=0.0,
+                              weight_decay=0.1)
+        tx = make_optimizer(cfg)
+        p = {"w": jnp.full((2, 2), 2.0)}
+        new = _step(tx, p, grads={"w": jnp.zeros((2, 2))})
+        # grad 0 + wd*p = 0.2 → p' = 2.0 - 1.0*0.2
+        np.testing.assert_allclose(np.asarray(new["w"]), 1.8, rtol=1e-6)
+
+
+class TestLamb:
+    def test_runs_and_trust_ratio_scales(self):
+        cfg = OptimizerConfig(name="lamb", lr=0.01, weight_decay=0.01)
+        tx = make_optimizer(cfg)
+        new = _step(tx, PARAMS)
+        finite = jax.tree.map(lambda x: bool(np.isfinite(x).all()), new)
+        assert all(jax.tree.leaves(finite))
+
+    def test_matches_optax_lamb(self):
+        cfg = OptimizerConfig(name="lamb", lr=0.01, betas=(0.9, 0.999),
+                              eps=1e-6, weight_decay=0.0)
+        ours = make_optimizer(cfg)
+        ref = optax.lamb(0.01, b1=0.9, b2=0.999, eps=1e-6, weight_decay=0.0)
+        g = jax.tree.map(lambda x: 0.3 * jnp.ones_like(x), PARAMS)
+        p1 = _step_with(ours, PARAMS, g, 3)
+        p2 = _step_with(ref, PARAMS, g, 3)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5), p1, p2)
+
+
+def _step_with(tx, params, grads, n):
+    state = tx.init(params)
+    for _ in range(n):
+        updates, state = tx.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    return params
+
+
+class TestDecayMask:
+    def test_no_1d_excludes_biases_and_norms(self):
+        mask = decay_mask(OptimizerConfig(weight_decay_mask="no_1d"))(PARAMS)
+        assert mask["dense"]["kernel"] is True
+        assert mask["dense"]["bias"] is False
+        assert mask["bn"]["scale"] is False and mask["bn"]["bias"] is False
+
+    def test_stacked_norm_params_still_excluded(self):
+        """Pipeline stacking turns [D] norm params into [L, D]; the name
+        check keeps them out of the decay set regardless of rank."""
+        stacked = {"blocks": {"ln1": {"scale": jnp.ones((4, 8)),
+                                      "bias": jnp.zeros((4, 8))},
+                              "mlp": {"kernel": jnp.ones((4, 8, 16))}}}
+        mask = decay_mask(OptimizerConfig(weight_decay_mask="no_1d"))(stacked)
+        assert mask["blocks"]["ln1"]["scale"] is False
+        assert mask["blocks"]["ln1"]["bias"] is False
+        assert mask["blocks"]["mlp"]["kernel"] is True
+
+    def test_all_returns_none(self):
+        assert decay_mask(OptimizerConfig(weight_decay_mask="all")) is None
+
+    def test_unknown_mask_rejected(self):
+        with pytest.raises(ValueError, match="weight_decay_mask"):
+            decay_mask(OptimizerConfig(weight_decay_mask="bogus"))
+
+    def test_masked_decay_leaves_1d_untouched(self):
+        cfg = OptimizerConfig(name="sgd", lr=1.0, momentum=0.0,
+                              weight_decay=0.5, weight_decay_mask="no_1d")
+        tx = make_optimizer(cfg)
+        zero_g = jax.tree.map(jnp.zeros_like, PARAMS)
+        new = _step(tx, PARAMS, grads=zero_g)
+        # kernel decayed, 1-d params untouched
+        np.testing.assert_allclose(np.asarray(new["dense"]["kernel"]), 0.5)
+        np.testing.assert_allclose(np.asarray(new["dense"]["bias"]), 1.0)
+        np.testing.assert_allclose(np.asarray(new["bn"]["scale"]), 1.0)
+
+
+class TestCliOverrides:
+    def test_resnet_cli_overrides_optimizer(self):
+        import importlib.util
+        import sys
+
+        spec = importlib.util.spec_from_file_location(
+            "resnet_jax_train", "resnet/jax_tpu/train.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        argv = sys.argv
+        try:
+            sys.argv = ["train.py", "--optimizer", "sgd", "--lr", "0.05",
+                        "--momentum", "0.85", "--nesterov",
+                        "--weight-decay", "1e-4",
+                        "--weight-decay-mask", "no_1d"]
+            args = mod.add_argument()
+        finally:
+            sys.argv = argv
+        cfg = mod.build_config(args)
+        o = cfg.optimizer
+        assert (o.name, o.lr, o.momentum, o.nesterov) == (
+            "sgd", 0.05, 0.85, True)
+        assert o.weight_decay == 1e-4 and o.weight_decay_mask == "no_1d"
