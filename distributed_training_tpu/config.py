@@ -53,6 +53,10 @@ class OptimizerConfig:
     # SGD-family knobs (ignored by the Adam family).
     momentum: float = 0.9
     nesterov: bool = False
+    # Parameter EMA (e.g. 0.9999): the optimizer state carries a moving
+    # average of the post-update params; evaluation can use it via
+    # train/optim.py::ema_params (Trainer does when eval_with_ema).
+    ema_decay: float | None = None
     scale_lr_by_world: bool = False
     # Gradient clipping: ds_config "gradient_clipping": 1.0
     # (deepspeed_train.py:195). None disables.
@@ -240,6 +244,8 @@ class TrainConfig:
     # Uniform label smoothing for the classification CE (ImageNet recipe);
     # 0 = the reference's plain nn.CrossEntropyLoss.
     label_smoothing: float = 0.0
+    # Evaluate with the EMA parameters when optimizer.ema_decay is set.
+    eval_with_ema: bool = True
     # Activation checkpointing (jax.checkpoint per block): O(depth)
     # activation memory for ~30% extra backward FLOPs. Unlocks configs
     # that otherwise OOM (e.g. ViT-B/16 batch 512/chip on v5e).

@@ -370,11 +370,21 @@ class LMTrainer:
         return self.meter.last
 
     # -- eval ---------------------------------------------------------------
+    def _eval_params(self):
+        """Params evaluation sees: the EMA tree when configured."""
+        if (self.cfg.optimizer.ema_decay is not None
+                and self.cfg.eval_with_ema):
+            from distributed_training_tpu.train.optim import ema_params
+
+            return ema_params(self.state.opt_state)
+        return self.state.params
+
     def evaluate(self, loader: TokenLoader) -> float:
         """Mean held-out perplexity (exp of the mean token CE)."""
+        params = self._eval_params()
         losses = []
         for gbatch in self._batches(loader):
-            losses.append(float(self._eval_fn(self.state.params, gbatch)))
+            losses.append(float(self._eval_fn(params, gbatch)))
         if not losses:
             raise ValueError(
                 "eval loader yielded no batches (eval_sequences "

@@ -15,9 +15,90 @@ Parity targets:
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
 import optax
 
 from distributed_training_tpu.config import OptimizerConfig, SchedulerConfig
+
+
+class EmaState(NamedTuple):
+    """State of :func:`with_ema`: the wrapped optimizer's state plus the
+    exponential moving average of the *post-update* parameters — and, for
+    BatchNorm models, of the running statistics (maintained by
+    ``precision.commit_gradients``, the one place both trees exist;
+    evaluating EMA weights against live-weight BN statistics would skew
+    the metric). ``ema_batch_stats`` is ``{}`` for stat-less models."""
+
+    inner: Any
+    ema_params: Any
+    ema_batch_stats: Any
+    decay: jnp.ndarray
+
+
+def with_ema(tx: optax.GradientTransformation,
+             decay: float) -> optax.GradientTransformation:
+    """Wrap ``tx`` so its state carries an EMA of the updated params.
+
+    Living inside ``opt_state`` (rather than a parallel TrainState field)
+    buys checkpointing and ZeRO sharding for free: the EMA tree is just
+    more optimizer state, so orbax saves it and the stage-1/2 placement
+    rules shard it over ``data`` like Adam moments. The fp16 path's
+    skip-on-overflow also covers it — a rejected step discards the whole
+    tentative opt_state, EMA included.
+
+    The average is initialized to the initial params (the standard,
+    already-unbiased choice). ``decay`` (e.g. 0.9999) is kept in the
+    state so ``commit_gradients`` can apply the same constant to the
+    BatchNorm-statistics average (``TrainState.create`` seeds
+    ``ema_batch_stats`` for models that carry stats).
+    """
+    def init(params):
+        return EmaState(
+            inner=tx.init(params),
+            # Real copies: jnp.asarray would alias the param buffers, and
+            # an opt_state leaf aliasing a param breaks buffer donation
+            # ("attempt to donate the same buffer twice").
+            ema_params=jax.tree.map(
+                lambda p: jnp.array(p, copy=True), params),
+            ema_batch_stats={},
+            decay=jnp.float32(decay),
+        )
+
+    def update(updates, state, params=None, **extra):
+        if params is None:
+            raise ValueError("with_ema requires params in update()")
+        new_updates, inner = tx.update(updates, state.inner, params, **extra)
+        new_params = optax.apply_updates(params, new_updates)
+        # state.decay (not the closure constant): the checkpointed value is
+        # the single source of truth, so params and BN-stats EMAs cannot
+        # advance at different rates after a resume with a changed config.
+        d = state.decay
+        ema = jax.tree.map(
+            lambda e, p: d * e + (1.0 - d) * p,
+            state.ema_params, new_params)
+        return new_updates, state._replace(inner=inner, ema_params=ema)
+
+    return optax.GradientTransformation(init, update)
+
+
+def ema_params(opt_state: Any) -> Any:
+    """Extract the EMA parameter tree from an optimizer state built with
+    ``OptimizerConfig(ema_decay=...)``; raises if EMA was not enabled."""
+    if isinstance(opt_state, EmaState):
+        return opt_state.ema_params
+    raise ValueError(
+        "optimizer state carries no EMA; set OptimizerConfig.ema_decay")
+
+
+def ema_batch_stats(opt_state: Any) -> Any:
+    """The EMA of BatchNorm running stats ({} for stat-less models)."""
+    if isinstance(opt_state, EmaState):
+        return opt_state.ema_batch_stats
+    raise ValueError(
+        "optimizer state carries no EMA; set OptimizerConfig.ema_decay")
 
 
 def make_schedule(opt: OptimizerConfig, sched: SchedulerConfig, world_size: int = 1):
@@ -63,8 +144,6 @@ def decay_mask(opt: OptimizerConfig):
     if opt.weight_decay_mask == "all":
         return None
     if opt.weight_decay_mask == "no_1d":
-        import jax
-
         def mask(params):
             def leaf(path, p):
                 last = path[-1]
@@ -109,7 +188,8 @@ def make_optimizer(
             parts.append(_decay(opt))
         parts.append(fused_adam(
             lr, b1=opt.betas[0], b2=opt.betas[1], eps=opt.eps))
-        return optax.chain(*parts)
+        tx = optax.chain(*parts)
+        return tx if opt.ema_decay is None else with_ema(tx, opt.ema_decay)
     if opt.name == "adam":
         if opt.weight_decay:
             parts.append(_decay(opt))
@@ -135,4 +215,7 @@ def make_optimizer(
     else:
         raise ValueError(f"unknown optimizer {opt.name!r}")
     parts.append(optax.scale_by_learning_rate(lr))
-    return optax.chain(*parts)
+    tx = optax.chain(*parts)
+    if opt.ema_decay is not None:
+        tx = with_ema(tx, opt.ema_decay)
+    return tx

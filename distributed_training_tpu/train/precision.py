@@ -161,7 +161,8 @@ def commit_gradients(state, grads, new_batch_stats=None):
     """
     if state.loss_scale.dynamic:
         finite = all_finite(grads)
-        candidate = state.apply_gradients(grads)
+        candidate = _with_ema_batch_stats(
+            state.apply_gradients(grads), new_batch_stats)
         new_state = select_tree(
             finite,
             candidate.replace(loss_scale=state.loss_scale.update(finite)),
@@ -175,7 +176,25 @@ def commit_gradients(state, grads, new_batch_stats=None):
                     finite, new_batch_stats, state.batch_stats))
     else:
         finite = jnp.bool_(True)
-        new_state = state.apply_gradients(grads)
+        new_state = _with_ema_batch_stats(
+            state.apply_gradients(grads), new_batch_stats)
         if new_batch_stats is not None:
             new_state = new_state.replace(batch_stats=new_batch_stats)
     return new_state, finite
+
+
+def _with_ema_batch_stats(state, new_batch_stats):
+    """Advance the EMA of BatchNorm running stats alongside the parameter
+    EMA (``optim.with_ema`` sees only params; this is the one place both
+    trees exist). No-op unless EMA is enabled AND the model carries stats.
+    """
+    from distributed_training_tpu.train.optim import EmaState
+
+    es = state.opt_state
+    if (not isinstance(es, EmaState) or new_batch_stats is None
+            or not jax.tree.leaves(es.ema_batch_stats)):
+        return state
+    new_ema = jax.tree.map(
+        lambda e, b: es.decay * e + (1.0 - es.decay) * b,
+        es.ema_batch_stats, new_batch_stats)
+    return state.replace(opt_state=es._replace(ema_batch_stats=new_ema))

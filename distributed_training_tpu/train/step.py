@@ -204,6 +204,16 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
         # and at least as principled).
         new_state = new_state.replace(
             batch_stats=jax.lax.pmean(new_state.batch_stats, axis_name))
+        # Same for the EMA of the stats (commit_gradients averaged in the
+        # per-shard values; EMA and pmean are both linear, so pmean-ing
+        # after commutes with averaging the pmean-ed stats).
+        from distributed_training_tpu.train.optim import EmaState
+
+        es = new_state.opt_state
+        if isinstance(es, EmaState) and jax.tree.leaves(es.ema_batch_stats):
+            new_state = new_state.replace(opt_state=es._replace(
+                ema_batch_stats=jax.lax.pmean(
+                    es.ema_batch_stats, axis_name)))
 
     accuracy = jnp.mean(
         (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))

@@ -32,11 +32,25 @@ class TrainState(struct.PyTreeNode):
 
     @classmethod
     def create(cls, *, apply_fn, params, tx, batch_stats=None, loss_scale=None):
+        batch_stats = batch_stats if batch_stats is not None else {}
+        opt_state = tx.init(params)
+        # Parameter EMA (optim.with_ema): seed the BatchNorm-statistics
+        # average here — optax init only sees params, but evaluating EMA
+        # weights against live-weight BN stats would skew the metric, so
+        # commit_gradients maintains this tree alongside ema_params. Seeded
+        # at create time so the opt_state pytree structure never changes
+        # mid-training (a lazy first-step init would retrigger compilation).
+        from distributed_training_tpu.train.optim import EmaState
+
+        if isinstance(opt_state, EmaState) and jax.tree.leaves(batch_stats):
+            opt_state = opt_state._replace(
+                ema_batch_stats=jax.tree.map(
+                    lambda b: jnp.array(b, copy=True), batch_stats))
         return cls(
             step=jnp.int32(0),
             params=params,
-            batch_stats=batch_stats if batch_stats is not None else {},
-            opt_state=tx.init(params),
+            batch_stats=batch_stats,
+            opt_state=opt_state,
             loss_scale=loss_scale if loss_scale is not None else
             LossScaleState(
                 scale=jnp.float32(1.0), good_steps=jnp.int32(0),

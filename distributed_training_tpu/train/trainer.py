@@ -219,12 +219,32 @@ class Trainer:
         return self.meter.last
 
     # -- eval ---------------------------------------------------------------
+    def _eval_state(self):
+        """The state evaluation sees: EMA params (and EMA BatchNorm stats —
+        averaged weights need matching normalization statistics) when
+        configured."""
+        if (self.cfg.optimizer.ema_decay is not None
+                and self.cfg.eval_with_ema):
+            from distributed_training_tpu.train.optim import (
+                ema_batch_stats,
+                ema_params,
+            )
+
+            state = self.state.replace(
+                params=ema_params(self.state.opt_state))
+            ema_bs = ema_batch_stats(self.state.opt_state)
+            if jax.tree.leaves(ema_bs):
+                state = state.replace(batch_stats=ema_bs)
+            return state
+        return self.state
+
     def evaluate(self, loader) -> float:
         """Top-1 accuracy (the ``target_acc`` metric); top-5 is kept on
         ``self.last_eval`` and written to the metric sinks."""
+        eval_state = self._eval_state()
         correct = correct5 = total = 0.0
         for gbatch in self._batches(loader):
-            c, c5, t = self.eval_step(self.state, gbatch)
+            c, c5, t = self.eval_step(eval_state, gbatch)
             correct += float(c)
             correct5 += float(c5)
             total += float(t)

@@ -29,6 +29,8 @@ def add_argument() -> argparse.Namespace:
                              "strategy; effective batch scales by this)")
     parser.add_argument("--remat", action="store_true", default=False,
                         help="activation-checkpoint each decoder block")
+    parser.add_argument("--ema-decay", type=float, default=None,
+                        help="parameter EMA decay; eval uses the average")
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--vocab-size", type=int, default=256)
     parser.add_argument("--num-layers", type=int, default=4)
@@ -95,6 +97,10 @@ def build_config(args: argparse.Namespace):
     )
 
     cfg = TrainConfig(model="transformer_lm")
+    if args.ema_decay is not None:
+        cfg = cfg.replace(
+            optimizer=dataclasses.replace(
+                cfg.optimizer, ema_decay=args.ema_decay))
     return cfg.replace(
         moe=MoEConfig(
             enabled=args.moe,

@@ -79,6 +79,9 @@ def add_argument() -> argparse.Namespace:
                         choices=["all", "no_1d"],
                         help="no_1d = don't decay biases/norm params "
                              "(ImageNet recipe)")
+    parser.add_argument("--ema-decay", type=float, default=None,
+                        help="parameter EMA decay (e.g. 0.9999); eval uses "
+                             "the averaged params")
     parser.add_argument("--log-interval", type=int, default=100,
                         help="steps between metric fetches/logs")
     parser.add_argument("--dtype", type=str, default="fp32",
@@ -277,6 +280,7 @@ def build_config(args: argparse.Namespace):
             ("momentum", args.momentum),
             ("weight_decay", args.weight_decay),
             ("weight_decay_mask", args.weight_decay_mask),
+            ("ema_decay", args.ema_decay),
         ) if v is not None
     }
     if args.nesterov:
