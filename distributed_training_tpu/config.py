@@ -355,7 +355,7 @@ def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> Tr
     cfg = base or TrainConfig.from_plugin("deepspeed")
     known = {
         "train_batch_size", "train_micro_batch_size_per_gpu", "steps_per_print",
-        "gradient_accumulation_steps",
+        "gradient_accumulation_steps", "activation_checkpointing",
         "optimizer", "scheduler", "gradient_clipping", "prescale_gradients",
         "bf16", "fp16", "wall_clock_breakdown", "zero_optimization",
     }
@@ -367,18 +367,31 @@ def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> Tr
     if "optimizer" in ds:
         p = ds["optimizer"].get("params", {})
         opt_type = ds["optimizer"].get("type", "Adam").lower()
-        if opt_type not in ("adam", "adamw"):
-            raise ValueError("only Adam-family optimizers are supported")
-        opt = dataclasses.replace(
-            opt,
-            # 'adamw' selects DECOUPLED weight decay in make_optimizer;
-            # plain 'adam' couples it into the moments (torch semantics).
-            name=opt_type,
-            lr=p.get("lr", opt.lr),
-            betas=tuple(p.get("betas", opt.betas)),
-            eps=p.get("eps", opt.eps),
-            weight_decay=p.get("weight_decay", opt.weight_decay),
-        )
+        if opt_type in ("adam", "adamw", "lamb"):
+            # One moments-family mapping; 'adamw' selects DECOUPLED weight
+            # decay in make_optimizer, plain 'adam' couples it into the
+            # moments (torch semantics), 'lamb' adds trust ratios.
+            opt = dataclasses.replace(
+                opt,
+                name=opt_type,
+                lr=p.get("lr", opt.lr),
+                betas=tuple(p.get("betas", opt.betas)),
+                eps=p.get("eps", opt.eps),
+                weight_decay=p.get("weight_decay", opt.weight_decay),
+            )
+        elif opt_type == "sgd":
+            opt = dataclasses.replace(
+                opt,
+                name="sgd",
+                lr=p.get("lr", opt.lr),
+                momentum=p.get("momentum", opt.momentum),
+                nesterov=bool(p.get("nesterov", opt.nesterov)),
+                weight_decay=p.get("weight_decay", opt.weight_decay),
+            )
+        else:
+            raise ValueError(
+                f"unsupported ds optimizer type {ds['optimizer'].get('type')!r}"
+                " (adam | adamw | sgd | lamb)")
     if "gradient_clipping" in ds:
         opt = dataclasses.replace(opt, grad_clip_norm=float(ds["gradient_clipping"]))
 
@@ -431,8 +444,32 @@ def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> Tr
     if "train_micro_batch_size_per_gpu" in ds:
         data = dataclasses.replace(data, batch_size=int(ds["train_micro_batch_size_per_gpu"]))
 
+    # DeepSpeed's activation_checkpointing block maps onto per-block remat.
+    # Its sub-knobs are GPU-memory plumbing with no TPU analogue
+    # (partition_activations only shards saved activations across
+    # model-parallel ranks — it does NOT gate checkpointing), so a present
+    # block simply turns remat on; the sub-keys are validated and recorded
+    # as no-ops like the zero_optimization bucketing knobs.
+    remat = cfg.remat
+    if "activation_checkpointing" in ds:
+        ac = ds["activation_checkpointing"]
+        if isinstance(ac, Mapping):
+            unknown_ac = set(ac) - {
+                "partition_activations", "cpu_checkpointing",
+                "contiguous_memory_optimization", "number_checkpoints",
+                "synchronize_checkpoint_boundary", "profile",
+            }
+            if unknown_ac:
+                raise ValueError(
+                    f"unknown activation_checkpointing keys: "
+                    f"{sorted(unknown_ac)}")
+            remat = True
+        else:
+            remat = bool(ac)
+
     return cfg.replace(
         optimizer=opt, scheduler=sched, precision=prec, zero=zero, data=data,
+        remat=remat,
         gradient_accumulation_steps=int(
             ds.get("gradient_accumulation_steps",
                    cfg.gradient_accumulation_steps)),

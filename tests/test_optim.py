@@ -130,6 +130,60 @@ class TestDecayMask:
         np.testing.assert_allclose(np.asarray(new["bn"]["scale"]), 1.0)
 
 
+class TestDsConfigIngestion:
+    def test_sgd_from_ds_config(self):
+        from distributed_training_tpu.config import from_ds_config
+
+        cfg = from_ds_config({
+            "optimizer": {"type": "SGD",
+                          "params": {"lr": 0.1, "momentum": 0.95,
+                                     "nesterov": True,
+                                     "weight_decay": 1e-4}},
+        })
+        o = cfg.optimizer
+        assert (o.name, o.lr, o.momentum, o.nesterov, o.weight_decay) == (
+            "sgd", 0.1, 0.95, True, 1e-4)
+
+    def test_lamb_from_ds_config(self):
+        from distributed_training_tpu.config import from_ds_config
+
+        cfg = from_ds_config({
+            "optimizer": {"type": "Lamb",
+                          "params": {"lr": 2e-3, "betas": [0.9, 0.99]}},
+        })
+        assert cfg.optimizer.name == "lamb"
+        assert cfg.optimizer.betas == (0.9, 0.99)
+
+    def test_unknown_optimizer_rejected(self):
+        from distributed_training_tpu.config import from_ds_config
+
+        with pytest.raises(ValueError, match="unsupported ds optimizer"):
+            from_ds_config({"optimizer": {"type": "Adagrad"}})
+
+    def test_activation_checkpointing_maps_to_remat(self):
+        from distributed_training_tpu.config import from_ds_config
+
+        # Presence of the block = checkpointing on; partition_activations
+        # only shards saved activations in DeepSpeed (it does not gate
+        # checkpointing), so it must NOT flip remat off.
+        assert from_ds_config(
+            {"activation_checkpointing": {"partition_activations": True}}
+        ).remat is True
+        assert from_ds_config(
+            {"activation_checkpointing": {"partition_activations": False,
+                                          "cpu_checkpointing": False}}
+        ).remat is True
+        assert from_ds_config({"activation_checkpointing": False}).remat is False
+        assert from_ds_config({}).remat is False
+
+    def test_activation_checkpointing_typo_keys_raise(self):
+        from distributed_training_tpu.config import from_ds_config
+
+        with pytest.raises(ValueError, match="activation_checkpointing"):
+            from_ds_config(
+                {"activation_checkpointing": {"partition_activation": True}})
+
+
 class TestCliOverrides:
     def test_resnet_cli_overrides_optimizer(self):
         import importlib.util
