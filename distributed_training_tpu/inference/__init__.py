@@ -1,5 +1,9 @@
 """Inference: KV-cache autoregressive generation for the LM family."""
 
+from distributed_training_tpu.inference.beam import (  # noqa: F401
+    BeamConfig,
+    BeamSearcher,
+)
 from distributed_training_tpu.inference.sampler import (  # noqa: F401
     Generator,
     SampleConfig,

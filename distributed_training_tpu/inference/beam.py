@@ -1,0 +1,177 @@
+"""Beam-search decoding over the KV-cache decode path.
+
+Deterministic companion to the sampling :class:`~distributed_training_tpu.
+inference.sampler.Generator`: maintain the K highest-log-probability
+continuations per prompt, expanding all beams in one batched forward
+(the model sees batch ``B*K``) and re-selecting the top K of the K·V
+candidates each step — XLA-friendly fixed shapes throughout, with beam
+reordering as a batched gather over the KV-cache pytree.
+
+EOS handling: a finished beam (emitted ``eos_id``) is frozen — every
+continuation except ``pad_id`` is masked to -inf and padding contributes
+zero log-probability, so its score stays put while live beams keep
+competing. The returned sequences are the final top-K by score (with an
+optional GNMT-style length penalty applied at selection time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NEG_INF = -1e30  # large-finite: -inf - -inf = nan under masking arithmetic
+
+
+@dataclasses.dataclass(frozen=True)
+class BeamConfig:
+    """Static beam-search knobs (changing them retraces)."""
+
+    num_beams: int = 4
+    max_new_tokens: int = 128
+    eos_id: int | None = None
+    pad_id: int = 0
+    # GNMT length penalty alpha: scores are divided by
+    # ((5 + len) / 6) ** alpha at final selection; 0 = pure log-prob.
+    length_penalty: float = 0.0
+
+    def __post_init__(self):
+        if self.num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1, got {self.num_beams}")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+
+
+class BeamSearcher:
+    """Jitted beam search for a :class:`TransformerLM`.
+
+    >>> bs = BeamSearcher(model, params, BeamConfig(num_beams=4,
+    ...                                             max_new_tokens=32))
+    >>> tokens, scores = bs(prompt)   # [B, Tp] -> ([B, K, 32], [B, K])
+
+    Sequences come back best-first along K; ``scores`` are total
+    log-probabilities (length-penalized if configured).
+    """
+
+    def __init__(self, model: Any, params: Any, cfg: BeamConfig):
+        from distributed_training_tpu.inference.sampler import check_unsharded
+
+        check_unsharded(model)
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self._search = jax.jit(self._search_impl)
+
+    def _log_probs(self, logits):
+        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    def _search_impl(self, params, prompt):
+        cfg = self.cfg
+        b, t_prompt = prompt.shape
+        k = cfg.num_beams
+        model = self.model.clone(cache_len=t_prompt + cfg.max_new_tokens)
+
+        # Prefill ONCE at batch B, then repeat the cache rows K-fold: the
+        # beams all share the prompt, so a [B*K] prefill would just redo
+        # identical compute K times.
+        positions = jnp.broadcast_to(jnp.arange(t_prompt), (b, t_prompt))
+        logits, vars_out = model.apply(
+            {"params": params}, prompt, positions=positions,
+            train=False, decode=True, mutable=["cache"])
+        cache = jax.tree.map(
+            lambda c: jnp.repeat(c, k, axis=0)
+            if c.ndim >= 1 and c.shape[0] == b else c,
+            vars_out["cache"])
+        vocab = logits.shape[-1]
+        first_lp = jnp.broadcast_to(
+            self._log_probs(logits[:, -1, :])[:, None, :], (b, k, vocab))
+
+        # Seed: only beam 0 is live (all beams hold identical prompts; K
+        # live copies would fill the beam with duplicates).
+        scores = jnp.broadcast_to(
+            jnp.where(jnp.arange(k) == 0, 0.0, NEG_INF),
+            (b, k)).astype(jnp.float32)  # [B, K]
+        seqs = jnp.full((b, k, cfg.max_new_tokens), cfg.pad_id, jnp.int32)
+        finished = jnp.zeros((b, k), bool)
+        lengths = jnp.zeros((b, k), jnp.float32)  # emitted tokens incl. EOS
+
+        def select(carry, step_lp, step_idx):
+            """One beam expansion: mask frozen beams, pick top K of K·V,
+            reorder all beam-major state by parent. No model call."""
+            cache, seqs, scores, finished, lengths = carry
+            # Frozen beams may only emit pad, at zero cost.
+            pad_only = jnp.full((vocab,), NEG_INF).at[cfg.pad_id].set(0.0)
+            step_lp = jnp.where(
+                finished[..., None], pad_only[None, None, :], step_lp)
+            cand = scores[..., None] + step_lp              # [B, K, V]
+            flat = cand.reshape(b, k * vocab)
+            top_scores, top_idx = lax.top_k(flat, k)        # [B, K]
+            parent = top_idx // vocab                       # [B, K]
+            token = (top_idx % vocab).astype(jnp.int32)     # [B, K]
+
+            batch_offset = jnp.arange(b)[:, None] * k
+            flat_parent = (batch_offset + parent).reshape(-1)  # [B*K]
+            cache = jax.tree.map(
+                lambda c: c[flat_parent] if c.ndim >= 1 and
+                c.shape[0] == b * k else c, cache)
+            seqs = jnp.take_along_axis(seqs, parent[..., None], axis=1)
+            seqs = seqs.at[:, :, step_idx].set(token)
+            finished = jnp.take_along_axis(finished, parent, axis=1)
+            lengths = jnp.take_along_axis(lengths, parent, axis=1)
+            # The emitted token counts toward length (incl. the EOS itself)
+            # unless the beam was already frozen — tracked explicitly: pad
+            # is a legitimate live token (byte 0 in byte-level vocabs), so
+            # counting non-pad positions would miscount.
+            lengths = lengths + (~finished).astype(jnp.float32)
+            if cfg.eos_id is not None:
+                finished = finished | (token == cfg.eos_id)
+            return (cache, seqs, top_scores, finished, lengths), token
+
+        def expand(carry, step_idx):
+            carry_out, token = select(carry[:-1], carry[-1], step_idx)
+            cache = carry_out[0]
+            # One forward for all beams' chosen tokens.
+            logits, vars_out = model.apply(
+                {"params": params, "cache": cache},
+                token.reshape(b * k, 1),
+                positions=jnp.full((b * k, 1), t_prompt + step_idx,
+                                   jnp.int32),
+                train=False, decode=True, mutable=["cache"])
+            next_lp = self._log_probs(logits[:, -1, :]).reshape(b, k, vocab)
+            return (vars_out["cache"],) + carry_out[1:] + (next_lp,), None
+
+        # N-1 scan steps (each ends with the forward that feeds the next
+        # selection); the final selection needs no forward — running one
+        # would waste a whole B*K-batch model call (same structure as the
+        # sampler's decode loop).
+        carry = (cache, seqs, scores, finished, lengths, first_lp)
+        carry, _ = lax.scan(
+            expand, carry, jnp.arange(cfg.max_new_tokens - 1))
+        (_, seqs, scores, finished, lengths), _ = select(
+            carry[:-1], carry[-1], cfg.max_new_tokens - 1)
+
+        if cfg.length_penalty:
+            penalty = ((5.0 + jnp.maximum(lengths, 1.0)) / 6.0
+                       ) ** cfg.length_penalty
+            ranked = scores / penalty
+        else:
+            ranked = scores
+        order = jnp.argsort(-ranked, axis=-1)
+        seqs = jnp.take_along_axis(seqs, order[..., None], axis=1)
+        ranked = jnp.take_along_axis(ranked, order, axis=1)
+        return seqs, ranked
+
+    def __call__(self, prompt_tokens):
+        from distributed_training_tpu.inference.sampler import check_cache_fits
+
+        prompt = jnp.asarray(prompt_tokens, jnp.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None, :]
+        check_cache_fits(self.model, prompt.shape[1], self.cfg.max_new_tokens)
+        seqs, scores = self._search(self.params, prompt)
+        return np.asarray(seqs), np.asarray(scores)

@@ -90,6 +90,22 @@ def sample_token(rng: jax.Array, logits: jnp.ndarray,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def check_unsharded(model: Any) -> None:
+    """Decode requires an unsharded model (shared by Generator/BeamSearcher)."""
+    if getattr(model, "seq_axis", None) is not None:
+        raise ValueError(
+            "generation uses the unsharded decode path; build the model "
+            "with seq_axis=None (params are layout-identical)")
+
+
+def check_cache_fits(model: Any, prompt_len: int, max_new_tokens: int) -> None:
+    total = prompt_len + max_new_tokens
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) = "
+            f"{total} exceeds the KV cache (max_len={model.max_len})")
+
+
 class Generator:
     """Jitted prompt→completion generation for a :class:`TransformerLM`.
 
@@ -99,10 +115,7 @@ class Generator:
 
     def __init__(self, model: Any, params: Any, cfg: SampleConfig,
                  seed: int = 0):
-        if getattr(model, "seq_axis", None) is not None:
-            raise ValueError(
-                "generation uses the unsharded decode path; build the model "
-                "with seq_axis=None (params are layout-identical)")
+        check_unsharded(model)
         self.model = model
         self.params = params
         self.cfg = cfg
@@ -161,12 +174,7 @@ class Generator:
         prompt = jnp.asarray(prompt_tokens, jnp.int32)
         if prompt.ndim == 1:
             prompt = prompt[None, :]
-        total = prompt.shape[1] + self.cfg.max_new_tokens
-        if total > self.model.max_len:
-            raise ValueError(
-                f"prompt ({prompt.shape[1]}) + max_new_tokens "
-                f"({self.cfg.max_new_tokens}) = {total} exceeds the KV cache "
-                f"(max_len={self.model.max_len})")
+        check_cache_fits(self.model, prompt.shape[1], self.cfg.max_new_tokens)
         if rng is None:
             # Fresh stream per call (fold in a call counter): repeated
             # stochastic sampling without an explicit rng must not return

@@ -46,6 +46,10 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--top-k", type=int, default=None)
     parser.add_argument("--top-p", type=float, default=None)
     parser.add_argument("--eos-id", type=int, default=None)
+    parser.add_argument("--num-beams", type=int, default=None,
+                        help="deterministic beam search instead of sampling")
+    parser.add_argument("--length-penalty", type=float, default=0.0,
+                        help="(beam) GNMT length-penalty alpha")
     parser.add_argument("--seed", type=int, default=0)
     return parser.parse_args()
 
@@ -108,13 +112,6 @@ def main() -> int:
     else:
         print("[generate] no checkpoint found; sampling from random init")
 
-    gen = Generator(model, state.params, SampleConfig(
-        max_new_tokens=args.max_new_tokens,
-        temperature=args.temperature,
-        top_k=args.top_k,
-        top_p=args.top_p,
-        eos_id=args.eos_id,
-    ))
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)
     if (prompt >= args.vocab_size).any():
         bad = sorted(set(int(b) for b in prompt[prompt >= args.vocab_size]))
@@ -123,9 +120,34 @@ def main() -> int:
             "byte-level prompts need --vocab-size 256 (or an ASCII-only "
             "prompt for smaller vocabs)")
     prompt = prompt.astype(np.int32)
+
+    def decode_bytes(toks):
+        return bytes(int(t) % 256 for t in toks).decode(
+            "utf-8", errors="replace")
+
+    if args.num_beams:
+        from distributed_training_tpu.inference import BeamConfig, BeamSearcher
+
+        beams, scores = BeamSearcher(model, state.params, BeamConfig(
+            num_beams=args.num_beams,
+            max_new_tokens=args.max_new_tokens,
+            eos_id=args.eos_id,
+            length_penalty=args.length_penalty,
+        ))(prompt)
+        for i in range(args.num_beams):
+            print(f"[generate] beam {i} (score {float(scores[0, i]):.3f}): "
+                  f"{args.prompt!r} -> {decode_bytes(beams[0, i])!r}")
+        return 0
+
+    gen = Generator(model, state.params, SampleConfig(
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        eos_id=args.eos_id,
+    ))
     out = gen(prompt, rng=jax.random.PRNGKey(args.seed))[0]
-    text = bytes(int(t) % 256 for t in out).decode("utf-8", errors="replace")
-    print(f"[generate] {args.prompt!r} -> {text!r}")
+    print(f"[generate] {args.prompt!r} -> {decode_bytes(out)!r}")
     return 0
 
 

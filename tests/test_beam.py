@@ -1,0 +1,160 @@
+"""Beam-search tests.
+
+Oracles: (a) num_beams=1 must equal greedy sampling; (b) with the beam as
+wide as the whole search space (K = V^N), beam search is exhaustive and
+must find the global-argmax sequence — checked against brute force over
+every possible continuation on a tiny model.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_tpu.inference import (
+    BeamConfig,
+    BeamSearcher,
+    Generator,
+    SampleConfig,
+)
+from distributed_training_tpu.models import get_model
+
+VOCAB = 7
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model(
+        "transformer_lm", num_classes=VOCAB, num_layers=2, num_heads=2,
+        hidden_dim=32, max_len=64)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return model, params
+
+
+def full_logits(model, params, tokens):
+    return model.apply({"params": params}, tokens, train=False)
+
+
+def brute_force_best(model, params, prompt, n_new):
+    """Enumerate all VOCAB^n_new continuations; return (best_seq, best_lp)."""
+    best_seq, best_lp = None, -np.inf
+    for cont in itertools.product(range(VOCAB), repeat=n_new):
+        seq = jnp.concatenate(
+            [prompt, jnp.asarray([cont], jnp.int32)], axis=1)
+        logits = full_logits(model, params, seq)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        total = sum(
+            float(lp[0, prompt.shape[1] - 1 + i, cont[i]])
+            for i in range(n_new))
+        if total > best_lp:
+            best_seq, best_lp = cont, total
+    return list(best_seq), best_lp
+
+
+class TestBeamSearch:
+    def test_single_beam_equals_greedy(self, lm):
+        model, params = lm
+        prompt = np.array([[1, 2, 3], [4, 5, 6]])
+        beams, scores = BeamSearcher(model, params, BeamConfig(
+            num_beams=1, max_new_tokens=6))(prompt)
+        greedy = Generator(model, params, SampleConfig(
+            max_new_tokens=6, temperature=0.0))(prompt)
+        np.testing.assert_array_equal(beams[:, 0, :], greedy)
+        assert beams.shape == (2, 1, 6)
+        assert (scores <= 0).all()  # log-probabilities
+
+    def test_exhaustive_beam_finds_global_argmax(self, lm):
+        """K = V^N makes beam search exact: compare with brute force."""
+        model, params = lm
+        prompt = jnp.asarray([[2, 4]], jnp.int32)
+        n_new = 2
+        k = VOCAB ** n_new  # 49 beams cover the whole space
+        beams, scores = BeamSearcher(model, params, BeamConfig(
+            num_beams=k, max_new_tokens=n_new))(np.asarray(prompt))
+        want_seq, want_lp = brute_force_best(model, params, prompt, n_new)
+        assert beams[0, 0].tolist() == want_seq
+        np.testing.assert_allclose(float(scores[0, 0]), want_lp, rtol=1e-4)
+
+    def test_beam_score_beats_or_matches_greedy(self, lm):
+        """Wider beams can only improve (or match) the best total log-prob."""
+        model, params = lm
+        prompt = np.array([[1, 5]])
+        lp1 = BeamSearcher(model, params, BeamConfig(
+            num_beams=1, max_new_tokens=5))(prompt)[1][0, 0]
+        lp4 = BeamSearcher(model, params, BeamConfig(
+            num_beams=4, max_new_tokens=5))(prompt)[1][0, 0]
+        assert float(lp4) >= float(lp1) - 1e-5
+
+    def test_beams_are_distinct_and_sorted(self, lm):
+        model, params = lm
+        beams, scores = BeamSearcher(model, params, BeamConfig(
+            num_beams=4, max_new_tokens=4))(np.array([[3, 1]]))
+        assert beams.shape == (1, 4, 4)
+        rows = {tuple(r) for r in beams[0].tolist()}
+        assert len(rows) == 4  # distinct hypotheses
+        s = scores[0]
+        assert all(s[i] >= s[i + 1] for i in range(3))  # best-first
+
+    def test_eos_freezes_beam_with_pad_tail(self, lm):
+        """Bias the head so EOS dominates: every beam should emit EOS then
+        pad, with the score unchanged by the padding."""
+        model, params = lm
+        eos = 5
+        biased = dict(params)
+        head = dict(biased["lm_head"])
+        head["bias"] = head["bias"].at[eos].add(1e3)
+        biased["lm_head"] = head
+        beams, scores = BeamSearcher(model, biased, BeamConfig(
+            num_beams=2, max_new_tokens=5, eos_id=eos, pad_id=0))(
+                np.array([[1, 2]]))
+        assert beams[0, 0, 0] == eos
+        assert (beams[0, 0, 1:] == 0).all()
+        # Score ≈ lp(eos) only — padding contributed zero.
+        assert float(scores[0, 0]) > -1.0
+
+    def test_length_penalty_changes_ranking_shape(self, lm):
+        model, params = lm
+        plain = BeamSearcher(model, params, BeamConfig(
+            num_beams=3, max_new_tokens=4))(np.array([[2, 2]]))
+        pen = BeamSearcher(model, params, BeamConfig(
+            num_beams=3, max_new_tokens=4, length_penalty=1.0))(
+                np.array([[2, 2]]))
+        # Same hypothesis space; penalized scores are scaled (larger, as
+        # scores are negative and penalty > 1).
+        assert float(pen[1][0, 0]) >= float(plain[1][0, 0])
+
+    def test_length_counts_live_pad_tokens(self, lm):
+        """pad_id (byte 0) is a legitimate live token: without EOS every
+        beam runs the full horizon, so the penalized score must equal
+        score / ((5+N)/6)^alpha even when token 0 appears mid-sequence."""
+        model, params = lm
+        biased = dict(params)
+        head = dict(biased["lm_head"])
+        head["bias"] = head["bias"].at[0].add(5.0)  # favor token 0 (== pad)
+        biased["lm_head"] = head
+        n = 4
+        plain_seqs, plain_scores = BeamSearcher(model, biased, BeamConfig(
+            num_beams=2, max_new_tokens=n))(np.array([[1, 2]]))
+        pen_seqs, pen_scores = BeamSearcher(model, biased, BeamConfig(
+            num_beams=2, max_new_tokens=n, length_penalty=1.0))(
+                np.array([[1, 2]]))
+        assert (plain_seqs[0, 0] == 0).any()  # token 0 actually emitted
+        np.testing.assert_array_equal(plain_seqs, pen_seqs)
+        np.testing.assert_allclose(
+            pen_scores, plain_scores / ((5.0 + n) / 6.0), rtol=1e-5)
+
+    def test_cache_overflow_rejected(self, lm):
+        model, params = lm
+        bs = BeamSearcher(model, params, BeamConfig(
+            num_beams=2, max_new_tokens=60))
+        with pytest.raises(ValueError, match="exceeds the KV cache"):
+            bs(np.zeros((1, 10), np.int32))
+
+    def test_invalid_config_rejected(self):
+        with pytest.raises(ValueError, match="num_beams"):
+            BeamConfig(num_beams=0)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            BeamConfig(max_new_tokens=0)
