@@ -1,0 +1,115 @@
+"""Direct unit tests for the small runtime/observability utilities.
+
+These are load-bearing plumbing (the wall_clock_breakdown parity surface,
+the rank-0 coordination facade, the meter's no-sync contract) that until
+now were only exercised indirectly through trainer integration runs.
+"""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from distributed_training_tpu.runtime.coordinator import Coordinator
+from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
+from distributed_training_tpu.utils.profiling import WallClock, trace
+
+
+class TestWallClock:
+    def test_phases_accumulate_and_report_clears(self):
+        clock = WallClock(enabled=True)
+        for _ in range(3):
+            with clock.phase("data"):
+                time.sleep(0.01)
+        with clock.phase("step"):
+            time.sleep(0.02)
+        report = clock.report()
+        assert set(report) == {"data", "step"}
+        assert report["data"] >= 0.025 and report["step"] >= 0.015
+        assert clock.report() == {}  # report() drains
+
+    def test_disabled_records_nothing(self):
+        clock = WallClock(enabled=False)
+        with clock.phase("data"):
+            time.sleep(0.005)
+        assert clock.report() == {}
+
+    def test_phase_records_on_exception(self):
+        clock = WallClock(enabled=True)
+        with pytest.raises(RuntimeError):
+            with clock.phase("step"):
+                raise RuntimeError("boom")
+        assert clock.report()["step"] >= 0
+
+    def test_trace_none_is_noop(self):
+        with trace(None):
+            pass  # must not start a profiler session
+
+    def test_trace_writes_profile_dir(self, tmp_path):
+        import os
+
+        d = str(tmp_path / "prof")
+        with trace(d):
+            jnp.ones((8, 8)).sum().block_until_ready()
+        found = []
+        for root, _, files in os.walk(d):
+            found += files
+        assert found, "no profiler artifacts written"
+
+
+class TestCoordinator:
+    def test_single_process_facade(self, capsys):
+        c = Coordinator()
+        assert c.process_index == 0
+        assert c.process_count == 1
+        assert c.is_master()
+        c.print("hello", "world")
+        assert "hello world" in capsys.readouterr().out
+
+    def test_priority_execution_runs_master_first(self):
+        c = Coordinator()
+        order = []
+        with c.priority_execution("test"):
+            order.append("master")
+        order.append("after")
+        assert order == ["master", "after"]
+
+    def test_barrier_single_process_noop(self):
+        Coordinator().barrier("t")  # must simply return
+
+    def test_broadcast_scalar_identity_single_process(self):
+        assert Coordinator().broadcast_scalar(3.5) == 3.5
+
+
+class TestMetricMeter:
+    def test_interval_gating_and_last(self):
+        meter = MetricMeter(log_interval=3)
+        m = {"loss": jnp.float32(1.5)}
+        assert meter.push(1, m) is False
+        assert meter.pending
+        assert meter.push(2, m) is False
+        assert meter.push(3, m) is True  # interval boundary fetches
+        assert not meter.pending
+        assert meter.last == {"loss": 1.5, "step": 3}
+
+    def test_flush_without_pending_repeats_last(self):
+        meter = MetricMeter(log_interval=1)
+        meter.push(1, {"loss": jnp.float32(2.0)})
+        first = dict(meter.last)
+        assert meter.flush() == first  # nothing pending: unchanged
+
+    def test_only_newest_pending_entry_materializes(self):
+        meter = MetricMeter(log_interval=10)
+        for i in range(1, 5):
+            meter.push(i, {"loss": jnp.float32(float(i))})
+        flushed = meter.flush()
+        assert flushed == {"loss": 4.0, "step": 4}
+
+
+class TestEpochBar:
+    def test_non_master_is_silent(self, capsys):
+        bar = EpochBar(total=5, epoch=0, num_epochs=1, is_master=False)
+        bar.update()
+        bar.set_postfix({"loss": 1.0})
+        bar.close()
+        assert capsys.readouterr().out == ""
