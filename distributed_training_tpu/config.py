@@ -444,26 +444,48 @@ def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> Tr
     if "train_micro_batch_size_per_gpu" in ds:
         data = dataclasses.replace(data, batch_size=int(ds["train_micro_batch_size_per_gpu"]))
 
+    # "prescale_gradients": true divides gradients by world_size BEFORE the
+    # all-reduce (a GPU fp16-overflow mitigation). Gradient reduction here
+    # is lax.pmean / GSPMD-inserted mean with fp32 accumulation, which
+    # applies the 1/world_size scaling inside the one fused collective —
+    # either setting yields the averaged gradient, so the knob is accepted
+    # as a documented no-op (like the zero_optimization bucketing knobs).
+    if not isinstance(ds.get("prescale_gradients", False), bool):
+        raise ValueError("prescale_gradients must be a bool")
+
     # DeepSpeed's activation_checkpointing block maps onto per-block remat.
-    # Its sub-knobs are GPU-memory plumbing with no TPU analogue
-    # (partition_activations only shards saved activations across
-    # model-parallel ranks — it does NOT gate checkpointing), so a present
-    # block simply turns remat on; the sub-keys are validated and recorded
-    # as no-ops like the zero_optimization bucketing knobs.
+    # In DeepSpeed the block only CONFIGURES the checkpointing API — nothing
+    # is checkpointed unless the model itself calls
+    # deepspeed.checkpointing.checkpoint — so inferring remat from the
+    # block's mere presence would silently charge ~30% extra backward FLOPs
+    # on parity configs. Remat therefore needs an opt-in signal: the
+    # dedicated "enabled": true extension key, or any truthy functional
+    # sub-knob (partition_activations / cpu_checkpointing /
+    # number_checkpoints / contiguous_memory_optimization — a config that
+    # sets these describes a model that DOES checkpoint). An all-false
+    # block leaves remat off; profile / synchronize_checkpoint_boundary are
+    # observability knobs and carry no intent. The sub-knobs themselves are
+    # GPU-memory plumbing with no TPU analogue — validated, then no-ops.
     remat = cfg.remat
     if "activation_checkpointing" in ds:
         ac = ds["activation_checkpointing"]
         if isinstance(ac, Mapping):
-            unknown_ac = set(ac) - {
-                "partition_activations", "cpu_checkpointing",
+            functional = {
+                "enabled", "partition_activations", "cpu_checkpointing",
                 "contiguous_memory_optimization", "number_checkpoints",
+            }
+            unknown_ac = set(ac) - functional - {
                 "synchronize_checkpoint_boundary", "profile",
             }
             if unknown_ac:
                 raise ValueError(
                     f"unknown activation_checkpointing keys: "
                     f"{sorted(unknown_ac)}")
-            remat = True
+            if "enabled" in ac:
+                # The dedicated key is authoritative in both directions.
+                remat = bool(ac["enabled"])
+            elif any(ac.get(k) for k in functional):
+                remat = True
         else:
             remat = bool(ac)
 

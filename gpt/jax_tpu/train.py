@@ -122,8 +122,10 @@ def build_config(args: argparse.Namespace):
         metrics_jsonl=args.metrics_jsonl,
         precision=dataclasses.replace(cfg.precision, dtype=args.dtype),
         zero=ZeroConfig(stage=args.stage),
+        # expert gated on --moe: a dense run must keep the full data axis
+        # (an expert axis under a dense model would just replicate compute).
         mesh=MeshSpec(data=-1, model=args.tp, pipe=args.pp, sequence=args.sp,
-                      expert=args.ep_world_size),
+                      expert=args.ep_world_size if args.moe else 1),
         checkpoint=CheckpointConfig(
             directory=args.checkpoint,
             interval=args.interval,

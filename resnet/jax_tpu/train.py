@@ -237,7 +237,8 @@ def build_config(args: argparse.Namespace):
         num_epochs=args.epochs,
         gradient_accumulation_steps=args.gradient_accumulation_steps,
         label_smoothing=args.label_smoothing,
-        remat=args.remat,
+        # --remat opts in; never clobber a remat=True the ds_config set.
+        remat=args.remat or cfg.remat,
         seed=args.seed,
         log_interval=args.log_interval,
         target_acc=args.target_acc,
@@ -270,6 +271,15 @@ def build_config(args: argparse.Namespace):
             noisy_gate_policy=args.noisy_gate_policy,
             moe_param_group=args.moe_param_group,
         ),
+        # The Trainer engages expert sharding from the mesh, not MoEConfig
+        # (train/trainer.py decides expert_axis from the realized mesh shape),
+        # so --ep-world-size must size the expert axis here — matching the
+        # gpt CLI's wiring. DeepSpeed's flag (deepspeed_train.py:64-66) has
+        # the same contract: ep_world_size is the expert-parallel degree.
+        # Gated on --moe: a dense run must keep the full data axis (an
+        # expert axis under a dense model would just replicate compute).
+        mesh=dataclasses.replace(
+            cfg.mesh, expert=args.ep_world_size if args.moe else 1),
     )
 
     # Optimizer overrides on top of the plugin preset (None = keep preset).

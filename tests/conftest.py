@@ -49,3 +49,17 @@ def mesh(devices):
 def mesh2x4(devices):
     """data=2 × fsdp=4 mesh for ZeRO/FSDP tests."""
     return create_mesh(MeshConfig(data=2, fsdp=4))
+
+
+def load_cli_module(relpath, name=None):
+    """Import a per-backend CLI script (e.g. ``resnet/jax_tpu/train.py``)
+    as a module; the backend dirs are script-style, not packages."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, relpath)
+    name = name or relpath.replace("/", "_").removesuffix(".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

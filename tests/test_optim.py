@@ -163,18 +163,41 @@ class TestDsConfigIngestion:
     def test_activation_checkpointing_maps_to_remat(self):
         from distributed_training_tpu.config import from_ds_config
 
-        # Presence of the block = checkpointing on; partition_activations
-        # only shards saved activations in DeepSpeed (it does not gate
-        # checkpointing), so it must NOT flip remat off.
+        # In DeepSpeed the block only configures the checkpointing API —
+        # nothing is checkpointed unless the model opts in — so remat needs
+        # an explicit opt-in (truthy partition_activations or the dedicated
+        # "enabled" extension key); an all-false block leaves remat off.
         assert from_ds_config(
             {"activation_checkpointing": {"partition_activations": True}}
         ).remat is True
         assert from_ds_config(
-            {"activation_checkpointing": {"partition_activations": False,
-                                          "cpu_checkpointing": False}}
+            {"activation_checkpointing": {"enabled": True}}
         ).remat is True
+        # Any truthy functional sub-knob describes a model that checkpoints.
+        assert from_ds_config(
+            {"activation_checkpointing": {"cpu_checkpointing": True,
+                                          "number_checkpoints": 4}}
+        ).remat is True
+        assert from_ds_config(
+            {"activation_checkpointing": {"partition_activations": False,
+                                          "cpu_checkpointing": False,
+                                          "profile": True}}
+        ).remat is False
+        assert from_ds_config({"activation_checkpointing": True}).remat is True
         assert from_ds_config({"activation_checkpointing": False}).remat is False
         assert from_ds_config({}).remat is False
+
+    def test_prescale_gradients_documented_noop(self):
+        from distributed_training_tpu.config import from_ds_config
+
+        # prescale divides grads by world_size before the all-reduce (a GPU
+        # fp16-overflow trick); reduction here is a fused fp32-accumulating
+        # mean, so both values yield the averaged gradient — accepted no-op.
+        # Structural equality pins the no-op contract.
+        assert from_ds_config({"prescale_gradients": True}) == from_ds_config({})
+        assert from_ds_config({"prescale_gradients": False}) == from_ds_config({})
+        with pytest.raises(ValueError, match="prescale_gradients"):
+            from_ds_config({"prescale_gradients": "yes"})
 
     def test_activation_checkpointing_typo_keys_raise(self):
         from distributed_training_tpu.config import from_ds_config
@@ -186,13 +209,11 @@ class TestDsConfigIngestion:
 
 class TestCliOverrides:
     def test_resnet_cli_overrides_optimizer(self):
-        import importlib.util
         import sys
 
-        spec = importlib.util.spec_from_file_location(
-            "resnet_jax_train", "resnet/jax_tpu/train.py")
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
+        from conftest import load_cli_module
+
+        mod = load_cli_module("resnet/jax_tpu/train.py")
         argv = sys.argv
         try:
             sys.argv = ["train.py", "--optimizer", "sgd", "--lr", "0.05",

@@ -73,6 +73,48 @@ def test_moe_expert_parallel_training(tmp_path):
     assert metrics["grads_finite"] == 1.0
 
 
+def test_cli_ep_world_size_sizes_expert_axis(tmp_path):
+    """--moe --ep-world-size 2 must actually shard experts: the CLI has to
+    size the expert mesh axis (the Trainer engages expert sharding only from
+    the realized mesh, so a MoEConfig-only wiring silently replicates)."""
+    from conftest import load_cli_module
+
+    mod = load_cli_module("resnet/jax_tpu/train.py", name="resnet_jax_train_ep")
+    argv = sys.argv
+    try:
+        sys.argv = ["train.py", "--moe", "--ep-world-size", "2",
+                    "--num-experts", "4", "--dataset", "synthetic_cifar",
+                    "--steps-per-epoch", "2", "-b", "8", "-e", "1"]
+        args = mod.add_argument()
+    finally:
+        sys.argv = argv
+    cfg = mod.build_config(args)
+    assert cfg.mesh.expert == 2
+
+    # Without --moe the expert axis must stay 1 (a stray --ep-world-size on
+    # a dense run would otherwise halve data parallelism to replicate
+    # compute), and a ds_config remat=True must survive the CLI defaults.
+    try:
+        sys.argv = ["train.py", "--ep-world-size", "2",
+                    "--dataset", "synthetic_cifar", "-p", "deepspeed"]
+        dense_args = mod.add_argument()
+    finally:
+        sys.argv = argv
+    import json as _json
+    ds_path = tmp_path / "ds.json"
+    ds_path.write_text(_json.dumps(
+        {"activation_checkpointing": {"enabled": True}}))
+    dense_args.deepspeed_config = str(ds_path)
+    dense_cfg = mod.build_config(dense_args)
+    assert dense_cfg.mesh.expert == 1
+    assert dense_cfg.remat is True
+
+    trainer = Trainer(cfg)
+    mesh_shape = dict(zip(trainer.mesh.axis_names, trainer.mesh.devices.shape))
+    assert mesh_shape["expert"] == 2
+    assert mesh_shape["data"] == len(trainer.mesh.devices.flat) // 2
+
+
 def test_moe_enabled_with_dense_model_refuses(tmp_path):
     from distributed_training_tpu.config import MoEConfig
 
