@@ -13,6 +13,7 @@ orbax.
 from __future__ import annotations
 
 import os
+import re
 from typing import Any
 
 import jax
@@ -20,57 +21,138 @@ import numpy as np
 import orbax.checkpoint as ocp
 from flax import serialization
 
+# ResNet blocks were renamed from Flax auto-names ("BasicBlock_3",
+# "BottleneckBlock_0", remat-prefixed "CheckpointBasicBlock_1") to explicit
+# "stage{i}_block{j}" names (models/resnet.py). Checkpoints saved before the
+# rename are migrated on restore: auto-names number blocks sequentially in
+# creation order, which is exactly "stage{i}_block{j}" sorted by (i, j).
+_LEGACY_BLOCK_RE = re.compile(
+    r"^(?:Checkpoint)?(?:BasicBlock|BottleneckBlock)_(\d+)$")
+_NEW_BLOCK_RE = re.compile(r"^stage(\d+)_block(\d+)$")
+
 
 def _epoch_dir(directory: str, epoch: int) -> str:
     return os.path.join(os.path.abspath(directory), f"epoch_{epoch}")
 
 
 def save_checkpoint(directory: str, epoch: int, state: Any,
-                    next_epoch: int | None = None) -> str:
+                    next_epoch: int | None = None,
+                    epoch_step: int = 0) -> str:
     """Save the train state tagged ``epoch``; returns the checkpoint path.
 
     ``next_epoch`` is the epoch a resume should start at — ``epoch + 1``
     for the normal end-of-epoch save, or ``epoch`` itself for a preemption
-    save taken *mid*-epoch (the partial epoch re-runs from its
-    deterministic shuffle; see ``runtime/preemption.py``).
+    save taken *mid*-epoch. ``epoch_step`` records how many effective
+    batches of that epoch were already consumed, so a resume skips exactly
+    that prefix of the epoch's deterministic shuffle instead of re-training
+    it (step-accurate resume; see ``runtime/preemption.py``).
     """
     path = _epoch_dir(directory, epoch)
     payload = {
         "state": serialization.to_state_dict(state),
         "meta": {"epoch": np.int32(epoch),
                  "next_epoch": np.int32(
-                     epoch + 1 if next_epoch is None else next_epoch)},
+                     epoch + 1 if next_epoch is None else next_epoch),
+                 "epoch_step": np.int32(epoch_step)},
     }
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, payload, force=True)
     return path
 
 
-def restore_checkpoint(directory: str, epoch: int, state: Any) -> tuple[Any, int]:
-    """Restore the checkpoint tagged ``epoch``; returns (state, start_epoch).
+def _rename_keys(tree: Any, mapping: dict[str, str]) -> Any:
+    if isinstance(tree, dict):
+        return {mapping.get(k, k): _rename_keys(v, mapping)
+                for k, v in tree.items()}
+    return tree
+
+
+def _leaf_shapes(tree: Any, prefix: tuple = ()) -> dict[tuple, tuple]:
+    """{path: shape} over a nested dict whose leaves carry ``.shape``
+    (works for both arrays and orbax ArrayMetadata)."""
+    if isinstance(tree, dict):
+        out = {}
+        for k, v in tree.items():
+            out.update(_leaf_shapes(v, prefix + (k,)))
+        return out
+    return {prefix: tuple(getattr(tree, "shape", ()) or ())}
+
+
+def _legacy_block_rename(saved_state: Any, new_state: dict) -> dict[str, str]:
+    """old-name → new-name map for pre-rename ResNet checkpoints (empty if
+    the save already uses explicit names or the shapes don't line up).
+
+    Per-block leaf shapes are compared (saved metadata vs template arrays),
+    so a genuinely incompatible checkpoint — e.g. a legacy resnet34 save
+    restored into a resnet50 template with the same block *count* — is not
+    migrated and instead surfaces the plain structural mismatch error.
+    """
+    saved_params = (saved_state or {}).get("params")
+    new_params = new_state.get("params")
+    if not isinstance(saved_params, dict) or not isinstance(new_params, dict):
+        return {}
+    legacy = sorted(
+        (k for k in saved_params if _LEGACY_BLOCK_RE.match(k)),
+        key=lambda k: int(_LEGACY_BLOCK_RE.match(k).group(1)))
+    new = sorted(
+        (k for k in new_params if _NEW_BLOCK_RE.match(k)),
+        key=lambda k: tuple(map(int, _NEW_BLOCK_RE.match(k).groups())))
+    if not legacy or len(legacy) != len(new):
+        return {}
+    for o, n in zip(legacy, new):
+        if _leaf_shapes(saved_params[o]) != _leaf_shapes(new_params[n]):
+            return {}
+    return dict(zip(legacy, new))
+
+
+def restore_checkpoint(directory: str, epoch: int,
+                       state: Any) -> tuple[Any, int, int]:
+    """Restore the checkpoint tagged ``epoch``; returns
+    ``(state, start_epoch, start_step)``.
 
     ``start_epoch`` comes from the checkpoint's ``next_epoch`` meta
-    (normally ``epoch + 1`` — the Colossal ``--resume <epoch>`` semantics).
+    (normally ``epoch + 1`` — the Colossal ``--resume <epoch>`` semantics);
+    ``start_step`` is the number of ``start_epoch``'s batches already
+    trained (nonzero only for mid-epoch preemption saves — the resume
+    skips that prefix of the epoch's deterministic shuffle).
+
+    Format differences are detected *explicitly* from the on-disk tree
+    structure (``metadata()``, no array reads) rather than by retrying on
+    exceptions, so a genuine restore failure surfaces its real cause:
+
+    - pre-``next_epoch`` saves carry only ``{epoch}`` → old ``epoch + 1``
+      resume semantics; pre-``epoch_step`` saves resume at step 0;
+    - pre-rename ResNet saves use Flax auto block names → keys are migrated
+      to the explicit ``stage{i}_block{j}`` names everywhere in the state
+      (params, batch_stats, and the param-shaped optimizer moments).
     """
     path = _epoch_dir(directory, epoch)
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint at {path}")
     ckptr = ocp.PyTreeCheckpointer()
-    template = {
-        "state": serialization.to_state_dict(state),
-        "meta": {"epoch": np.int32(0), "next_epoch": np.int32(0)},
-    }
-    try:
-        restored = ckptr.restore(path, item=template)
-        next_epoch = int(restored["meta"]["next_epoch"])
-    except Exception:
-        # Pre-next_epoch checkpoints carry only {epoch}; restore with the
-        # old template and apply the old epoch+1 semantics.
-        template["meta"] = {"epoch": np.int32(0)}
-        restored = ckptr.restore(path, item=template)
-        next_epoch = int(restored["meta"]["epoch"]) + 1
-    new_state = serialization.from_state_dict(state, restored["state"])
-    return new_state, next_epoch
+    saved = ckptr.metadata(path).item_metadata.tree or {}
+    state_template = serialization.to_state_dict(state)
+    rename = _legacy_block_rename(saved.get("state"), state_template)
+    if rename:
+        # Present orbax a template keyed by the on-disk (legacy) names while
+        # keeping the template's array leaves (shardings drive the restore).
+        state_template = _rename_keys(
+            state_template, {n: o for o, n in rename.items()})
+    saved_meta = saved.get("meta", {})
+    meta_template = {"epoch": np.int32(0)}
+    for key in ("next_epoch", "epoch_step"):
+        if key in saved_meta:
+            meta_template[key] = np.int32(0)
+    restored = ckptr.restore(
+        path, item={"state": state_template, "meta": meta_template})
+    meta = restored["meta"]
+    next_epoch = (int(meta["next_epoch"]) if "next_epoch" in meta
+                  else int(meta["epoch"]) + 1)
+    start_step = int(meta.get("epoch_step", 0))
+    restored_state = (_rename_keys(restored["state"], rename)
+                      if rename else restored["state"])
+    new_state = serialization.from_state_dict(state, restored_state)
+    return new_state, next_epoch, start_step
 
 
 def resolve_resume(ckpt_cfg) -> int:

@@ -128,6 +128,11 @@ class ImageFolderLoader(ShardedBatchIndexer):
         self.num_workers = max(1, num_workers)
 
     def __iter__(self) -> Iterator[dict]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_step: int) -> Iterator[dict]:
+        """Iterate from ``start_step``: skipped batches are skipped at the
+        index level — no decode/augment cost for the resumed-over prefix."""
         # Per-example decode seeds: (seed, epoch, global index) so crops are
         # deterministic, distinct per example, and fresh every epoch.
         seed_base = (self.seed * 7 + self.epoch * 13) % (2 ** 31)
@@ -136,7 +141,7 @@ class ImageFolderLoader(ShardedBatchIndexer):
         randomize = self.train and self.augment == "pad_crop_flip"
 
         with ThreadPoolExecutor(self.num_workers) as pool:
-            for lidx, pad in self.batches():
+            for lidx, pad in self.batches(start_step):
                 decoded = list(pool.map(
                     lambda j: _decode(self.paths[j], self.image_size,
                                       randomize, seed_base + int(j)),

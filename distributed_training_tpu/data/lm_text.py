@@ -102,13 +102,18 @@ class TokenLoader:
         return min(steps, self.max_steps) if self.max_steps else steps
 
     def __iter__(self) -> Iterator[dict]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_step: int) -> Iterator[dict]:
+        """Iterate from ``start_step`` of the epoch's deterministic shuffle
+        (index-level skip; step-accurate preemption resume)."""
         n = self.tokens.shape[0]
         order = np.arange(n)
         if self.shuffle:
             np.random.RandomState((self.seed, self.epoch)).shuffle(order)
         per_proc = self.global_batch_size // self.process_count
         lo = self.process_index * per_proc
-        for step in range(len(self)):
+        for step in range(start_step, len(self)):
             sel = order[step * self.global_batch_size:
                         (step + 1) * self.global_batch_size]
             shard = sel[lo:lo + per_proc]

@@ -81,15 +81,18 @@ class ShardedBatchIndexer:
             steps = min(steps, self.max_steps)
         return steps
 
-    def batches(self) -> Iterator[tuple[np.ndarray, int]]:
+    def batches(self, start_step: int = 0) -> Iterator[tuple[np.ndarray, int]]:
         """Yield ``(local_indices, pad)`` per step; ``pad`` is how many
-        padding examples the ragged final batch needs (0 otherwise)."""
+        padding examples the ragged final batch needs (0 otherwise).
+        ``start_step`` skips a prefix of the epoch's deterministic shuffle
+        at the *index* level — no skipped example is loaded or augmented
+        (step-accurate preemption resume)."""
         order = np.arange(self.num_examples)
         if self.shuffle:
             order = np.random.RandomState(
                 (self.seed * 100_003 + self.epoch) % (2 ** 31)).permutation(
                     self.num_examples)
-        for i in range(len(self)):
+        for i in range(start_step, len(self)):
             gstart = i * self.global_batch_size
             gidx = order[gstart:gstart + self.global_batch_size]
             # Contiguous per-process slice of the global batch.
@@ -131,9 +134,15 @@ class ShardedDataLoader(ShardedBatchIndexer):
         self.train = train
 
     def __iter__(self) -> Iterator[dict]:
+        return self.iter_from(0)
+
+    def iter_from(self, start_step: int) -> Iterator[dict]:
+        """Iterate the epoch from ``start_step`` (cheap: skipped batches are
+        never materialized). The augment RNG stream restarts rather than
+        fast-forwarding — data *order* is what resume guarantees."""
         aug_rng = np.random.RandomState(
             (self.seed * 7 + self.epoch * 13 + self.process_index) % (2 ** 31))
-        for lidx, pad in self.batches():
+        for lidx, pad in self.batches(start_step):
             images = self.images[lidx]
             labels = self.labels[lidx]
             mask = np.ones(len(lidx), dtype=np.float32)
@@ -149,6 +158,39 @@ class ShardedDataLoader(ShardedBatchIndexer):
             if not self.drop_last:
                 batch["mask"] = mask
             yield batch
+
+
+class SkipBatches:
+    """Loader view that drops the first ``skip`` batches of the epoch's
+    deterministic shuffle (step-accurate preemption resume).
+
+    A resume whose recorded ``epoch_step`` no longer fits the epoch (e.g.
+    batch size changed between runs, shrinking ``len(loader)``) is refused
+    loudly — silently training zero batches would drop data. (A *completed*
+    epoch never reaches here: the preemption save rolls it over to
+    ``next_epoch = epoch + 1, epoch_step = 0``.)
+    """
+
+    def __init__(self, loader, skip: int):
+        if skip >= len(loader):
+            raise ValueError(
+                f"cannot resume at step {skip} of a {len(loader)}-step "
+                f"epoch — the epoch geometry changed since the preemption "
+                f"save (different batch size or dataset?); restart the "
+                f"epoch with --resume or keep the original batch size")
+        self.loader, self.skip = loader, skip
+
+    def __len__(self):
+        return max(0, len(self.loader) - self.skip)
+
+    def __iter__(self):
+        if hasattr(self.loader, "iter_from"):
+            # Index-level skip: the prefix is never decoded/augmented.
+            return self.loader.iter_from(self.skip)
+        it = iter(self.loader)
+        for _ in range(self.skip):
+            next(it, None)
+        return it
 
 
 def to_global_batch(batch: dict, mesh: Mesh, shardings: dict) -> dict:

@@ -279,6 +279,7 @@ class LMTrainer:
             enabled=self.coord.is_master())
         self._guard: PreemptionGuard | None = None
         self._global_step = 0
+        self._epoch_step = 0
         self.coord.print(
             f"[lm_trainer] params={param_count(state.params):,} "
             f"mesh={shape} strategy={self.strategy} "
@@ -338,8 +339,18 @@ class LMTrainer:
                                 depth=self.cfg.data.prefetch)
 
     # -- train --------------------------------------------------------------
-    def train_epoch(self, epoch: int, loader: TokenLoader) -> dict:
+    def train_epoch(self, epoch: int, loader: TokenLoader,
+                    skip_steps: int = 0) -> dict:
         loader.set_epoch(epoch)
+        if skip_steps:
+            # Step-accurate preemption resume: skip the already-trained
+            # prefix of the epoch's deterministic shuffle (see trainer.py).
+            from distributed_training_tpu.data.pipeline import SkipBatches
+
+            self.coord.print(
+                f"[lm_trainer] resuming epoch {epoch} at step {skip_steps}")
+            loader = SkipBatches(loader, skip_steps)
+        self._epoch_step = skip_steps
         bar = EpochBar(len(loader), epoch, self.cfg.num_epochs,
                        self.coord.is_master())
         for gbatch in self._batches(loader):
@@ -349,6 +360,7 @@ class LMTrainer:
                     self.state, gbatch, step_rng)
             with self.clock.phase("log"):
                 self._global_step += 1
+                self._epoch_step += 1
                 fetched = self.meter.push(self._global_step, metrics)
                 bar.update()
                 if fetched:
@@ -407,9 +419,10 @@ class LMTrainer:
         train_loader, eval_loader = self.make_loaders()
 
         start_epoch = 0
+        start_step = 0
         resume = ckpt_lib.resolve_resume(cfg.checkpoint)
         if resume >= 0:
-            self.state, start_epoch = ckpt_lib.restore_checkpoint(
+            self.state, start_epoch, start_step = ckpt_lib.restore_checkpoint(
                 cfg.checkpoint.directory, resume, self.state)
             self.state = place_state(self.state, self.shardings)
             # Metric sinks continue the restored step axis (see trainer.py).
@@ -421,16 +434,23 @@ class LMTrainer:
         with trace(cfg.profile_dir), PreemptionGuard() as guard:
             self._guard = guard
             for epoch in range(start_epoch, cfg.num_epochs):
-                self.train_epoch(epoch, train_loader)
+                self.train_epoch(
+                    epoch, train_loader,
+                    skip_steps=start_step if epoch == start_epoch else 0)
                 if guard.should_stop():
                     preempted = True
                     if cfg.checkpoint.save_on_preemption:
+                        # Completed-epoch preemption rolls over (trainer.py).
+                        done = self._epoch_step >= len(train_loader)
+                        next_ep = epoch + 1 if done else epoch
+                        estep = 0 if done else self._epoch_step
                         ckpt_lib.save_checkpoint(
                             cfg.checkpoint.directory, epoch, self.state,
-                            next_epoch=epoch)
+                            next_epoch=next_ep, epoch_step=estep)
                         self.coord.print(
                             f"[lm_trainer] SIGTERM: saved preemption "
-                            f"checkpoint (resumes at epoch {epoch})")
+                            f"checkpoint (resumes at epoch {next_ep} "
+                            f"step {estep})")
                     break
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                     ppl = self.evaluate(eval_loader)

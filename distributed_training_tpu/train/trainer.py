@@ -19,7 +19,11 @@ import numpy as np
 
 from distributed_training_tpu import checkpoint as ckpt_lib
 from distributed_training_tpu.config import TrainConfig, effective_batch_sizes
-from distributed_training_tpu.data.pipeline import build_dataloaders, to_global_batch
+from distributed_training_tpu.data.pipeline import (
+    SkipBatches,
+    build_dataloaders,
+    to_global_batch,
+)
 from distributed_training_tpu.data.prefetch import DevicePrefetcher
 from distributed_training_tpu.models import get_model
 from distributed_training_tpu.parallel.sharding import (
@@ -148,6 +152,7 @@ class Trainer:
             enabled=self.coord.is_master())
         self._guard: PreemptionGuard | None = None
         self._global_step = 0
+        self._epoch_step = 0
         self.coord.print(
             f"[trainer] model={cfg.model} params={param_count(state.params):,} "
             f"mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))} "
@@ -185,8 +190,16 @@ class Trainer:
         return DevicePrefetcher(loader, place, depth=self.cfg.data.prefetch)
 
     # -- train --------------------------------------------------------------
-    def train_epoch(self, epoch: int, loader) -> dict:
+    def train_epoch(self, epoch: int, loader, skip_steps: int = 0) -> dict:
+        """One epoch; ``skip_steps`` drops that many leading batches of the
+        epoch's deterministic shuffle (step-accurate preemption resume —
+        the pre-preemption prefix must not train twice)."""
         loader.set_epoch(epoch)
+        if skip_steps:
+            self.coord.print(
+                f"[trainer] resuming epoch {epoch} at step {skip_steps}")
+            loader = SkipBatches(loader, skip_steps)
+        self._epoch_step = skip_steps
         bar = EpochBar(len(loader), epoch, self.cfg.num_epochs,
                        self.coord.is_master())
         for gbatch in self._batches(loader):
@@ -198,6 +211,7 @@ class Trainer:
                 # Host-side counter: metrics stay device-resident until the
                 # meter's interval flush — no per-step loss.item() sync.
                 self._global_step += 1
+                self._epoch_step += 1
                 fetched = self.meter.push(self._global_step, metrics)
                 bar.update()
                 if fetched:
@@ -269,9 +283,10 @@ class Trainer:
         train_loader, eval_loader = self.make_loaders()
 
         start_epoch = 0
+        start_step = 0
         resume = ckpt_lib.resolve_resume(cfg.checkpoint)
         if resume >= 0:
-            self.state, start_epoch = ckpt_lib.restore_checkpoint(
+            self.state, start_epoch, start_step = ckpt_lib.restore_checkpoint(
                 cfg.checkpoint.directory, resume, self.state)
             self.state = place_state(self.state, self.shardings)
             # Metric sinks must continue the restored step axis, not restart
@@ -285,19 +300,29 @@ class Trainer:
         with trace(cfg.profile_dir), PreemptionGuard() as guard:
             self._guard = guard
             for epoch in range(start_epoch, cfg.num_epochs):
-                self.train_epoch(epoch, train_loader)
+                self.train_epoch(
+                    epoch, train_loader,
+                    skip_steps=start_step if epoch == start_epoch else 0)
                 if guard.should_stop():
                     # Preempted mid-epoch: next_epoch points back at this
-                    # (partial) epoch, which re-runs from its deterministic
-                    # shuffle on resume.
+                    # (partial) epoch, and epoch_step records how far into
+                    # its deterministic shuffle training got — the resume
+                    # skips exactly that prefix (no batch trains twice). A
+                    # SIGTERM landing in the final log interval lets the
+                    # epoch COMPLETE first; that save must roll over to the
+                    # next epoch, or the resume would refuse a skip ==
+                    # len(loader).
                     preempted = True
                     if cfg.checkpoint.save_on_preemption:
+                        done = self._epoch_step >= len(train_loader)
+                        next_ep = epoch + 1 if done else epoch
+                        estep = 0 if done else self._epoch_step
                         ckpt_lib.save_checkpoint(
                             cfg.checkpoint.directory, epoch, self.state,
-                            next_epoch=epoch)
+                            next_epoch=next_ep, epoch_step=estep)
                         self.coord.print(
                             f"[trainer] SIGTERM: saved preemption checkpoint "
-                            f"(resumes at epoch {epoch})")
+                            f"(resumes at epoch {next_ep} step {estep})")
                     break
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
                     final_acc = self.evaluate(eval_loader)

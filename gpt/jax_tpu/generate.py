@@ -40,6 +40,13 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("-r", "--resume", type=int, default=-1,
                         help="epoch to load; -1 = latest (random init if "
                              "no checkpoint exists)")
+    parser.add_argument("--ema-decay", type=float, default=None,
+                        help="must mirror training: an --ema-decay run saves "
+                             "an EMA-wrapped opt_state, and the restore "
+                             "template has to match the checkpoint tree")
+    parser.add_argument("--use-ema", action="store_true", default=False,
+                        help="sample from the EMA parameter average instead "
+                             "of the raw params (requires --ema-decay)")
     parser.add_argument("--max-new-tokens", type=int, default=128)
     parser.add_argument("--temperature", type=float, default=1.0,
                         help="0 = greedy")
@@ -96,9 +103,13 @@ def main() -> int:
     )
 
     # Template state matching LMTrainer's tensor/dp construction — same
-    # optimizer factory, so the orbax opt-state tree round-trips; only
-    # params are consumed here.
-    tx = make_optimizer(OptimizerConfig(), SchedulerConfig(), world_size=1)
+    # optimizer factory (including the EMA wrapper when --ema-decay mirrors
+    # the training run), so the orbax opt-state tree round-trips; only
+    # params (or the EMA average) are consumed here.
+    if args.use_ema and args.ema_decay is None:
+        raise SystemExit("--use-ema requires --ema-decay (mirror training)")
+    tx = make_optimizer(OptimizerConfig(ema_decay=args.ema_decay),
+                        SchedulerConfig(), world_size=1)
     state = init_train_state(
         model, jax.random.PRNGKey(args.seed), (1, 8), tx,
         loss_scale=LossScaleState.create(precision), input_dtype=jax.numpy.int32)
@@ -107,10 +118,17 @@ def main() -> int:
         latest = ckpt_lib.latest_epoch(args.checkpoint)
         epoch = -1 if latest is None else latest
     if epoch >= 0:
-        state, _ = ckpt_lib.restore_checkpoint(args.checkpoint, epoch, state)
+        state, _, _ = ckpt_lib.restore_checkpoint(args.checkpoint, epoch, state)
         print(f"[generate] restored epoch {epoch} from {args.checkpoint}")
     else:
         print("[generate] no checkpoint found; sampling from random init")
+
+    params = state.params
+    if args.use_ema:
+        from distributed_training_tpu.train.optim import ema_params
+
+        params = ema_params(state.opt_state)
+        print("[generate] sampling from EMA parameter average")
 
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)
     if (prompt >= args.vocab_size).any():
@@ -128,7 +146,7 @@ def main() -> int:
     if args.num_beams:
         from distributed_training_tpu.inference import BeamConfig, BeamSearcher
 
-        beams, scores = BeamSearcher(model, state.params, BeamConfig(
+        beams, scores = BeamSearcher(model, params, BeamConfig(
             num_beams=args.num_beams,
             max_new_tokens=args.max_new_tokens,
             eos_id=args.eos_id,
@@ -139,7 +157,7 @@ def main() -> int:
                   f"{args.prompt!r} -> {decode_bytes(beams[0, i])!r}")
         return 0
 
-    gen = Generator(model, state.params, SampleConfig(
+    gen = Generator(model, params, SampleConfig(
         max_new_tokens=args.max_new_tokens,
         temperature=args.temperature,
         top_k=args.top_k,

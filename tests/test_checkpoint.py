@@ -36,7 +36,7 @@ def test_save_restore_roundtrip(tmp_path, state):
     mutated = _mutate(state)
     ckpt_lib.save_checkpoint(str(tmp_path), epoch=3, state=mutated)
 
-    restored, start_epoch = ckpt_lib.restore_checkpoint(
+    restored, start_epoch, _ = ckpt_lib.restore_checkpoint(
         str(tmp_path), 3, state)
     assert start_epoch == 4  # resume at the NEXT epoch
     assert int(restored.step) == 7
@@ -54,6 +54,81 @@ def test_restore_missing_raises(tmp_path, state):
         ckpt_lib.restore_checkpoint(str(tmp_path), 0, state)
 
 
+def test_restore_pre_next_epoch_format(tmp_path, state):
+    """Saves from before the next_epoch meta carry only {epoch}; the format
+    is detected from the on-disk structure (not exception retry) and the
+    old epoch+1 resume semantics apply."""
+    import orbax.checkpoint as ocp
+    from flax import serialization
+
+    mutated = _mutate(state)
+    payload = {
+        "state": serialization.to_state_dict(mutated),
+        "meta": {"epoch": np.int32(5)},
+    }
+    path = str(tmp_path / "epoch_5")
+    ocp.PyTreeCheckpointer().save(path, payload, force=True)
+
+    restored, start_epoch, _ = ckpt_lib.restore_checkpoint(
+        str(tmp_path), 5, state)
+    assert start_epoch == 6
+    assert int(restored.step) == 7
+
+
+def test_restore_migrates_legacy_resnet_block_names(tmp_path, state):
+    """Checkpoints from before the stage{i}_block{j} rename (Flax auto-names
+    BasicBlock_0..7 in creation order) restore through the key-migration
+    shim — params, batch_stats, AND the param-shaped Adam moments."""
+    import orbax.checkpoint as ocp
+    from flax import serialization
+
+    mutated = _mutate(state)
+    sd = serialization.to_state_dict(mutated)
+
+    # Rebuild the old on-disk layout: creation order = (stage, block) order.
+    new_names = sorted(
+        (k for k in sd["params"] if k.startswith("stage")),
+        key=lambda k: tuple(
+            int(x) for x in k.replace("stage", "").split("_block")))
+    to_legacy = {n: f"BasicBlock_{i}" for i, n in enumerate(new_names)}
+
+    def rename(tree):
+        if isinstance(tree, dict):
+            return {to_legacy.get(k, k): rename(v) for k, v in tree.items()}
+        return tree
+
+    payload = {"state": rename(sd),
+               "meta": {"epoch": np.int32(2), "next_epoch": np.int32(3)}}
+    ocp.PyTreeCheckpointer().save(str(tmp_path / "epoch_2"), payload,
+                                  force=True)
+
+    restored, start_epoch, _ = ckpt_lib.restore_checkpoint(
+        str(tmp_path), 2, state)
+    assert start_epoch == 3
+    for a, b in zip(jax.tree.leaves(restored.params),
+                    jax.tree.leaves(mutated.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(restored.opt_state),
+                    jax.tree.leaves(mutated.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_tree_mismatch_surfaces_real_error(tmp_path, state):
+    """A genuinely incompatible save must raise the orbax error once, not a
+    confusing second error from an exception-driven format retry."""
+    import orbax.checkpoint as ocp
+
+    ocp.PyTreeCheckpointer().save(
+        str(tmp_path / "epoch_0"),
+        {"state": {"params": {"totally": np.zeros(3)}},
+         "meta": {"epoch": np.int32(0), "next_epoch": np.int32(1)}},
+        force=True)
+    with pytest.raises(Exception) as ei:
+        ckpt_lib.restore_checkpoint(str(tmp_path), 0, state)
+    # the real structural mismatch, not a missing-next_epoch secondary error
+    assert "next_epoch" not in str(ei.value)
+
+
 def test_latest_epoch_and_prune(tmp_path, state):
     assert ckpt_lib.latest_epoch(str(tmp_path)) is None
     for e in (0, 1, 2, 3):
@@ -61,7 +136,52 @@ def test_latest_epoch_and_prune(tmp_path, state):
     assert ckpt_lib.latest_epoch(str(tmp_path)) == 3
     ckpt_lib.prune_checkpoints(str(tmp_path), keep=2)
     assert ckpt_lib.latest_epoch(str(tmp_path)) == 3
-    restored, start = ckpt_lib.restore_checkpoint(str(tmp_path), 3, state)
+    restored, start, _ = ckpt_lib.restore_checkpoint(str(tmp_path), 3, state)
     assert start == 4
     with pytest.raises(FileNotFoundError):
         ckpt_lib.restore_checkpoint(str(tmp_path), 0, state)
+
+
+def test_legacy_migration_rejects_shape_mismatch(tmp_path, state):
+    """Same block count but different shapes (e.g. legacy resnet34 into a
+    resnet50 template) must NOT be migrated — the plain structural error
+    should surface instead of a confusing shape error on migrated keys."""
+    from distributed_training_tpu.checkpoint import _legacy_block_rename
+    from flax import serialization
+
+    sd = serialization.to_state_dict(_mutate(state))["params"]
+    new_names = sorted(
+        (k for k in sd if k.startswith("stage")),
+        key=lambda k: tuple(
+            int(x) for x in k.replace("stage", "").split("_block")))
+    # Matching-shape mapping is built...
+    legacy = {f"BasicBlock_{i}": sd[n] for i, n in enumerate(new_names)}
+    legacy |= {k: v for k, v in sd.items() if not k.startswith("stage")}
+    assert _legacy_block_rename({"params": legacy}, {"params": sd})
+    # ...but a per-block shape mismatch kills it.
+    import numpy as np
+    bad = dict(legacy)
+    first = f"BasicBlock_0"
+    bad[first] = jax.tree.map(lambda x: np.zeros(np.shape(x) + (1,)),
+                              bad[first])
+    assert _legacy_block_rename({"params": bad}, {"params": sd}) == {}
+
+
+def test_skip_batches_guard_and_cheap_skip():
+    """_SkipBatches refuses an out-of-range resume step and uses the
+    loader's index-level iter_from when available."""
+    from distributed_training_tpu.data.pipeline import ShardedDataLoader
+    from distributed_training_tpu.data.pipeline import SkipBatches
+
+    images = np.arange(8 * 4 * 4 * 3, dtype=np.float32).reshape(8, 4, 4, 3)
+    labels = np.arange(8, dtype=np.int32)
+    loader = ShardedDataLoader(
+        images, labels, global_batch_size=2, shuffle=True, augment="none",
+        process_index=0, process_count=1)
+    loader.set_epoch(0)
+    full = [b["label"].tolist() for b in loader]
+    skipped = [b["label"].tolist() for b in SkipBatches(loader, 2)]
+    assert skipped == full[2:]  # same shuffle, prefix dropped
+    assert len(SkipBatches(loader, 2)) == len(full) - 2
+    with pytest.raises(ValueError, match="epoch geometry"):
+        SkipBatches(loader, 4)

@@ -264,3 +264,52 @@ class TestSamplingTransforms:
             SampleConfig(temperature=-1.0)
         with pytest.raises(ValueError, match="max_new_tokens"):
             SampleConfig(max_new_tokens=0)
+
+
+class TestGenerateCliEmaRestore:
+    def test_ema_checkpoint_restores_and_samples(self, tmp_path, monkeypatch,
+                                                 capsys):
+        """An --ema-decay training run saves an EmaState-wrapped opt_state;
+        generate.py must mirror the flag so the restore template matches,
+        and --use-ema must sample from the EMA average (ADVICE r1)."""
+        from conftest import load_cli_module
+
+        from distributed_training_tpu import checkpoint as ckpt_lib
+        from distributed_training_tpu.config import (
+            OptimizerConfig,
+            PrecisionConfig,
+            SchedulerConfig,
+        )
+        from distributed_training_tpu.train.optim import make_optimizer
+        from distributed_training_tpu.train.precision import LossScaleState
+        from distributed_training_tpu.train.train_state import init_train_state
+
+        model = get_model("transformer_lm", num_classes=256, num_layers=1,
+                          num_heads=2, hidden_dim=32, max_len=64)
+        tx = make_optimizer(OptimizerConfig(ema_decay=0.9),
+                            SchedulerConfig(), world_size=1)
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), (1, 8), tx,
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+            input_dtype=jnp.int32)
+        ckpt_lib.save_checkpoint(str(tmp_path), 0, state)
+
+        gen_cli = load_cli_module("gpt/jax_tpu/generate.py")
+        monkeypatch.setattr("sys.argv", [
+            "generate.py", "-c", str(tmp_path), "--prompt", "ab",
+            "--num-layers", "1", "--num-heads", "2", "--hidden-dim", "32",
+            "--max-len", "64", "--max-new-tokens", "4",
+            "--temperature", "0", "--ema-decay", "0.9", "--use-ema"])
+        assert gen_cli.main() == 0
+        out = capsys.readouterr().out
+        assert "restored epoch 0" in out
+        assert "EMA parameter average" in out
+
+    def test_use_ema_without_decay_refuses(self, tmp_path, monkeypatch):
+        from conftest import load_cli_module
+
+        gen_cli = load_cli_module("gpt/jax_tpu/generate.py")
+        monkeypatch.setattr("sys.argv", [
+            "generate.py", "-c", str(tmp_path), "--use-ema"])
+        with pytest.raises(SystemExit, match="ema-decay"):
+            gen_cli.main()

@@ -138,9 +138,10 @@ class TestTrainerPreemption:
         result2 = tr2.fit()
         assert result2["preempted"] is False
         assert result2["steps"] > steps_before
-        # Epoch 0 re-ran fully + epoch 1: 2 epochs × 4 steps on top of the
-        # restored optimizer step counter.
-        assert result2["steps"] == steps_before + 8
+        # Step-accurate resume: epoch 0 resumes AFTER its already-trained
+        # prefix (steps_before batches), so the total equals an uninterrupted
+        # 2×4-step run — no batch trains twice.
+        assert result2["steps"] == 8
 
     def test_metrics_jsonl_written_by_trainer(self, mesh, tmp_path):
         from distributed_training_tpu.train.trainer import Trainer
@@ -169,10 +170,10 @@ class TestCheckpointNextEpoch:
             loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
         d = str(tmp_path / "c")
         ckpt_lib.save_checkpoint(d, 3, state, next_epoch=3)
-        _, start = ckpt_lib.restore_checkpoint(d, 3, state)
+        _, start, _ = ckpt_lib.restore_checkpoint(d, 3, state)
         assert start == 3
         ckpt_lib.save_checkpoint(d, 3, state)  # normal end-of-epoch save
-        _, start = ckpt_lib.restore_checkpoint(d, 3, state)
+        _, start, _ = ckpt_lib.restore_checkpoint(d, 3, state)
         assert start == 4
 
     def test_old_format_checkpoint_restores_with_epoch_plus_one(
@@ -198,7 +199,7 @@ class TestCheckpointNextEpoch:
             "state": serialization.to_state_dict(state),
             "meta": {"epoch": np.int32(2)},
         })
-        _, start = ckpt_lib.restore_checkpoint(str(tmp_path / "c"), 2, state)
+        _, start, _ = ckpt_lib.restore_checkpoint(str(tmp_path / "c"), 2, state)
         assert start == 3
 
     def test_preempt_during_first_epoch_roundtrips(self, mesh, tmp_path):
@@ -217,5 +218,40 @@ class TestCheckpointNextEpoch:
         d = str(tmp_path / "c")
         ckpt_lib.save_checkpoint(d, 0, state, next_epoch=0)
         assert ckpt_lib.latest_epoch(d) == 0
-        _, start = ckpt_lib.restore_checkpoint(d, 0, state)
+        _, start, _ = ckpt_lib.restore_checkpoint(d, 0, state)
         assert start == 0
+
+
+class TestEpochBoundaryPreemption:
+    def test_sigterm_in_final_interval_rolls_to_next_epoch(
+            self, mesh, tmp_path):
+        """A SIGTERM that lands in the last log interval lets the epoch
+        complete; the preemption save must then point at epoch+1/step 0 —
+        a resume at skip == len(loader) would be refused as geometry
+        drift."""
+        from distributed_training_tpu import checkpoint as ckpt_lib
+        from distributed_training_tpu.train.trainer import Trainer
+
+        cfg = _cfg(tmp_path, auto_resume=True)
+        tr = Trainer(cfg, mesh=mesh)
+        real_step = tr.train_step
+        calls = []
+
+        def step_then_signal(state, batch, rng):
+            out = real_step(state, batch, rng)
+            calls.append(1)
+            if len(calls) == 4:  # last step of the 4-step epoch 0
+                signal.raise_signal(signal.SIGTERM)
+            return out
+
+        tr.train_step = step_then_signal
+        result = tr.fit()
+        assert result["preempted"] is True and result["steps"] == 4
+        _, start_epoch, start_step = ckpt_lib.restore_checkpoint(
+            cfg.checkpoint.directory, 0, tr.state)
+        assert (start_epoch, start_step) == (1, 0)
+
+        # Resume completes epoch 1 only: total = uninterrupted 8 steps.
+        result2 = Trainer(cfg, mesh=mesh).fit()
+        assert result2["preempted"] is False
+        assert result2["steps"] == 8
