@@ -120,17 +120,33 @@ def spmd_pipeline(
     return outputs.reshape(b, *x.shape[1:])
 
 
-def pp_tree_shardings(tree: Any, mesh: Mesh) -> Any:
+def pp_tree_shardings(tree: Any, mesh: Mesh, *, tp: bool = False) -> Any:
     """Shardings for any tree congruent with PP params (incl. Adam moments):
     leaves under a ``blocks`` key shard their leading (layer) dim over
     ``pipe``; everything else is replicated. The match is on an exact path
     component (not a substring), so e.g. a ``res_blocks`` module is not
-    accidentally pipe-sharded."""
-    from distributed_training_tpu.utils.tree import path_keys
+    accidentally pipe-sharded.
+
+    ``tp=True`` composes the megatron rule table on top: block leaves get
+    ``P(pipe, *tp_spec)`` (the stacking dim shifts the TP dims right by
+    one), and the out-of-pipeline leaves (vocab-parallel ``tok_embed`` /
+    ``lm_head``) take their TP spec directly — each pipeline stage then
+    holds only its ``1/tp`` slice of its layers' weights.
+    """
+    from distributed_training_tpu.parallel.tensor_parallel import (
+        tp_spec_for_path,
+    )
+    from distributed_training_tpu.utils.tree import path_keys, path_str
 
     def leaf(path, x):
         if "blocks" in path_keys(path) and getattr(x, "ndim", 0) >= 1:
+            if tp:
+                tp_spec = tp_spec_for_path(path_str(path))
+                if len(tp_spec) == getattr(x, "ndim", 0) - 1:
+                    return NamedSharding(mesh, P(AXIS_PIPE, *tp_spec))
             return NamedSharding(mesh, P(AXIS_PIPE))
+        if tp:
+            return NamedSharding(mesh, tp_spec_for_path(path_str(path)))
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(leaf, tree)
@@ -168,6 +184,11 @@ class PipelinedLM:
             name=None)
         shape = dict(zip(mesh.axis_names, mesh.devices.shape))
         self.pipe_size = shape.get(AXIS_PIPE, 1)
+        # TP composition: a model axis > 1 shards each stage's weights by
+        # the megatron rule table; the pipeline shard_map is partial-manual
+        # over (pipe, data) so GSPMD inserts the model-axis psums inside
+        # each stage's compute.
+        self.tp_size = shape.get("model", 1)
         if model.num_layers % max(self.pipe_size, 1):
             raise ValueError(
                 f"{model.num_layers} layers not divisible into "
@@ -182,8 +203,9 @@ class PipelinedLM:
         return {"blocks": stacked, **rest}
 
     def param_shardings(self, params: dict) -> dict:
-        """Blocks sharded over ``pipe`` on the layer dim; rest replicated."""
-        return pp_tree_shardings(params, self.mesh)
+        """Blocks sharded over ``pipe`` on the layer dim; rest replicated
+        (or megatron-TP-sharded when the mesh has a model axis)."""
+        return pp_tree_shardings(params, self.mesh, tp=self.tp_size > 1)
 
     def _stage_fn(self, stage_params, x):
         def layer(h, p):
@@ -216,6 +238,11 @@ class PipelinedLM:
         x = make_tok_embed(m).apply({"params": params["tok_embed"]}, tokens)
         x = add_pos_embed(m, params["pos_embed"], x, positions)
 
+        # Partial-manual over (pipe, data) when TP is in play: the
+        # scan/ppermute schedule is explicit, while the model-axis (TP)
+        # sharding of the stage weights stays automatic — GSPMD inserts the
+        # megatron psums inside each stage_fn call. Without a model axis,
+        # full-manual is identical and keeps old-jax compatibility.
         pipeline = shard_map(
             functools.partial(
                 spmd_pipeline, self._stage_fn,
@@ -224,6 +251,7 @@ class PipelinedLM:
             in_specs=(jax.tree.map(lambda _: P(AXIS_PIPE), params["blocks"]),
                       P(AXIS_DATA, None, None)),
             out_specs=P(AXIS_DATA, None, None),
+            axis_names=(AXIS_PIPE, AXIS_DATA) if self.tp_size > 1 else None,
         )
         x = pipeline(params["blocks"], x)
 

@@ -183,6 +183,13 @@ def make_lm_train_step(
     statically known. Pass ``model=`` (the :class:`TransformerLM`) to derive
     the bound from the table itself; a hand-passed ``max_len`` that
     disagrees with the model's would re-open the silent-clamp gap.
+
+    The shard_map is *partial-manual* over ``(data, sequence)`` only: every
+    other mesh axis stays automatic, so a state placed by the megatron TP
+    rule table (weights sharded over ``model``) composes transparently —
+    inside each sequence shard, GSPMD inserts the row-parallel psums over
+    ``model`` while the ring hops K/V blocks over ``sequence`` (TP shards
+    heads, SP shards positions; the two are orthogonal dims of attention).
     """
     if (model is None) == (max_len is None):
         raise ValueError("pass exactly one of model= or max_len=")
@@ -190,6 +197,12 @@ def make_lm_train_step(
         max_len = model.max_len
     batch_spec = {"tokens": P(AXIS_DATA, AXIS_SEQUENCE),
                   "targets": P(AXIS_DATA, AXIS_SEQUENCE)}
+    # Partial-manual only when a model axis is actually in play: full-manual
+    # is semantically identical when every non-manual axis is size 1, and it
+    # keeps the plain SP path working on jax versions without axis_names.
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axis_names = ((AXIS_DATA, AXIS_SEQUENCE)
+                  if shape.get("model", 1) > 1 else None)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def jitted(state: TrainState, batch, rng):
@@ -197,6 +210,7 @@ def make_lm_train_step(
             functools.partial(_lm_step_body, ce_chunk=ce_chunk), mesh,
             in_specs=(jax.tree.map(lambda _: P(), state), batch_spec, P()),
             out_specs=(jax.tree.map(lambda _: P(), state), P()),
+            axis_names=axis_names,
         )
         return sharded(state, batch, rng)
 
@@ -341,14 +355,15 @@ def make_pp_lm_train_step(
     )
 
     plm = PipelinedLM(model, mesh, num_microbatches=num_microbatches)
+    tp = plm.tp_size > 1
 
     def state_shardings(state: TrainState):
         repl = NamedSharding(mesh, P())
         return state.replace(
             step=repl,
-            params=pp_tree_shardings(state.params, mesh),
+            params=pp_tree_shardings(state.params, mesh, tp=tp),
             batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
-            opt_state=pp_tree_shardings(state.opt_state, mesh),
+            opt_state=pp_tree_shardings(state.opt_state, mesh, tp=tp),
             loss_scale=jax.tree.map(lambda _: repl, state.loss_scale),
         )
 

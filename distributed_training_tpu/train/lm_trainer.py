@@ -15,8 +15,12 @@ The parallel strategy follows from the mesh, not from a flag:
   megatron TP when ``model > 1`` and plain DP when ``model == 1``, with
   ZeRO stages composing on the free dims.
 
-Mutually exclusive combinations are rejected loudly (``sequence`` with
-``model``/``pipe`` would need 2-level shard_map nesting that is not built).
+``model > 1`` composes with EITHER explicit strategy (TP×SP, PP×TP): the
+sequence/pipeline shard_maps are partial-manual — their own axes are
+manual while ``model`` stays automatic, so megatron shardings propagate
+inside the shards and GSPMD inserts the row-parallel psums there. The one
+remaining exclusion is ``sequence`` with ``pipe`` (two explicit schedules
+over one activation stream), rejected loudly.
 """
 
 from __future__ import annotations
@@ -78,16 +82,18 @@ class LMTrainer:
         seq = shape.get(AXIS_SEQUENCE, 1)
         pipe = shape.get(AXIS_PIPE, 1)
         model_par = shape.get(AXIS_MODEL, 1)
-        if seq > 1 and (pipe > 1 or model_par > 1):
+        if seq > 1 and pipe > 1:
             raise NotImplementedError(
-                "sequence parallelism does not compose with model/pipe axes "
-                "in this engine; use one of (sequence) | (model [+zero]) | "
-                "(pipe)")
-        if pipe > 1 and model_par > 1:
-            raise NotImplementedError("model and pipe axes do not compose yet")
+                "sequence and pipe axes do not compose in this engine; use "
+                "(sequence [×model]) | (pipe [×model]) | (model [+zero])")
         self.strategy = ("sequence" if seq > 1 else
                          "pipeline" if pipe > 1 else
                          "tensor/dp")
+        # model_par composes with EITHER explicit strategy: the sequence and
+        # pipeline shard_maps are partial-manual (their own axes manual,
+        # ``model`` automatic), so megatron TP shardings propagate inside
+        # the shards and GSPMD inserts the row-parallel psums there.
+        self.tp_size = model_par
         if self.strategy != "tensor/dp" and cfg.zero.stage != 0:
             # Refuse rather than silently train unsharded while the banner
             # advertises a ZeRO stage.
@@ -215,15 +221,21 @@ class LMTrainer:
                 tx=self.tx, loss_scale=loss_scale)
             self.shardings = self.train_step.state_shardings(state)
         elif self.strategy == "sequence":
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from distributed_training_tpu.parallel.tensor_parallel import (
+                tp_state_shardings,
+            )
 
             self.train_step = make_lm_train_step(
                 self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
-            repl = NamedSharding(self.mesh, P())
-            self.shardings = jax.tree.map(lambda _: repl, state)
+            # TP rule table: over a model axis of size 1 every spec is a
+            # no-op shard (pure-SP state replication, as before); with
+            # model > 1 the weights shard megatron-style and the sequence
+            # step's partial-manual shard_map leaves them automatic.
+            self.shardings = tp_state_shardings(state, self.mesh,
+                                                zero_stage=0)
         else:
             self.train_step = make_tp_lm_train_step(
                 self.mesh, model=self.model, zero_stage=cfg.zero.stage,
@@ -280,9 +292,11 @@ class LMTrainer:
         self._guard: PreemptionGuard | None = None
         self._global_step = 0
         self._epoch_step = 0
+        strategy_label = self.strategy + (
+            "×tp" if self.tp_size > 1 and self.strategy != "tensor/dp" else "")
         self.coord.print(
             f"[lm_trainer] params={param_count(state.params):,} "
-            f"mesh={shape} strategy={self.strategy} "
+            f"mesh={shape} strategy={strategy_label} "
             f"zero_stage={cfg.zero.stage} dtype={cfg.precision.dtype} "
             f"seq_len={lm.seq_len}"
             + (f" grad_accum={self.grad_accum}" if self.grad_accum > 1 else ""))

@@ -19,16 +19,29 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
-def shard_map(fn, mesh, in_specs, out_specs):
+def shard_map(fn, mesh, in_specs, out_specs, axis_names=None):
     """``shard_map`` without replication checking, across jax versions.
 
     The replication-check flag was renamed ``check_rep`` → ``check_vma``;
     both spellings are handled here so callers don't each carry the
     try/except.
+
+    ``axis_names`` selects *partial-manual* mode: only the named mesh axes
+    are manual (specs refer to them); the remaining axes stay automatic, so
+    GSPMD keeps propagating shardings through the body. This is how the
+    explicit strategies compose with declarative TP: ring attention /
+    pipeline collectives run manually over ``sequence``/``pipe`` while the
+    megatron ``model``-axis psums are inserted by GSPMD inside the shards.
     """
+    kwargs = {} if axis_names is None else {"axis_names": set(axis_names)}
     try:
         return _shard_map(fn, mesh=mesh, in_specs=in_specs,
-                          out_specs=out_specs, check_vma=False)
+                          out_specs=out_specs, check_vma=False, **kwargs)
     except TypeError:
+        if axis_names is not None:
+            raise RuntimeError(
+                "this jax version's shard_map has no axis_names "
+                "(partial-manual) support; TP×SP / PP×TP composition "
+                "requires jax >= 0.6")
         return _shard_map(fn, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_rep=False)
