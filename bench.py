@@ -87,6 +87,135 @@ def build(model_name: str, batch_size: int, image_size: int, num_classes: int,
     return mesh, state, step
 
 
+def bench_data_only(args) -> None:
+    """Host input-pipeline throughput: can the host feed the device rate?
+
+    Two paths, mirroring real training:
+    - ``imagefolder``: JPEG decode (PIL) + resize/crop/flip per example via
+      the threaded :class:`ImageFolderLoader` — the DALI-analogue path. A
+      synthetic on-disk tree is generated once (real JPEG bytes, so decode
+      cost is real).
+    - ``augment``: in-memory arrays through the C++ (ctypes) fused
+      pad/crop/flip/normalize augmentation — the CIFAR-style path.
+
+    Prints ONE JSON line: host images/sec for the requested path and
+    ``vs_baseline`` against the measured device rate (2400 img/s on the one
+    v5e chip, BASELINE.md), i.e. >= 1.0 means the host is not the
+    bottleneck.
+    """
+    import shutil
+    import tempfile
+
+    DEVICE_RATE = 2400.0  # measured R50 img/s/chip, BASELINE.md round 1
+
+    if args.data_path:
+        if not os.path.isdir(args.data_path):
+            raise SystemExit(
+                f"--data-path {args.data_path} does not exist; omit it to "
+                f"bench against a generated synthetic JPEG tree")
+        root, cleanup = args.data_path, None
+    else:
+        from PIL import Image
+
+        root = tempfile.mkdtemp(prefix="bench_imagefolder_")
+        cleanup = root
+        rng = np.random.RandomState(0)
+        n_images = args.data_images
+        per_class = n_images // 8
+        for c in range(8):
+            d = os.path.join(root, "train", f"class{c}")
+            os.makedirs(d)
+            for i in range(per_class):
+                # Real JPEG bytes at ImageNet-ish dims: decode cost is real.
+                arr = rng.randint(0, 255, (256, 256, 3), dtype=np.uint8)
+                Image.fromarray(arr).save(
+                    os.path.join(d, f"im{i}.jpg"), quality=85)
+
+    def timed_epoch(loader):
+        loader.set_epoch(0)
+        for _ in loader:  # warm epoch (thread spin-up, page cache)
+            pass
+        loader.set_epoch(1)
+        t0 = time.perf_counter()
+        n = 0
+        for b in loader:
+            n += len(b["label"])
+        return n / (time.perf_counter() - t0)
+
+    try:
+        folder_rate = cached_rate = None
+        if args.data_mode in ("imagefolder", "cached", "both"):
+            from distributed_training_tpu.data.imagefolder import (
+                ImageFolderLoader,
+                scan_imagefolder,
+            )
+
+            paths, labels, _ = scan_imagefolder(os.path.join(root, "train"))
+            if args.data_mode != "cached":
+                folder_rate = timed_epoch(ImageFolderLoader(
+                    paths, labels, global_batch_size=args.batch_size,
+                    image_size=args.image_size, augment="pad_crop_flip",
+                    train=True, num_workers=args.data_workers,
+                    process_index=0, process_count=1))
+            if args.data_mode in ("cached", "both"):
+                from distributed_training_tpu.data.decoded_cache import (
+                    DecodedCacheLoader,
+                    build_decoded_cache,
+                )
+
+                cache = os.path.join(root, ".decoded_cache",
+                                     f"train_{args.image_size}")
+                t0 = time.perf_counter()
+                build_decoded_cache(
+                    paths, labels, cache, image_size=args.image_size,
+                    num_workers=args.data_workers)
+                build_s = time.perf_counter() - t0
+                cached_rate = timed_epoch(DecodedCacheLoader(
+                    cache, global_batch_size=args.batch_size,
+                    augment="pad_crop_flip", train=True,
+                    process_index=0, process_count=1))
+                print(json.dumps({
+                    "note": "decoded-cache one-time build",
+                    "images": len(paths), "seconds": round(build_s, 1),
+                }), file=sys.stderr)
+
+        augment_rate = None
+        if args.data_mode in ("augment", "both"):
+            from distributed_training_tpu.data.pipeline import ShardedDataLoader
+
+            rng = np.random.RandomState(0)
+            images = rng.rand(4096, 32, 32, 3).astype(np.float32)
+            labels = rng.randint(0, 10, 4096).astype(np.int32)
+            augment_rate = timed_epoch(ShardedDataLoader(
+                images, labels, global_batch_size=args.batch_size,
+                augment="pad_crop_flip", train=True,
+                process_index=0, process_count=1))
+    finally:
+        if cleanup:
+            shutil.rmtree(cleanup, ignore_errors=True)
+
+    # Primary = the rate the device would actually be fed in steady state:
+    # the cached path when measured, else live decode, else augment.
+    primary = next(r for r in (cached_rate, folder_rate, augment_rate)
+                   if r is not None)
+    extras = {}
+    if cached_rate is not None and primary is not cached_rate:
+        extras["cached_images_per_sec"] = round(cached_rate, 1)
+    if folder_rate is not None and primary is not folder_rate:
+        extras["jpeg_decode_images_per_sec"] = round(folder_rate, 1)
+    if augment_rate is not None and primary is not augment_rate:
+        extras["augment_images_per_sec"] = round(augment_rate, 1)
+    print(json.dumps({
+        "metric": f"host input pipeline ({args.data_mode}; {os.cpu_count()} "
+                  f"core(s), {args.data_workers} threads, batch "
+                  f"{args.batch_size})",
+        "value": round(primary, 2),
+        "unit": "images/sec (host)",
+        "vs_baseline": round(primary / DEVICE_RATE, 4),
+        **extras,
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50")
@@ -103,7 +232,22 @@ def main():
     ap.add_argument("--sync-interval", type=int, default=15,
                     help="fetch the loss to host every N steps (the honest "
                          "execution barrier; see comment in main)")
+    ap.add_argument("--data-only", action="store_true", default=False,
+                    help="bench the HOST input pipeline instead of the "
+                         "device step (no TPU touched)")
+    ap.add_argument("--data-mode", default="both",
+                    choices=["imagefolder", "cached", "augment", "both"])
+    ap.add_argument("--data-path", default=None,
+                    help="existing imagefolder root (<root>/train/...); "
+                         "default generates a synthetic JPEG tree")
+    ap.add_argument("--data-images", type=int, default=2048,
+                    help="synthetic-tree size for --data-only")
+    ap.add_argument("--data-workers", type=int, default=os.cpu_count() or 8)
     args = ap.parse_args()
+
+    if args.data_only:
+        bench_data_only(args)
+        return
 
     platform = ensure_live_backend()
     if platform == "cpu" and args.model == "resnet50":

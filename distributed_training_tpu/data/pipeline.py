@@ -252,6 +252,47 @@ def build_dataloaders(cfg, coordinator=None, *, seed: int = 0,
             raise ValueError(
                 f"found {len(classes)} classes under {data.data_path}, "
                 f"config says num_classes={data.num_classes}")
+        if data.decoded_cache:
+            # Pre-decoded uint8 memmap cache (DALI-cache analogue): decode
+            # once — rank-0 first so hosts sharing a filesystem don't race —
+            # then every epoch runs at augment speed instead of JPEG-decode
+            # speed (single measured core: ~47k img/s vs ~150 img/s).
+            from distributed_training_tpu.data.decoded_cache import (
+                DecodedCacheLoader,
+                build_decoded_cache,
+            )
+
+            cache_root = os.path.join(data.data_path, ".decoded_cache")
+
+            def _build():
+                for split, paths, labels in (
+                        ("train", tr_paths, tr_labels),
+                        ("val", ev_paths, ev_labels)):
+                    build_decoded_cache(
+                        paths, labels,
+                        os.path.join(cache_root,
+                                     f"{split}_{data.image_size}"),
+                        image_size=data.image_size,
+                        num_workers=data.num_workers)
+
+            if coordinator is not None:
+                with coordinator.priority_execution("decoded_cache"):
+                    _build()
+            else:
+                _build()
+            cached = dict(image_size=data.image_size, seed=seed,
+                          augment=data.augment)
+            train_loader = DecodedCacheLoader(
+                os.path.join(cache_root, f"train_{data.image_size}"),
+                global_batch_size=global_bs, shuffle=True,
+                drop_last=data.drop_last, train=True,
+                max_steps=data.max_steps_per_epoch, **cached)
+            eval_loader = DecodedCacheLoader(
+                os.path.join(cache_root, f"val_{data.image_size}"),
+                global_batch_size=eval_bs, shuffle=False,
+                drop_last=False, train=False, **cached)
+            return train_loader, eval_loader
+
         train_loader = ImageFolderLoader(
             tr_paths, tr_labels, global_batch_size=global_bs, shuffle=True,
             drop_last=data.drop_last, train=True,

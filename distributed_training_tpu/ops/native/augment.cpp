@@ -103,6 +103,40 @@ void pad_crop_flip_u8(const uint8_t* in, uint8_t* out,
   });
 }
 
+// Fused gather + crop + flip: reads crop windows DIRECTLY out of a big
+// (possibly memory-mapped) uint8 dataset — no intermediate gathered copy.
+// in:  [N_total, bh, bw, c] uint8 (the decoded cache); idx: [n] int64 rows
+// out: [n, h, w, c] uint8
+void gather_crop_flip_u8(const uint8_t* in, uint8_t* out,
+                         const int64_t* idx,
+                         int64_t n, int64_t bh, int64_t bw,
+                         int64_t h, int64_t w, int64_t c,
+                         const int32_t* ys, const int32_t* xs,
+                         const uint8_t* flips) {
+  const int64_t src_img = bh * bw * c;
+  const int64_t dst_img = h * w * c;
+  parallel_for(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const uint8_t* src = in + idx[i] * src_img;
+      uint8_t* dst = out + i * dst_img;
+      const int64_t y0 = ys[i];
+      const int64_t x0 = xs[i];
+      const bool flip = flips[i] != 0;
+      for (int64_t y = 0; y < h; ++y) {
+        const uint8_t* srow = src + (y + y0) * bw * c + x0 * c;
+        uint8_t* drow = dst + y * w * c;
+        if (!flip) {
+          std::memcpy(drow, srow, w * c);
+        } else {
+          for (int64_t x = 0; x < w; ++x) {
+            std::memcpy(drow + x * c, srow + (w - 1 - x) * c, c);
+          }
+        }
+      }
+    }
+  });
+}
+
 // out = in * scale + bias, elementwise over n values.
 void u8_to_f32_affine(const uint8_t* in, float* out, int64_t n,
                       float scale, float bias) {

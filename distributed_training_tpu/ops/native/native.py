@@ -73,6 +73,13 @@ def get_lib() -> ctypes.CDLL | None:
             ctypes.c_float, ctypes.c_float,
         ]
         lib.u8_to_f32_affine.restype = None
+        lib.gather_crop_flip_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.gather_crop_flip_u8.restype = None
         _lib = lib
         return _lib
 
@@ -89,12 +96,40 @@ def pad_crop_flip(images: np.ndarray, ys: np.ndarray, xs: np.ndarray,
     images = np.ascontiguousarray(images, dtype=np.uint8)
     n, h, w, c = images.shape
     out = np.empty_like(images)
+    # Bind converted index arrays to locals: `ascontiguousarray(x).ctypes
+    # .data` would free the converted copy before the call (the int address
+    # does not keep the array alive) — dangling pointer when dtypes differ.
+    ys = np.ascontiguousarray(ys, np.int32)
+    xs = np.ascontiguousarray(xs, np.int32)
+    flips = np.ascontiguousarray(flips, np.uint8)
     lib.pad_crop_flip_u8(
         images.ctypes.data, out.ctypes.data,
         n, h, w, c, pad,
-        np.ascontiguousarray(ys, np.int32).ctypes.data,
-        np.ascontiguousarray(xs, np.int32).ctypes.data,
-        np.ascontiguousarray(flips, np.uint8).ctypes.data)
+        ys.ctypes.data, xs.ctypes.data, flips.ctypes.data)
+    return out
+
+
+def gather_crop_flip(dataset: np.ndarray, lidx: np.ndarray, ys: np.ndarray,
+                     xs: np.ndarray, flips: np.ndarray,
+                     size: int) -> np.ndarray:
+    """Fused gather+crop+flip straight out of a [N, bh, bw, c] uint8
+    dataset (works on a memmap WITHOUT materializing it — no
+    ascontiguousarray on the dataset, which would copy the whole file)."""
+    lib = get_lib()
+    assert lib is not None, "native lib unavailable — check available() first"
+    if dataset.dtype != np.uint8 or not dataset.flags["C_CONTIGUOUS"]:
+        raise ValueError("dataset must be C-contiguous uint8")
+    _, bh, bw, c = dataset.shape
+    n = len(lidx)
+    out = np.empty((n, size, size, c), np.uint8)
+    lidx = np.ascontiguousarray(lidx, np.int64)
+    ys = np.ascontiguousarray(ys, np.int32)
+    xs = np.ascontiguousarray(xs, np.int32)
+    flips = np.ascontiguousarray(flips, np.uint8)
+    lib.gather_crop_flip_u8(
+        dataset.ctypes.data, out.ctypes.data, lidx.ctypes.data,
+        n, bh, bw, size, size, c,
+        ys.ctypes.data, xs.ctypes.data, flips.ctypes.data)
     return out
 
 

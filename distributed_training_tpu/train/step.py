@@ -62,15 +62,31 @@ def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray,
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def _input_images(batch, input_affine=None):
+    """Device-side input decode: uint8 batches (the decoded-cache loader
+    ships raw u8 — 4× less host/PCIe traffic, and the cast fuses into the
+    first conv on TPU) are mapped to float with a static affine.
+    ``input_affine`` defaults to ToTensor's ``x/255``; the normalize_only
+    augment mode passes ``(2/255, -1)`` (= Normalize(0.5, 0.5) after
+    ToTensor). Float inputs pass through untouched (host already did it).
+    """
+    x = batch["image"]
+    if x.dtype == jnp.uint8:
+        scale, bias = input_affine or (1.0 / 255.0, 0.0)
+        x = x.astype(jnp.float32) * scale + bias
+    return x
+
+
 def _forward_and_loss(state: TrainState, params, batch, rng, train: bool,
-                      label_smoothing: float = 0.0):
+                      label_smoothing: float = 0.0, input_affine=None):
     variables = {"params": params}
     if state.batch_stats:
         variables["batch_stats"] = state.batch_stats
+    images = _input_images(batch, input_affine)
     if train:
         rngs = dict(zip(("dropout", "gate"), jax.random.split(rng)))
         logits, mutated = state.apply_fn(
-            variables, batch["image"], train=True,
+            variables, images, train=True,
             mutable=["batch_stats", "aux_loss"],
             rngs=rngs,
         )
@@ -78,7 +94,7 @@ def _forward_and_loss(state: TrainState, params, batch, rng, train: bool,
         new_batch_stats = mutated.get("batch_stats", state.batch_stats)
         aux = sum(jax.tree.leaves(mutated.get("aux_loss", {})), jnp.float32(0))
     else:
-        logits = state.apply_fn(variables, batch["image"], train=False)
+        logits = state.apply_fn(variables, images, train=False)
         new_batch_stats = state.batch_stats
         aux = jnp.float32(0)
     loss = cross_entropy_loss(logits, batch["label"], label_smoothing) + aux
@@ -132,7 +148,8 @@ def accumulate_grads(params, batch, rng, accum_steps: int, mesh: Mesh | None,
 
 
 def _accum_grads_and_stats(state: TrainState, batch, rng, accum_steps: int,
-                           mesh: Mesh | None, label_smoothing: float = 0.0):
+                           mesh: Mesh | None, label_smoothing: float = 0.0,
+                           input_affine=None):
     """Image-step accumulation: BatchNorm running stats thread sequentially
     through the scan (torch grad-accum semantics: every microbatch forward
     ticks the EMA). Returns (avg grads, mean loss, mean accuracy, stats)."""
@@ -141,7 +158,7 @@ def _accum_grads_and_stats(state: TrainState, batch, rng, accum_steps: int,
         def loss_fn(p):
             loss, logits, new_bs = _forward_and_loss(
                 state.replace(batch_stats=bs), p, mbatch, r, train=True,
-                label_smoothing=label_smoothing)
+                label_smoothing=label_smoothing, input_affine=input_affine)
             return state.loss_scale.scale_loss(loss), (loss, logits, new_bs)
 
         grads, (loss, logits, new_bs) = jax.grad(
@@ -158,7 +175,7 @@ def _accum_grads_and_stats(state: TrainState, batch, rng, accum_steps: int,
 
 def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
                accum_steps: int = 1, mesh: Mesh | None = None,
-               label_smoothing: float = 0.0):
+               label_smoothing: float = 0.0, input_affine=None):
     """Shared step body for the GSPMD and shard_map paths.
 
     When ``axis_name`` is set (shard_map path), gradients/metrics are
@@ -169,7 +186,8 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
     """
     if accum_steps > 1:
         grads, loss, accuracy, new_batch_stats = _accum_grads_and_stats(
-            state, batch, rng, accum_steps, mesh, label_smoothing)
+            state, batch, rng, accum_steps, mesh, label_smoothing,
+            input_affine)
         grads = state.loss_scale.unscale_grads(grads)
         new_state, finite = commit_gradients(state, grads, new_batch_stats)
         return new_state, {
@@ -182,7 +200,7 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
     def loss_fn(params):
         loss, logits, new_bs = _forward_and_loss(
             state, params, batch, rng, train=True,
-            label_smoothing=label_smoothing)
+            label_smoothing=label_smoothing, input_affine=input_affine)
         return state.loss_scale.scale_loss(loss), (loss, logits, new_bs)
 
     grads, (loss, logits, new_batch_stats) = jax.grad(
@@ -236,6 +254,7 @@ def make_train_step(
     donate: bool = True,
     grad_accum_steps: int = 1,
     label_smoothing: float = 0.0,
+    input_affine: tuple | None = None,
 ) -> Callable:
     """Build the GSPMD jitted train step for a mesh + ZeRO stage.
 
@@ -267,7 +286,8 @@ def make_train_step(
                     _step_body, axis_name=None,
                     accum_steps=grad_accum_steps,
                     mesh=mesh if grad_accum_steps > 1 else None,
-                    label_smoothing=label_smoothing),
+                    label_smoothing=label_smoothing,
+                    input_affine=input_affine),
                 in_shardings=(sshard, bshard, replicated(mesh)),
                 out_shardings=(sshard, replicated(mesh)),
                 donate_argnums=(0,) if donate else (),
@@ -279,7 +299,8 @@ def make_train_step(
 
 
 def make_shard_map_train_step(mesh: Mesh, donate: bool = True,
-                              label_smoothing: float = 0.0) -> Callable:
+                              label_smoothing: float = 0.0,
+                              input_affine: tuple | None = None) -> Callable:
     """Explicit-collective DP train step (``shard_map`` + ``lax.pmean``).
 
     The hand-written formulation of DDP's gradient all-reduce
@@ -294,7 +315,8 @@ def make_shard_map_train_step(mesh: Mesh, donate: bool = True,
     def step(state: TrainState, batch, rng):
         sharded = shard_map(
             functools.partial(_step_body, axis_name=AXIS_DATA,
-                              label_smoothing=label_smoothing),
+                              label_smoothing=label_smoothing,
+                              input_affine=input_affine),
             mesh,
             in_specs=(
                 jax.tree.map(lambda _: P(), state),
@@ -308,7 +330,8 @@ def make_shard_map_train_step(mesh: Mesh, donate: bool = True,
     return step
 
 
-def make_eval_step(mesh: Mesh | None = None) -> Callable:
+def make_eval_step(mesh: Mesh | None = None,
+                   input_affine: tuple | None = None) -> Callable:
     """Jitted eval step: per-batch (top1_count, top5_count, example_count).
 
     The reference builds a ``test_dataloader`` but never consumes it
@@ -320,7 +343,8 @@ def make_eval_step(mesh: Mesh | None = None) -> Callable:
 
     def eval_body(state: TrainState, batch):
         _, logits, _ = _forward_and_loss(
-            state, state.params, batch, jax.random.PRNGKey(0), train=False)
+            state, state.params, batch, jax.random.PRNGKey(0), train=False,
+            input_affine=input_affine)
         labels = batch["label"]
         correct = (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
         # Top-5 (the second ImageNet-standard metric); degenerates to top-1

@@ -127,11 +127,18 @@ class Trainer:
         # contributes batch_size examples of work.
         self.train_gbs, self.eval_gbs, self.grad_accum = effective_batch_sizes(
             cfg, int(self.mesh.devices.size), allow_derive=uses_gspmd_step)
+        # uint8 batches (decoded-cache loader) defer ToTensor/Normalize to
+        # the device, fused into the first conv; the affine encodes the
+        # augment mode's normalization. Float batches ignore it.
+        input_affine = ((2.0 / 255.0, -1.0)
+                        if cfg.data.augment == "normalize_only"
+                        else (1.0 / 255.0, 0.0))
         if uses_gspmd_step:
             self.train_step = make_train_step(
                 self.mesh, zero_stage=cfg.zero.stage,
                 grad_accum_steps=self.grad_accum,
-                label_smoothing=cfg.label_smoothing)
+                label_smoothing=cfg.label_smoothing,
+                input_affine=input_affine)
         else:
             if cfg.zero.stage != 0:
                 raise NotImplementedError(
@@ -143,8 +150,9 @@ class Trainer:
                     "gradient accumulation is built on the GSPMD step; use "
                     "sync_batchnorm=True with it")
             self.train_step = make_shard_map_train_step(
-                self.mesh, label_smoothing=cfg.label_smoothing)
-        self.eval_step = make_eval_step(self.mesh)
+                self.mesh, label_smoothing=cfg.label_smoothing,
+                input_affine=input_affine)
+        self.eval_step = make_eval_step(self.mesh, input_affine=input_affine)
         self.meter = MetricMeter(cfg.log_interval)
         self.clock = WallClock(cfg.wall_clock_breakdown)
         self.metrics_writer = MetricsWriter(

@@ -123,6 +123,11 @@ def add_argument() -> argparse.Namespace:
                         help="dataset root (default: $DATA or ../data); "
                              "imagefolder expects <root>/train and "
                              "<root>/val class-directory trees")
+    parser.add_argument("--decoded-cache", action="store_true", default=False,
+                        help="(imagefolder) decode the tree once into a "
+                             "uint8 memmap cache under <root>/.decoded_cache "
+                             "and serve epochs from it — decode-bound hosts "
+                             "become augment-bound (DALI-cache analogue)")
     parser.add_argument("--image-size", type=int, default=None,
                         help="square input size (default: 224 for "
                              "imagenet-style datasets, 32 for CIFAR)")
@@ -260,6 +265,7 @@ def build_config(args: argparse.Namespace):
             image_size=image_size,
             num_classes=num_classes,
             max_steps_per_epoch=args.steps_per_epoch,
+            decoded_cache=args.decoded_cache,
         ),
         moe=MoEConfig(
             enabled=args.moe,
