@@ -58,7 +58,9 @@ def ensure_live_backend(probe_timeout: int = 180) -> str:
 
 
 def build(model_name: str, batch_size: int, image_size: int, num_classes: int,
-          zero_stage: int = 0, remat: bool = False):
+          zero_stage: int = 0, remat: bool = False,
+          remat_policy: str | None = None, param_dtype: str = "fp32",
+          grad_accum: int = 1):
     from distributed_training_tpu.config import PrecisionConfig
     from distributed_training_tpu.models import get_model
     from distributed_training_tpu.parallel.sharding import (
@@ -71,7 +73,16 @@ def build(model_name: str, batch_size: int, image_size: int, num_classes: int,
     from distributed_training_tpu.train.train_state import init_train_state
 
     mesh = create_mesh(MeshConfig(data=-1))
-    kwargs = {"remat": True} if remat else {}
+    kwargs = {}
+    if remat or remat_policy:
+        kwargs["remat"] = True
+        if remat_policy:
+            kwargs["remat_policy"] = remat_policy
+    if param_dtype == "bf16":
+        # Lever: bf16 master params + bf16 SGD momentum — halves the
+        # weight/opt-state HBM traffic per step (fine for throughput
+        # measurement; convergence-critical runs keep fp32 masters).
+        kwargs["param_dtype"] = jnp.bfloat16
     model = get_model(model_name, num_classes=num_classes, dtype=jnp.bfloat16,
                       **kwargs)
     # SGD+momentum per the BASELINE.json north-star spec ("forward, backward,
@@ -83,7 +94,8 @@ def build(model_name: str, batch_size: int, image_size: int, num_classes: int,
         (batch_size, image_size, image_size, 3), tx,
         loss_scale=LossScaleState.create(PrecisionConfig(dtype="bf16")))
     state = place_state(state, state_shardings(state, mesh, zero_stage=zero_stage))
-    step = make_train_step(mesh, zero_stage=zero_stage, donate=True)
+    step = make_train_step(mesh, zero_stage=zero_stage, donate=True,
+                           grad_accum_steps=grad_accum)
     return mesh, state, step
 
 
@@ -227,6 +239,18 @@ def main():
                     help="ZeRO placement for the benched step")
     ap.add_argument("--remat", action="store_true", default=False,
                     help="activation-checkpoint blocks (fits larger batches)")
+    ap.add_argument("--remat-policy", default=None, choices=[None, "conv"],
+                    help="'conv': save only conv outputs, recompute BN/ReLU "
+                         "in backward (memory-traffic lever)")
+    ap.add_argument("--param-dtype", default="fp32", choices=["fp32", "bf16"],
+                    help="bf16: halve weight+momentum HBM traffic")
+    ap.add_argument("--input-dtype", default="fp32",
+                    choices=["fp32", "bf16", "uint8"],
+                    help="batch image dtype (bf16/uint8 cut host->HBM input "
+                         "bytes; uint8 decodes on device like the cache path)")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="microbatch scan inside the step (batch-size is the "
+                         "effective batch)")
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--steps", type=int, default=45)
     ap.add_argument("--sync-interval", type=int, default=15,
@@ -262,13 +286,20 @@ def main():
 
     mesh, state, step = build(
         args.model, global_batch, args.image_size, args.num_classes,
-        zero_stage=args.zero_stage, remat=args.remat)
+        zero_stage=args.zero_stage, remat=args.remat,
+        remat_policy=args.remat_policy, param_dtype=args.param_dtype,
+        grad_accum=args.grad_accum)
 
     rng = np.random.RandomState(0)
+    images = rng.rand(global_batch, args.image_size, args.image_size, 3)
+    if args.input_dtype == "uint8":
+        images = jnp.asarray((images * 255).astype(np.uint8))
+    else:
+        images = jnp.asarray(
+            images, jnp.bfloat16 if args.input_dtype == "bf16"
+            else jnp.float32)
     batch = {
-        "image": jnp.asarray(
-            rng.rand(global_batch, args.image_size, args.image_size, 3),
-            jnp.float32),
+        "image": images,
         "label": jnp.asarray(
             rng.randint(0, args.num_classes, global_batch), jnp.int32),
     }
@@ -301,6 +332,10 @@ def main():
                   f"(bf16, batch {args.batch_size}/chip"
                   f"{', zero-' + str(args.zero_stage) if args.zero_stage else ''}"
                   f"{', remat' if args.remat else ''}"
+                  f"{', remat:' + args.remat_policy if args.remat_policy else ''}"
+                  f"{', params:bf16' if args.param_dtype == 'bf16' else ''}"
+                  f"{', in:' + args.input_dtype if args.input_dtype != 'fp32' else ''}"
+                  f"{', accum:' + str(args.grad_accum) if args.grad_accum > 1 else ''}"
                   f", {n_chips} {platform} chip(s))",
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",

@@ -59,6 +59,45 @@ def _load_binary_batches(root: str, train: bool):
     return np.ascontiguousarray(x), labels
 
 
+def synthetic_cifar10_hard(n: int, train: bool, seed: int = 0):
+    """Procedural CIFAR stand-in that is NOT linearly separable.
+
+    Each class is a Gabor texture — a sinusoidal grating under a Gaussian
+    envelope — where the class determines only the *orientation* and
+    *spatial frequency*; position, phase, and pixel noise are random and
+    the mean intensity is identical across classes. A linear probe on raw
+    pixels stays near chance, so a model reaching high accuracy had to
+    learn oriented-frequency conv features — making a multi-epoch
+    convergence run a real signal (used for the 5-epoch reference-protocol
+    run on the real chip when the actual CIFAR-10 binaries are absent;
+    BASELINE.md "convergence").
+    """
+    rng = np.random.RandomState(seed + (0 if train else 1))
+    labels = rng.randint(0, NUM_CLASSES, size=n).astype(np.int32)
+    yy, xx = np.mgrid[0:32, 0:32] / 32.0
+    angles = np.pi * (np.arange(NUM_CLASSES) % 5) / 5.0
+    freqs = np.where(np.arange(NUM_CLASSES) < 5, 5.0, 9.0)
+    phase = rng.rand(n) * 2 * np.pi
+    cx = rng.rand(n) * 0.5 + 0.25
+    cy = rng.rand(n) * 0.5 + 0.25
+    images = np.empty((n, *IMAGE_SHAPE), np.uint8)
+    tint = np.array([1.0, 0.85, 0.7])  # fixed channel weighting, class-free
+    for c in range(NUM_CLASSES):
+        idx = np.where(labels == c)[0]
+        if not len(idx):
+            continue
+        dx = xx[None] - cx[idx, None, None]
+        dy = yy[None] - cy[idx, None, None]
+        t = np.cos(angles[c]) * dx + np.sin(angles[c]) * dy
+        wave = np.sin(2 * np.pi * freqs[c] * t + phase[idx, None, None])
+        env = np.exp(-(dx ** 2 + dy ** 2) / 0.06)
+        pat = (wave * env)[..., None] * tint
+        noisy = pat * 0.5 + rng.randn(len(idx), *IMAGE_SHAPE) * 0.18
+        images[idx] = np.clip((noisy * 0.5 + 0.5) * 255, 0, 255).astype(
+            np.uint8)
+    return images, labels
+
+
 def synthetic_cifar10(n: int, train: bool, seed: int = 0):
     """Deterministic CIFAR-shaped synthetic data.
 

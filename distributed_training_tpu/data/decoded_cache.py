@@ -73,12 +73,22 @@ def build_decoded_cache(
     (count, base size) is kept. Multi-host: build under
     ``Coordinator.priority_execution`` so process 0 writes first.
     """
+    import hashlib
+
     base = _base_size(image_size)
+    # Content fingerprint: a renamed/relabeled/reordered tree with the SAME
+    # file count must not serve a stale cache — hash the (path, label)
+    # sequence, not just its length.
+    digest = hashlib.sha256()
+    for p, l in zip(paths, np.asarray(labels).tolist()):
+        digest.update(f"{os.path.basename(p)}:{l}\n".encode())
+    fingerprint = digest.hexdigest()
     meta_path = cache_path + ".meta.json"
     if os.path.exists(meta_path):
         with open(meta_path) as fh:
             meta = json.load(fh)
-        if meta.get("count") == len(paths) and meta.get("base") == base:
+        if (meta.get("count") == len(paths) and meta.get("base") == base
+                and meta.get("fingerprint") == fingerprint):
             return cache_path
     os.makedirs(os.path.dirname(os.path.abspath(cache_path)), exist_ok=True)
     arr = np.lib.format.open_memmap(
@@ -96,7 +106,7 @@ def build_decoded_cache(
     np.save(cache_path + ".labels.npy", np.asarray(labels, np.int32))
     with open(meta_path, "w") as fh:
         json.dump({"count": len(paths), "base": base,
-                   "image_size": image_size}, fh)
+                   "image_size": image_size, "fingerprint": fingerprint}, fh)
     return cache_path
 
 

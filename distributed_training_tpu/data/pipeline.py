@@ -311,6 +311,11 @@ def build_dataloaders(cfg, coordinator=None, *, seed: int = 0,
         elif data.dataset == "synthetic_cifar":
             tr = cifar10.synthetic_cifar10(4096, True, seed)
             ev = cifar10.synthetic_cifar10(1024, False, seed)
+        elif data.dataset == "synthetic_cifar_hard":
+            # Full-size splits: this is the convergence-run stand-in (Gabor
+            # textures, not separable by pixel statistics), not a smoke set.
+            tr = cifar10.synthetic_cifar10_hard(50_000, True, seed)
+            ev = cifar10.synthetic_cifar10_hard(10_000, False, seed)
         elif data.dataset == "synthetic_imagenet":
             tr = synthetic_imagenet(8192, data.image_size, data.num_classes, seed)
             ev = synthetic_imagenet(1024, data.image_size, data.num_classes, seed + 1)

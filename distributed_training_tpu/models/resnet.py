@@ -25,7 +25,9 @@ import functools
 from typing import Any, Callable, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 ModuleDef = Any
 
@@ -45,14 +47,19 @@ class BasicBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        # checkpoint_name marks conv outputs for the 'conv' remat policy
+        # (save convs, recompute BN/ReLU in backward); no-op otherwise.
+        y = checkpoint_name(
+            self.conv(self.filters, (3, 3), self.strides)(x), "conv_out")
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3))(y)
+        y = checkpoint_name(self.conv(self.filters, (3, 3))(y), "conv_out")
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
 
         if residual.shape != y.shape:
-            residual = self.conv(self.filters, (1, 1), self.strides, name="downsample_conv")(residual)
+            residual = checkpoint_name(
+                self.conv(self.filters, (1, 1), self.strides,
+                          name="downsample_conv")(residual), "conv_out")
             residual = self.norm(name="downsample_bn")(residual)
         return self.act(residual + y)
 
@@ -69,17 +76,21 @@ class BottleneckBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         residual = x
-        y = self.conv(self.filters, (1, 1))(x)
+        y = checkpoint_name(self.conv(self.filters, (1, 1))(x), "conv_out")
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = checkpoint_name(
+            self.conv(self.filters, (3, 3), self.strides)(y), "conv_out")
         y = self.norm()(y)
         y = self.act(y)
-        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = checkpoint_name(
+            self.conv(self.filters * 4, (1, 1))(y), "conv_out")
         y = self.norm(scale_init=nn.initializers.zeros_init())(y)
 
         if residual.shape != y.shape:
-            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="downsample_conv")(residual)
+            residual = checkpoint_name(
+                self.conv(self.filters * 4, (1, 1), self.strides,
+                          name="downsample_conv")(residual), "conv_out")
             residual = self.norm(name="downsample_bn")(residual)
         return self.act(residual + y)
 
@@ -111,6 +122,12 @@ class ResNet(nn.Module):
     # checkpointing): trades ~30% more FLOPs for O(depth) activation
     # memory — the jax.checkpoint lever from SURVEY.md's HBM notes.
     remat: bool = False
+    # remat_policy='conv': save only conv outputs per block and recompute
+    # the (cheap, elementwise) BN/ReLU chain in the backward — a memory-
+    # TRAFFIC lever, not just a capacity one: fewer residuals are written
+    # in forward and re-read in backward. None = save everything the
+    # autodiff wants (plain remat saves nothing but the block input).
+    remat_policy: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -145,7 +162,18 @@ class ResNet(nn.Module):
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
 
-        block_cls = nn.remat(self.block_cls) if self.remat else self.block_cls
+        if self.remat or self.remat_policy:
+            policy = None
+            if self.remat_policy == "conv":
+                policy = jax.checkpoint_policies.save_only_these_names(
+                    "conv_out")
+            elif self.remat_policy is not None:
+                raise ValueError(
+                    f"unknown remat_policy {self.remat_policy!r} "
+                    "(None | 'conv')")
+            block_cls = nn.remat(self.block_cls, policy=policy)
+        else:
+            block_cls = self.block_cls
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
@@ -171,6 +199,12 @@ class ResNet(nn.Module):
 
 
 STAGE_SIZES = {
+    # resnet_micro: a 4-stage/1-block, 8-filter ResNet (~12k params) with
+    # the full structural surface (stem, BN, downsample convs, residuals).
+    # It exists for the test suite: integration tests exercising WIRING
+    # (checkpoint/resume, preemption, metrics, CLI) compile in seconds on
+    # the virtual CPU mesh where resnet18's 11M params take minutes.
+    "resnet_micro": ((1, 1, 1, 1), BasicBlock),
     "resnet18": ((2, 2, 2, 2), BasicBlock),
     "resnet34": ((3, 4, 6, 3), BasicBlock),
     "resnet50": ((3, 4, 6, 3), BottleneckBlock),
@@ -181,4 +215,6 @@ STAGE_SIZES = {
 
 def make_resnet(name: str, **kwargs) -> ResNet:
     sizes, block = STAGE_SIZES[name]
+    if name == "resnet_micro":
+        kwargs.setdefault("num_filters", 8)
     return ResNet(stage_sizes=sizes, block_cls=block, **kwargs)

@@ -118,6 +118,7 @@ def add_argument() -> argparse.Namespace:
     # -- data / misc --------------------------------------------------------
     parser.add_argument("--dataset", type=str, default="cifar10",
                         choices=["cifar10", "synthetic_cifar",
+                                 "synthetic_cifar_hard",
                                  "synthetic_imagenet", "imagefolder"])
     parser.add_argument("--data-path", type=str, default=None,
                         help="dataset root (default: $DATA or ../data); "

@@ -16,7 +16,7 @@ from distributed_training_tpu.train.train_state import init_train_state
 
 @pytest.fixture()
 def state():
-    model = get_model("resnet18", num_classes=10, stem="cifar")
+    model = get_model("resnet_micro", num_classes=10, stem="cifar")
     tx = optax.adam(1e-3)
     return init_train_state(
         model, jax.random.PRNGKey(0), (2, 8, 8, 3), tx,

@@ -134,7 +134,7 @@ def test_uint8_batch_trains_end_to_end(tree, mesh):
     from distributed_training_tpu.train.step import make_train_step
     from distributed_training_tpu.train.train_state import init_train_state
 
-    model = get_model("resnet18", num_classes=2, stem="cifar")
+    model = get_model("resnet_micro", num_classes=2, stem="cifar")
     state = init_train_state(
         model, jax.random.PRNGKey(0), (1, 24, 24, 3), optax.sgd(0.1),
         loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))

@@ -28,7 +28,7 @@ def _make_state(axis_name=None, lr=1e-2):
     # and unsharded paths agree to reduction-order noise (~1e-6). Adam's
     # step-1 update is g/|g|-shaped and amplifies that noise to ~lr; the
     # Adam path is covered separately with an appropriate tolerance.
-    model = get_model("resnet18", num_classes=10, axis_name=axis_name,
+    model = get_model("resnet_micro", num_classes=10, axis_name=axis_name,
                       stem="cifar")
     tx = optax.sgd(lr, momentum=0.9)
     state = init_train_state(
@@ -126,7 +126,7 @@ def test_adam_dp_step_matches_single_device(mesh):
     first-step update is ±lr·(1-β1)/√(1-β2)-shaped, so sign flips on
     near-zero grads move params by O(lr). Tolerance reflects that bound,
     not a correctness gap: 4e-3 << 2·lr = 2e-2."""
-    model = get_model("resnet18", num_classes=10, stem="cifar")
+    model = get_model("resnet_micro", num_classes=10, stem="cifar")
     tx = optax.adam(1e-2)
     state = init_train_state(
         model, jax.random.PRNGKey(0), (8, 8, 8, 3), tx,
@@ -179,7 +179,7 @@ def test_trainer_local_bn_path(tmp_path):
     from distributed_training_tpu.config import CheckpointConfig, DataConfig
 
     cfg = TrainConfig.from_plugin("torch_ddp").replace(
-        model="resnet18", num_epochs=1, log_interval=4, sync_batchnorm=False,
+        model="resnet_micro", num_epochs=1, log_interval=4, sync_batchnorm=False,
         data=DataConfig(dataset="synthetic_cifar", batch_size=8,
                         max_steps_per_epoch=6),
         checkpoint=CheckpointConfig(directory=str(tmp_path), interval=0))

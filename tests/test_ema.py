@@ -66,7 +66,7 @@ class TestWithEma:
 
 class TestTrainStepIntegration:
     def _fit_state(self, mesh, ema_decay, dtype="fp32", zero_stage=0):
-        model = get_model("resnet18", num_classes=10, stem="cifar")
+        model = get_model("resnet_micro", num_classes=10, stem="cifar")
         tx = make_optimizer(OptimizerConfig(name="adam", ema_decay=ema_decay))
         state = init_train_state(
             model, jax.random.PRNGKey(0), (8, 8, 8, 3), tx,
@@ -101,7 +101,7 @@ class TestTrainStepIntegration:
         from distributed_training_tpu.train.trainer import Trainer
 
         cfg = TrainConfig(
-            model="resnet18", num_epochs=1, eval_every=1, log_interval=4,
+            model="resnet_micro", num_epochs=1, eval_every=1, log_interval=4,
             optimizer=OptimizerConfig(name="adam", lr=0.5, ema_decay=0.999),
             data=DataConfig(dataset="synthetic_cifar", batch_size=4,
                             max_steps_per_epoch=2, prefetch=0),
@@ -137,7 +137,7 @@ class TestTrainStepIntegration:
         from distributed_training_tpu.train.trainer import Trainer
 
         cfg = TrainConfig(
-            model="resnet18", num_epochs=1, eval_every=0, log_interval=4,
+            model="resnet_micro", num_epochs=1, eval_every=0, log_interval=4,
             optimizer=OptimizerConfig(name="adam", lr=0.5, ema_decay=0.9),
             data=DataConfig(dataset="synthetic_cifar", batch_size=4,
                             max_steps_per_epoch=2, prefetch=0),
@@ -161,7 +161,7 @@ class TestTrainStepIntegration:
             make_shard_map_train_step,
         )
 
-        model = get_model("resnet18", num_classes=10, stem="cifar")
+        model = get_model("resnet_micro", num_classes=10, stem="cifar")
         tx = make_optimizer(OptimizerConfig(name="adam", ema_decay=0.5))
         state = init_train_state(
             model, jax.random.PRNGKey(0), (8, 8, 8, 3), tx,
@@ -187,7 +187,7 @@ class TestTrainStepIntegration:
         """A rejected step must leave the EMA untouched."""
         from distributed_training_tpu.train.precision import LossScaleState
 
-        model = get_model("resnet18", num_classes=10, stem="cifar")
+        model = get_model("resnet_micro", num_classes=10, stem="cifar")
         tx = make_optimizer(OptimizerConfig(name="adam", ema_decay=0.5))
         state = init_train_state(
             model, jax.random.PRNGKey(0), (8, 8, 8, 3), tx,

@@ -95,18 +95,18 @@ class TestImageAccumEquivalence:
         batch = _image_batch(16)
         rng = jax.random.PRNGKey(3)
 
-        one_state = _image_state(mesh, model_name="resnet18", stem="cifar")
+        one_state = _image_state(mesh, model_name="resnet_micro", stem="cifar")
         one_step = make_train_step(mesh, donate=False)
         one_state, _ = one_step(one_state, batch, rng)
 
-        acc_state = _image_state(mesh, model_name="resnet18", stem="cifar")
+        acc_state = _image_state(mesh, model_name="resnet_micro", stem="cifar")
         acc_step = make_train_step(mesh, donate=False, grad_accum_steps=2)
         acc_state, m = acc_step(acc_state, batch, rng)
 
         assert np.isfinite(float(m["loss"]))
         # Stats updated (changed from init)...
         init_stats = jax.device_get(
-            _image_state(mesh, model_name="resnet18", stem="cifar").batch_stats)
+            _image_state(mesh, model_name="resnet_micro", stem="cifar").batch_stats)
         got = jax.device_get(acc_state.batch_stats)
         changed = jax.tree.leaves(
             jax.tree.map(lambda a, b: float(np.abs(a - b).max()), init_stats, got))
@@ -229,7 +229,7 @@ class TestConfigPlumbing:
         from distributed_training_tpu.train.trainer import Trainer
 
         cfg = TrainConfig(
-            model="resnet18",
+            model="resnet_micro",
             gradient_accumulation_steps=2,
             data=DataConfig(dataset="synthetic_cifar", batch_size=4),
         )
@@ -263,7 +263,7 @@ class TestConfigPlumbing:
         from distributed_training_tpu.train.trainer import Trainer
 
         cfg = TrainConfig(
-            model="resnet18",
+            model="resnet_micro",
             sync_batchnorm=False,
             gradient_accumulation_steps=2,
             data=DataConfig(dataset="synthetic_cifar", batch_size=4),

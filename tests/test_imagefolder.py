@@ -134,7 +134,7 @@ class TestTrainerWiring:
         make_tree(str(tmp_path / "train"), per_class=8)
         make_tree(str(tmp_path / "val"), per_class=2)
         cfg = TrainConfig(
-            model="resnet18",
+            model="resnet_micro",
             num_epochs=1,
             log_interval=1,
             eval_every=1,
@@ -158,7 +158,7 @@ class TestTrainerWiring:
         make_tree(str(tmp_path / "train"), per_class=2)
         make_tree(str(tmp_path / "val"), per_class=1)
         cfg = TrainConfig(
-            model="resnet18", num_epochs=1,
+            model="resnet_micro", num_epochs=1,
             data=DataConfig(dataset="imagefolder", data_path=str(tmp_path),
                             batch_size=1, image_size=16, num_classes=10),
         )

@@ -94,7 +94,7 @@ class TestPreemptionGuard:
 
 def _cfg(tmp_path, **kw):
     return TrainConfig(
-        model="resnet18",
+        model="resnet_micro",
         num_epochs=2,
         log_interval=2,
         eval_every=0,
@@ -164,7 +164,7 @@ class TestCheckpointNextEpoch:
         from distributed_training_tpu.train.precision import LossScaleState
         from distributed_training_tpu.train.train_state import init_train_state
 
-        model = get_model("resnet18", num_classes=10, stem="cifar")
+        model = get_model("resnet_micro", num_classes=10, stem="cifar")
         state = init_train_state(
             model, jax.random.PRNGKey(0), (1, 8, 8, 3), optax.adam(1e-3),
             loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
@@ -190,7 +190,7 @@ class TestCheckpointNextEpoch:
         from distributed_training_tpu.train.precision import LossScaleState
         from distributed_training_tpu.train.train_state import init_train_state
 
-        model = get_model("resnet18", num_classes=10, stem="cifar")
+        model = get_model("resnet_micro", num_classes=10, stem="cifar")
         state = init_train_state(
             model, jax.random.PRNGKey(0), (1, 8, 8, 3), optax.adam(1e-3),
             loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
@@ -211,7 +211,7 @@ class TestCheckpointNextEpoch:
         from distributed_training_tpu.train.precision import LossScaleState
         from distributed_training_tpu.train.train_state import init_train_state
 
-        model = get_model("resnet18", num_classes=10, stem="cifar")
+        model = get_model("resnet_micro", num_classes=10, stem="cifar")
         state = init_train_state(
             model, jax.random.PRNGKey(0), (1, 8, 8, 3), optax.adam(1e-3),
             loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))

@@ -40,7 +40,7 @@ class TestLabelSmoothing:
     def test_train_step_loss_reflects_smoothing(self, mesh):
         # ResNet's head has a non-zero init (ViT's is zero-init, making
         # initial logits uniform — where smoothed CE equals plain CE).
-        model = get_model("resnet18", num_classes=10, stem="cifar")
+        model = get_model("resnet_micro", num_classes=10, stem="cifar")
         rng_np = np.random.RandomState(0)
         batch = {
             "image": jnp.asarray(rng_np.rand(8, 16, 16, 3), jnp.float32),
@@ -68,7 +68,7 @@ class TestLabelSmoothing:
 
 class TestTop5Eval:
     def test_counts(self, mesh):
-        model = get_model("resnet18", num_classes=10, stem="cifar")
+        model = get_model("resnet_micro", num_classes=10, stem="cifar")
         state = init_train_state(
             model, jax.random.PRNGKey(0), (8, 8, 8, 3), optax.adam(1e-3),
             loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
@@ -100,7 +100,7 @@ class TestTop5Eval:
         from distributed_training_tpu.train.trainer import Trainer
 
         cfg = TrainConfig(
-            model="resnet18", num_epochs=1, eval_every=1, log_interval=4,
+            model="resnet_micro", num_epochs=1, eval_every=1, log_interval=4,
             label_smoothing=0.1,
             data=DataConfig(dataset="synthetic_cifar", batch_size=4,
                             max_steps_per_epoch=2, prefetch=0),

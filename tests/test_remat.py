@@ -25,7 +25,7 @@ VIT_KW = dict(hidden_size=32, num_layers=2, num_heads=2, mlp_dim=64,
 class TestEquivalence:
     @pytest.mark.parametrize("name,kw,shape", [
         ("vit_b16", VIT_KW, (2, 16, 16, 3)),
-        ("resnet18", dict(stem="cifar"), (2, 8, 8, 3)),
+        ("resnet_micro", dict(stem="cifar"), (2, 8, 8, 3)),
         ("transformer_lm", dict(num_layers=2, num_heads=2, hidden_dim=32,
                                 max_len=32), (2, 8)),
     ])
@@ -90,7 +90,7 @@ class TestTrainStepIntegration:
 
     def test_resnet_with_bn_remat_trains(self, mesh):
         """BatchNorm's mutable batch_stats must thread through nn.remat."""
-        model = get_model("resnet18", num_classes=10, stem="cifar", remat=True)
+        model = get_model("resnet_micro", num_classes=10, stem="cifar", remat=True)
         state = init_train_state(
             model, jax.random.PRNGKey(0), (8, 8, 8, 3), optax.adam(1e-3),
             loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))

