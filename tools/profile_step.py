@@ -2,16 +2,21 @@
 
 Round-2 evidence tooling (VERDICT r1 #1: "capture a per-op profile of the
 R50 step into the repo"). Runs the same jitted step bench.py measures under
-``jax.profiler.trace``, converts the xplane protobuf with
-tensorboard-plugin-profile's converter, and writes a compact JSON artifact
-(top ops by self time, with occurrences/category) plus the XLA
-``cost_analysis`` aggregate (FLOPs / bytes accessed) — the inputs to the
-roofline table in BASELINE.md.
+``jax.profiler.trace`` and parses the xplane protobuf DIRECTLY
+(``tensorflow.tsl...xplane_pb2`` — the tensorboard-plugin-profile converter
+is broken in this image) into a compact committed JSON artifact:
+
+- per-HLO-category totals: self time, FLOPs, bytes accessed → achieved
+  TFLOP/s and GB/s against the device's own advertised peaks (the numbers
+  the roofline table in BASELINE.md cites);
+- top-N individual fusions by total device time.
 
 Usage (one TPU client at a time — the tunnel serves one):
+    PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python \
     python tools/profile_step.py --model resnet50 --batch-size 256 \
         --out profiles/r50_b256
     python tools/profile_step.py --lm --seq-len 1024 --out profiles/gpt_t1024
+    python tools/profile_step.py --summarize profiles/r50_b256.json
 """
 
 from __future__ import annotations
@@ -23,6 +28,80 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The pure-python protobuf fallback is required for the prebuilt tsl protos.
+os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+
+def parse_xplane(path: str, top: int) -> dict:
+    """Aggregate the TPU plane of one xplane.pb into category/op tables."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as fh:
+        xs.ParseFromString(fh.read())
+    tpu = next((p for p in xs.planes if p.name.startswith("/device:TPU")),
+               None)
+    if tpu is None:
+        return {"error": f"no TPU plane in {path}"}
+    stat_names = {k: v.name for k, v in tpu.stat_metadata.items()}
+
+    def stats_of(msg):
+        out = {}
+        for st in msg.stats:
+            name = stat_names.get(st.metadata_id, str(st.metadata_id))
+            out[name] = (st.double_value or st.uint64_value or st.int64_value
+                         or st.str_value)
+        return out
+
+    device = stats_of(tpu)
+
+    steps_line = next((l for l in tpu.lines if l.name == "Steps"), None)
+    num_steps = len(steps_line.events) if steps_line else 0
+    step_ps = (sum(e.duration_ps for e in steps_line.events)
+               if steps_line else 0)
+
+    ops_line = next((l for l in tpu.lines if l.name == "XLA Ops"), None)
+    cats: dict[str, dict] = {}
+    ops: dict[str, dict] = {}
+    total_ps = 0
+    for ev in ops_line.events if ops_line else ():
+        md = tpu.event_metadata[ev.metadata_id]
+        ms = stats_of(md)
+        cat = ms.get("hlo_category", "?")
+        dur = ev.duration_ps
+        total_ps += dur
+        flops = int(ms.get("flops", 0) or 0)
+        bytes_acc = int(ms.get("bytes_accessed", 0) or 0)
+        c = cats.setdefault(cat, {"time_ps": 0, "flops": 0, "bytes": 0,
+                                  "occurrences": 0})
+        c["time_ps"] += dur
+        c["flops"] += flops
+        c["bytes"] += bytes_acc
+        c["occurrences"] += 1
+        o = ops.setdefault(md.display_name, {
+            "category": cat, "time_ps": 0, "flops": 0, "bytes": 0,
+            "occurrences": 0, "source_op": ms.get("tf_op", "")})
+        o["time_ps"] += dur
+        o["flops"] += flops
+        o["bytes"] += bytes_acc
+        o["occurrences"] += 1
+
+    top_ops = sorted(ops.items(), key=lambda kv: -kv[1]["time_ps"])[:top]
+    return {
+        "device": {
+            "type": device.get("device_type_string"),
+            "peak_tflops": device.get("peak_teraflops_per_second"),
+            "peak_hbm_gbps": device.get("peak_hbm_bw_gigabytes_per_second"),
+        },
+        "num_steps": num_steps,
+        "step_time_ms": step_ps / num_steps / 1e9 if num_steps else None,
+        "op_time_ms_per_step": (total_ps / num_steps / 1e9
+                                if num_steps else None),
+        "categories": dict(sorted(cats.items(),
+                                  key=lambda kv: -kv[1]["time_ps"])),
+        "top_ops": [{"name": k, **v} for k, v in top_ops],
+    }
 
 
 def capture(args) -> None:
@@ -70,7 +149,8 @@ def capture(args) -> None:
     else:
         mesh, state, step = bench.build(
             args.model, args.batch_size, args.image_size, args.num_classes,
-            zero_stage=args.zero_stage, remat=args.remat)
+            zero_stage=args.zero_stage, remat=args.remat,
+            remat_policy=args.remat_policy, param_dtype=args.param_dtype)
         rng = np.random.RandomState(0)
         batch = {
             "image": jnp.asarray(
@@ -93,22 +173,10 @@ def capture(args) -> None:
         float(metrics["loss"])
 
     artifact = {"label": label, "trace_steps": args.trace_steps}
-
-    xplanes = glob.glob(
-        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True)
+    xplanes = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
     if xplanes:
-        from tensorboard_plugin_profile.convert import raw_to_tool_data
-
-        data, _ = raw_to_tool_data.xspace_to_tool_data(
-            [xplanes[0]], "op_profile", {})
-        op_profile = json.loads(data)
-        artifact["op_profile"] = _trim_op_profile(op_profile)
-        try:
-            data, _ = raw_to_tool_data.xspace_to_tool_data(
-                [xplanes[0]], "overview_page", {})
-            artifact["overview"] = json.loads(data)
-        except Exception as e:  # overview is best-effort
-            artifact["overview_error"] = str(e)
+        artifact.update(parse_xplane(xplanes[-1], args.top))
     else:
         artifact["error"] = f"no xplane.pb under {trace_dir}"
 
@@ -121,48 +189,40 @@ def capture(args) -> None:
     summarize(args.out + ".json", args.top)
 
 
-def _trim_op_profile(op_profile: dict) -> dict:
-    """Keep only the byCategory grouping (the raw tool dump repeats the
-    whole program once per grouping; one tree carries all the metrics)."""
-    return op_profile.get("byCategory", op_profile)
-
-
 def summarize(path: str, top: int) -> None:
-    """Print a top-op table from a saved artifact (markdown-ish)."""
+    """Print category roofline + top-op tables from a saved artifact."""
     with open(path) as fh:
-        artifact = json.load(fh)
-    prof = artifact.get("op_profile")
-    if not prof:
-        print("no op_profile in artifact")
+        a = json.load(fh)
+    if "categories" not in a:
+        print(f"no parsed profile in {path}: {a.get('error')}")
         return
-
-    rows = []
-
-    def walk(node, category=""):
-        metrics = node.get("metrics") or {}
-        children = node.get("children") or []
-        xla = node.get("xla")
-        if xla and metrics.get("selfTimePs", 0) > 0:
-            rows.append({
-                "op": node.get("name", "?"),
-                "category": xla.get("category", category),
-                "self_time_frac": metrics.get("time", 0.0),
-                "flops_util": metrics.get("flops", 0.0),
-                "bytes_frac": metrics.get("memoryBandwidth", 0.0),
-                "occurrences": xla.get("occurrences", 0),
-            })
-        for c in children:
-            walk(c, node.get("name", category))
-
-    walk(prof)
-    rows.sort(key=lambda r: -r["self_time_frac"])
-    print(f"\ntop {top} ops by self time — {artifact['label']}:")
-    print("| op | category | time% | flops-util | occurrences |")
+    n = a["num_steps"] or 1
+    step_ms = a.get("step_time_ms")
+    busy_ms = a.get("op_time_ms_per_step")
+    fmt = lambda v: f"{v:.2f} ms" if v is not None else "n/a"
+    print(f"\n{a['label']}: {a['num_steps']} steps traced, "
+          f"step {fmt(step_ms)} "
+          f"(XLA-op busy {fmt(busy_ms)}); device "
+          f"{a['device']['type']} peaks {a['device']['peak_tflops']} TFLOP/s"
+          f" / {a['device']['peak_hbm_gbps']} GB/s HBM")
+    print("\n| category | ms/step | % | TFLOP/s | GB/s (bytes-accessed) |")
     print("|---|---|---|---|---|")
-    for r in rows[:top]:
-        print(f"| {r['op'][:60]} | {r['category']} "
-              f"| {100 * r['self_time_frac']:.1f} "
-              f"| {100 * r['flops_util']:.1f} | {r['occurrences']} |")
+    total = sum(c["time_ps"] for c in a["categories"].values())
+    for cat, c in a["categories"].items():
+        secs = max(c["time_ps"], 1) / 1e12
+        ms = c["time_ps"] / n / 1e9
+        print(f"| {cat} | {ms:.2f} | {100 * c['time_ps'] / total:.1f} "
+              f"| {c['flops'] / secs / 1e12:.1f} "
+              f"| {c['bytes'] / secs / 1e9:.0f} |")
+    print(f"\ntop {top} fusions by device time:")
+    print("| fusion | category | ms/step | TFLOP/s | GB/s | n |")
+    print("|---|---|---|---|---|---|")
+    for o in a["top_ops"][:top]:
+        secs = max(o["time_ps"], 1) / 1e12
+        print(f"| {o['name'][:46]} | {o['category']} "
+              f"| {o['time_ps'] / n / 1e9:.2f} "
+              f"| {o['flops'] / secs / 1e12:.1f} "
+              f"| {o['bytes'] / secs / 1e9:.0f} | {o['occurrences']} |")
 
 
 def main():
@@ -173,6 +233,9 @@ def main():
     ap.add_argument("--num-classes", type=int, default=1000)
     ap.add_argument("--zero-stage", type=int, default=0)
     ap.add_argument("--remat", action="store_true", default=False)
+    ap.add_argument("--remat-policy", default=None, choices=[None, "conv"])
+    ap.add_argument("--param-dtype", default="fp32",
+                    choices=["fp32", "bf16"])
     ap.add_argument("--lm", action="store_true", default=False,
                     help="profile the GPT-2-small LM step instead")
     ap.add_argument("--seq-len", type=int, default=1024)
@@ -185,7 +248,7 @@ def main():
                     help="artifact prefix (writes <out>.json + <out>_trace/); "
                          "required unless --summarize")
     ap.add_argument("--summarize", default=None,
-                    help="just print the table from an existing artifact")
+                    help="just print the tables from an existing artifact")
     args = ap.parse_args()
     if args.summarize:
         summarize(args.summarize, args.top)
