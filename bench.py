@@ -118,7 +118,9 @@ def bench_data_only(args) -> None:
     import shutil
     import tempfile
 
-    DEVICE_RATE = 2400.0  # measured R50 img/s/chip, BASELINE.md round 1
+    DEVICE_RATE = 2580.0  # measured R50 img/s/chip, BASELINE.md round 2
+    batch = args.data_batch_size  # decoupled from the device bench's
+    # effective-batch default so host numbers stay comparable across rounds
 
     if args.data_path:
         if not os.path.isdir(args.data_path):
@@ -165,7 +167,7 @@ def bench_data_only(args) -> None:
             paths, labels, _ = scan_imagefolder(os.path.join(root, "train"))
             if args.data_mode != "cached":
                 folder_rate = timed_epoch(ImageFolderLoader(
-                    paths, labels, global_batch_size=args.batch_size,
+                    paths, labels, global_batch_size=batch,
                     image_size=args.image_size, augment="pad_crop_flip",
                     train=True, num_workers=args.data_workers,
                     process_index=0, process_count=1))
@@ -183,7 +185,7 @@ def bench_data_only(args) -> None:
                     num_workers=args.data_workers)
                 build_s = time.perf_counter() - t0
                 cached_rate = timed_epoch(DecodedCacheLoader(
-                    cache, global_batch_size=args.batch_size,
+                    cache, global_batch_size=batch,
                     augment="pad_crop_flip", train=True,
                     process_index=0, process_count=1))
                 print(json.dumps({
@@ -199,7 +201,7 @@ def bench_data_only(args) -> None:
             images = rng.rand(4096, 32, 32, 3).astype(np.float32)
             labels = rng.randint(0, 10, 4096).astype(np.int32)
             augment_rate = timed_epoch(ShardedDataLoader(
-                images, labels, global_batch_size=args.batch_size,
+                images, labels, global_batch_size=batch,
                 augment="pad_crop_flip", train=True,
                 process_index=0, process_count=1))
     finally:
@@ -220,7 +222,7 @@ def bench_data_only(args) -> None:
     print(json.dumps({
         "metric": f"host input pipeline ({args.data_mode}; {os.cpu_count()} "
                   f"core(s), {args.data_workers} threads, batch "
-                  f"{args.batch_size})",
+                  f"{batch})",
         "value": round(primary, 2),
         "unit": "images/sec (host)",
         "vs_baseline": round(primary / DEVICE_RATE, 4),
@@ -280,6 +282,9 @@ def main():
     ap.add_argument("--data-images", type=int, default=2048,
                     help="synthetic-tree size for --data-only")
     ap.add_argument("--data-workers", type=int, default=os.cpu_count() or 8)
+    ap.add_argument("--data-batch-size", type=int, default=256,
+                    help="--data-only loader batch (kept at the round-1 "
+                         "value so host numbers stay comparable)")
     args = ap.parse_args()
 
     if args.data_only:
@@ -341,6 +346,12 @@ def main():
             return state, {"loss": losses[-1]}
 
         step = multi
+        if args.warmup == 0 or args.steps < steps_per_call:
+            print(f"bench: steps-per-call={steps_per_call} rounds "
+                  f"warmup {args.warmup}->{max(1, args.warmup // steps_per_call) * steps_per_call} "
+                  f"and steps {args.steps}->{max(1, args.steps // steps_per_call) * steps_per_call} "
+                  f"(one priming call always runs; pass --steps-per-call 1 "
+                  f"for exact counts)", file=sys.stderr)
         args.steps = max(1, args.steps // steps_per_call)
         args.warmup = max(1, args.warmup // steps_per_call)
 

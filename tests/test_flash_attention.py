@@ -50,7 +50,10 @@ def test_flash_gradients_match_exact(causal):
     ref = jax.grad(loss(lambda q, k, v: exact_attention(q, k, v, causal)),
                    argnums=(0, 1, 2))(q, k, v)
     got = jax.grad(loss(lambda q, k, v: flash_attention(
-        q, k, v, causal=causal, block_q=64, block_k=64)),
+        q, k, v, causal=causal, block_q=64, block_k=64,
+        # Explicit bwd blocks: keep the dq/dkv kernels multi-block at this
+        # T so the cross-block accumulation + causal skip stay covered.
+        bwd_block_q=64, bwd_block_k=64)),
         argnums=(0, 1, 2))(q, k, v)
     for name, a, b in zip("qkv", ref, got):
         np.testing.assert_allclose(
