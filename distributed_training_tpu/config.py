@@ -258,6 +258,13 @@ class TrainConfig:
     log_interval: int = 100    # steps between host-side loss fetches
     target_acc: float | None = None  # colossal_train.py:43-46, wired here
     eval_every: int = 1        # epochs between eval passes
+    # Precise-BN: refresh BatchNorm running statistics with N train-mode
+    # forwards (current params, no optimizer) right before each eval. The
+    # running-stat EMA (momentum 0.9) lags the parameters it normalizes
+    # for; when params move fast (high LR, loss-scale skip bursts) the
+    # stale stats can cost tens of accuracy points at eval even though
+    # train-mode accuracy is fine. 0 = off (raw EMA stats, torch parity).
+    eval_precise_bn_batches: int = 0
     sync_batchnorm: bool = True
     optimizer: OptimizerConfig = dataclasses.field(default_factory=OptimizerConfig)
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)

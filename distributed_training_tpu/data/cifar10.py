@@ -75,11 +75,19 @@ def synthetic_cifar10_hard(n: int, train: bool, seed: int = 0):
     rng = np.random.RandomState(seed + (0 if train else 1))
     labels = rng.randint(0, NUM_CLASSES, size=n).astype(np.int32)
     yy, xx = np.mgrid[0:32, 0:32] / 32.0
-    angles = np.pi * (np.arange(NUM_CLASSES) % 5) / 5.0
-    freqs = np.where(np.arange(NUM_CLASSES) < 5, 5.0, 9.0)
+    # Classes must be CLOSED under horizontal flip (the train augment):
+    # flip maps orientation θ → π−θ, so oblique angles would alias class
+    # pairs and cap accuracy. 5 frequencies × {0°, 90°} are both
+    # flip-invariant (phase is random per example anyway).
+    angles = np.where(np.arange(NUM_CLASSES) % 2 == 0, 0.0, np.pi / 2)
+    freqs = 3.0 + 2.0 * (np.arange(NUM_CLASSES) // 2)
     phase = rng.rand(n) * 2 * np.pi
     cx = rng.rand(n) * 0.5 + 0.25
     cy = rng.rand(n) * 0.5 + 0.25
+    # Random amplitude keeps signal-to-noise per example variable: weak
+    # examples are genuinely ambiguous, so 5-epoch accuracy lands in a
+    # discriminative band instead of saturating.
+    amp = rng.rand(n) * 0.35 + 0.22
     images = np.empty((n, *IMAGE_SHAPE), np.uint8)
     tint = np.array([1.0, 0.85, 0.7])  # fixed channel weighting, class-free
     for c in range(NUM_CLASSES):
@@ -90,9 +98,10 @@ def synthetic_cifar10_hard(n: int, train: bool, seed: int = 0):
         dy = yy[None] - cy[idx, None, None]
         t = np.cos(angles[c]) * dx + np.sin(angles[c]) * dy
         wave = np.sin(2 * np.pi * freqs[c] * t + phase[idx, None, None])
-        env = np.exp(-(dx ** 2 + dy ** 2) / 0.06)
+        env = np.exp(-(dx ** 2 + dy ** 2) / 0.05)
         pat = (wave * env)[..., None] * tint
-        noisy = pat * 0.5 + rng.randn(len(idx), *IMAGE_SHAPE) * 0.18
+        noisy = (pat * amp[idx, None, None, None]
+                 + rng.randn(len(idx), *IMAGE_SHAPE) * 0.24)
         images[idx] = np.clip((noisy * 0.5 + 0.5) * 255, 0, 255).astype(
             np.uint8)
     return images, labels

@@ -160,9 +160,21 @@ def commit_gradients(state, grads, new_batch_stats=None):
     Returns ``(new_state, grads_finite)``.
     """
     if state.loss_scale.dynamic:
-        finite = all_finite(grads)
         candidate = _with_ema_batch_stats(
             state.apply_gradients(grads), new_batch_stats)
+        # Guard the UPDATE, not just the gradients: a finite-but-huge
+        # unscaled grad (|g| > ~1.8e19, possible once the scale sits at its
+        # floor under real divergence) passes an all_finite(grads) check
+        # and then overflows inside the optimizer (e.g. Adam's g² > fp32
+        # max → v = inf), committing a non-finite value PERMANENTLY —
+        # a NaN param kills the model; an inf moment silently freezes its
+        # weight (β·inf stays inf, updates become 0 forever). Checking the
+        # candidate params AND optimizer state catches any update-path
+        # overflow; the skip machinery then handles it like an overflowed
+        # gradient (observed in the wild: round-2 fp16 convergence run,
+        # one NaN in conv_init/kernel with loss_scale at 1.0).
+        finite = (all_finite(grads) & all_finite(candidate.params)
+                  & all_finite(candidate.opt_state))
         new_state = select_tree(
             finite,
             candidate.replace(loss_scale=state.loss_scale.update(finite)),

@@ -129,10 +129,11 @@ class Trainer:
             cfg, int(self.mesh.devices.size), allow_derive=uses_gspmd_step)
         # uint8 batches (decoded-cache loader) defer ToTensor/Normalize to
         # the device, fused into the first conv; the affine encodes the
-        # augment mode's normalization. Float batches ignore it.
-        input_affine = ((2.0 / 255.0, -1.0)
-                        if cfg.data.augment == "normalize_only"
-                        else (1.0 / 255.0, 0.0))
+        # augment mode's normalization. Float batches ignore it. Kept on
+        # self so the precise-BN refresh normalizes identically.
+        input_affine = self._input_affine = (
+            (2.0 / 255.0, -1.0) if cfg.data.augment == "normalize_only"
+            else (1.0 / 255.0, 0.0))
         if uses_gspmd_step:
             self.train_step = make_train_step(
                 self.mesh, zero_stage=cfg.zero.stage,
@@ -159,6 +160,7 @@ class Trainer:
             cfg.tensorboard_dir, cfg.metrics_jsonl,
             enabled=self.coord.is_master())
         self._guard: PreemptionGuard | None = None
+        self._stats_refresh = None
         self._global_step = 0
         self._epoch_step = 0
         self.coord.print(
@@ -260,9 +262,50 @@ class Trainer:
             return state
         return self.state
 
-    def evaluate(self, loader) -> float:
+    def _refresh_batch_stats(self, train_loader, num_batches: int) -> None:
+        """Precise-BN: re-estimate running stats with the CURRENT params
+        (train-mode forwards, no optimizer) so eval normalizes with
+        statistics that match the weights it is evaluating — the EMA lags
+        by design and goes stale whenever params move fast."""
+        import itertools
+
+        if self._stats_refresh is None:
+            from distributed_training_tpu.train.step import _input_images
+
+            affine = self._input_affine  # the step's input normalization
+
+            def refresh(state, batch):
+                rngs = {"dropout": jax.random.PRNGKey(0),
+                        "gate": jax.random.PRNGKey(1)}
+                _, mut = state.apply_fn(
+                    {"params": state.params,
+                     "batch_stats": state.batch_stats},
+                    _input_images(batch, affine), train=True,
+                    mutable=["batch_stats", "aux_loss"], rngs=rngs)
+                return state.replace(
+                    batch_stats=dict(mut).get("batch_stats",
+                                              state.batch_stats))
+
+            self._stats_refresh = jax.jit(refresh, donate_argnums=(0,))
+
+        head = itertools.islice(iter(train_loader), num_batches)
+        for gbatch in self._batches(head):
+            self.state = self._stats_refresh(self.state, gbatch)
+
+    def evaluate(self, loader, train_loader=None) -> float:
         """Top-1 accuracy (the ``target_acc`` metric); top-5 is kept on
         ``self.last_eval`` and written to the metric sinks."""
+        k = self.cfg.eval_precise_bn_batches
+        uses_ema_stats = (
+            self.cfg.optimizer.ema_decay is not None
+            and self.cfg.eval_with_ema)
+        # Refresh only when eval will actually read self.state.batch_stats:
+        # BN-free models have nothing to refresh, and the EMA-eval path
+        # replaces the stats with the EMA copy (refreshing raw stats there
+        # would be paid-for compute that eval never sees).
+        if (k and train_loader is not None and not uses_ema_stats
+                and jax.tree.leaves(self.state.batch_stats)):
+            self._refresh_batch_stats(train_loader, k)
         eval_state = self._eval_state()
         correct = correct5 = total = 0.0
         for gbatch in self._batches(loader):
@@ -333,7 +376,7 @@ class Trainer:
                             f"(resumes at epoch {next_ep} step {estep})")
                     break
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                    final_acc = self.evaluate(eval_loader)
+                    final_acc = self.evaluate(eval_loader, train_loader)
                     last_eval_epoch = epoch + 1
                     self.coord.print(
                         f"[eval] epoch {epoch + 1}: top-1 {final_acc:.4f}")
@@ -355,7 +398,7 @@ class Trainer:
         # the gate judges the *final* model, not a stale accuracy.
         if cfg.target_acc is not None:
             if final_acc is None or last_eval_epoch != cfg.num_epochs:
-                final_acc = self.evaluate(eval_loader)
+                final_acc = self.evaluate(eval_loader, train_loader)
             if final_acc < cfg.target_acc:
                 raise RuntimeError(
                     f"target accuracy {cfg.target_acc} not reached "

@@ -46,6 +46,10 @@ def add_argument() -> argparse.Namespace:
                         help="checkpoint directory")
     parser.add_argument("-i", "--interval", type=int, default=5,
                         help="interval of saving checkpoint (epochs)")
+    parser.add_argument("--precise-bn-batches", type=int, default=0,
+                        help="refresh BatchNorm running stats with N "
+                             "train-mode forwards before each eval (the EMA "
+                             "stats lag fast-moving params; 0 = raw stats)")
     parser.add_argument("--target_acc", type=float, default=None,
                         help="target accuracy; raise if not reached")
     parser.add_argument("--local-rank", "--local_rank", type=int, default=-1,
@@ -248,6 +252,7 @@ def build_config(args: argparse.Namespace):
         seed=args.seed,
         log_interval=args.log_interval,
         target_acc=args.target_acc,
+        eval_precise_bn_batches=args.precise_bn_batches,
         wall_clock_breakdown=args.wall_clock_breakdown,
         profile_dir=args.profile_dir,
         tensorboard_dir=args.tensorboard_dir,

@@ -128,3 +128,46 @@ def test_scale_loss_unscale_grads_roundtrip():
     grads = {"w": jnp.full(4, 2.0 ** 15)}
     un = s.unscale_grads(grads)
     np.testing.assert_allclose(np.asarray(un["w"]), np.ones(4))
+
+
+def test_commit_guards_optimizer_internal_overflow():
+    """Finite gradients whose OPTIMIZER update overflows must skip: the
+    grad finiteness check alone cannot see an overflow that happens inside
+    the transform (observed in the round-2 fp16 convergence run: a NaN
+    committed into conv_init/kernel with the loss scale at its floor).
+    The guard checks the candidate params, catching any update-path
+    overflow regardless of mechanism."""
+    import optax
+
+    from distributed_training_tpu.train.precision import commit_gradients
+    from distributed_training_tpu.train.train_state import TrainState
+
+    def overflowing_update(updates, state, params=None):
+        # Stand-in for any optimizer-internal overflow (g², trust ratios,
+        # schedule math...): finite input, non-finite update.
+        return jax.tree.map(lambda g: g * jnp.inf, updates), state
+
+    tx = optax.GradientTransformation(optax.adam(1e-3).init,
+                                      overflowing_update)
+    state = TrainState.create(
+        apply_fn=lambda *a, **k: None,
+        params={"w": jnp.ones(4)},
+        tx=tx,
+        loss_scale=LossScaleState.create(_cfg()),  # dynamic fp16 scaler
+    )
+    finite_grads = {"w": jnp.full(4, 0.5, jnp.float32)}
+    new_state, finite = commit_gradients(state, finite_grads)
+    assert not bool(finite)  # grads were finite; the UPDATE was not
+    np.testing.assert_array_equal(np.asarray(new_state.params["w"]),
+                                  np.ones(4))  # params untouched
+    assert int(new_state.step) == 0
+
+    # The same grads through a sane optimizer still commit.
+    ok_state = TrainState.create(
+        apply_fn=lambda *a, **k: None,
+        params={"w": jnp.ones(4)},
+        tx=optax.adam(1e-3),
+        loss_scale=LossScaleState.create(_cfg()),
+    )
+    new_state, finite = commit_gradients(ok_state, finite_grads)
+    assert bool(finite) and int(new_state.step) == 1
