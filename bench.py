@@ -230,6 +230,70 @@ def bench_data_only(args) -> None:
     }))
 
 
+def bench_lm(args) -> None:
+    """GPT-2-small train throughput in tokens/sec (BASELINE.md LM rows).
+
+    Same methodology as the image bench: steady-state jitted step on
+    device-resident batches, host-fetch barrier every sync interval.
+    """
+    from distributed_training_tpu.config import PrecisionConfig
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+    from distributed_training_tpu.train.lm_step import (
+        make_lm_batch,
+        make_tp_lm_train_step,
+    )
+    from distributed_training_tpu.train.precision import LossScaleState
+    from distributed_training_tpu.train.train_state import init_train_state
+
+    platform = ensure_live_backend()
+    if platform == "cpu":
+        args.lm_batch = min(args.lm_batch, 2)
+        args.seq_len = min(args.seq_len, 256)
+        args.steps = min(args.steps, 4)
+        args.warmup = min(args.warmup, 2)
+
+    mesh = create_mesh(MeshConfig(data=-1))
+    model = get_model(
+        "transformer_lm", num_classes=50304, dtype=jnp.bfloat16,
+        num_layers=12, num_heads=12, hidden_dim=768,
+        max_len=args.seq_len, attn_impl=args.attn_impl)
+    tx = optax.adamw(3e-4)
+    state = init_train_state(
+        model, jax.random.PRNGKey(0), (1, 8), tx,
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="bf16")),
+        input_dtype=jnp.int32)
+    step = make_tp_lm_train_step(mesh, model=model, donate=True,
+                                 ce_chunk=args.ce_chunk)
+    toks = np.random.RandomState(0).randint(
+        0, 50304, (args.lm_batch, args.seq_len + 1)).astype(np.int32)
+    batch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in make_lm_batch(toks).items()},
+        step.batch_shardings)
+    key = jax.random.PRNGKey(0)
+    for _ in range(args.warmup):
+        state, m = step(state, batch, key)
+    if args.warmup:
+        float(m["loss"])
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, m = step(state, batch, key)
+        if args.sync_interval > 0 and (i + 1) % args.sync_interval == 0:
+            float(m["loss"])
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    tok_s = args.lm_batch * args.seq_len * args.steps / dt
+    print(json.dumps({
+        "metric": f"GPT-2-small train throughput (bf16 AdamW, B"
+                  f"{args.lm_batch} T{args.seq_len} {args.attn_impl}"
+                  f"{', chunked CE' if args.ce_chunk else ''}, "
+                  f"{jax.device_count()} {platform} chip(s))",
+        "value": round(tok_s, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tok_s / 94_600, 4),  # round-1 T1024 number
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50")
@@ -285,10 +349,21 @@ def main():
     ap.add_argument("--data-batch-size", type=int, default=256,
                     help="--data-only loader batch (kept at the round-1 "
                          "value so host numbers stay comparable)")
+    ap.add_argument("--lm", action="store_true", default=False,
+                    help="bench the GPT-2-small LM step (tokens/sec) "
+                         "instead of the image step")
+    ap.add_argument("--lm-batch", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--attn-impl", default="flash",
+                    choices=["flash", "exact"])
+    ap.add_argument("--ce-chunk", type=int, default=None)
     args = ap.parse_args()
 
     if args.data_only:
         bench_data_only(args)
+        return
+    if args.lm:
+        bench_lm(args)
         return
 
     platform = ensure_live_backend()

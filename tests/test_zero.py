@@ -26,7 +26,7 @@ from distributed_training_tpu.train.train_state import init_train_state
 def _make_state(opt="sgd"):
     # SGD+momentum for strict 1e-5 equivalence (linear in grads — see
     # test_dp_equivalence for why Adam needs a looser bound).
-    model = get_model("resnet18", num_classes=10, stem="cifar")
+    model = get_model("resnet_micro", num_classes=10, stem="cifar")
     if opt == "adam":
         tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-2))
     else:
