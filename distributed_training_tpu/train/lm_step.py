@@ -142,7 +142,8 @@ def _lm_metrics(new_state: TrainState, ce, aux, accuracy, finite,
     }
 
 
-def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None):
+def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None,
+                  accum: int = 1):
     tokens = batch["tokens"]
     targets = batch["targets"]
     t_local = tokens.shape[1]
@@ -152,9 +153,29 @@ def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None):
     shard_rng = jax.random.fold_in(
         rng, seq_idx * lax.axis_size(AXIS_DATA) + lax.axis_index(AXIS_DATA))
 
-    grads, ce, aux, accuracy = _lm_loss_and_grads(
-        state, tokens, targets, shard_rng, positions=positions,
-        ce_chunk=ce_chunk)
+    if accum > 1:
+        # Long-context accumulation: the local batch dim is the EFFECTIVE
+        # micro×accum slice; scan fwd/bwd over microbatches inside the
+        # shard_map body (the shared accumulate_grads scan, shard-locally
+        # with mesh=None), average, then one collective + one update.
+        # Equal-sized microbatches ⇒ mean of micro-means is the full mean.
+        from distributed_training_tpu.train.step import accumulate_grads
+
+        def micro_fn(params, mbatch, r, carry):
+            g, ce, aux, acc = _lm_loss_and_grads(
+                state.replace(params=params), mbatch["tokens"],
+                mbatch["targets"], r, positions=positions,
+                ce_chunk=ce_chunk)
+            return g, carry, (ce, aux, acc)
+
+        grads, _, (ces, auxs, accs) = accumulate_grads(
+            state.params, {"tokens": tokens, "targets": targets},
+            shard_rng, accum, None, micro_fn, init_carry=jnp.zeros(()))
+        ce, aux, accuracy = ces.mean(), auxs.mean(), accs.mean()
+    else:
+        grads, ce, aux, accuracy = _lm_loss_and_grads(
+            state, tokens, targets, shard_rng, positions=positions,
+            ce_chunk=ce_chunk)
     grads = lax.pmean(grads, _GRAD_AXES)
     grads = state.loss_scale.unscale_grads(grads)
 
@@ -166,6 +187,7 @@ def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None):
 def make_lm_train_step(
     mesh: Mesh, *, model=None, max_len: int | None = None,
     donate: bool = True, ce_chunk: int | None = None,
+    grad_accum_steps: int = 1,
 ) -> Callable:
     """Build the (data × sequence)-parallel jitted LM train step.
 
@@ -204,10 +226,15 @@ def make_lm_train_step(
     axis_names = ((AXIS_DATA, AXIS_SEQUENCE)
                   if shape.get("model", 1) > 1 else None)
 
+    if grad_accum_steps < 1:
+        raise ValueError(
+            f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def jitted(state: TrainState, batch, rng):
         sharded = shard_map(
-            functools.partial(_lm_step_body, ce_chunk=ce_chunk), mesh,
+            functools.partial(_lm_step_body, ce_chunk=ce_chunk,
+                              accum=grad_accum_steps), mesh,
             in_specs=(jax.tree.map(lambda _: P(), state), batch_spec, P()),
             out_specs=(jax.tree.map(lambda _: P(), state), P()),
             axis_names=axis_names,

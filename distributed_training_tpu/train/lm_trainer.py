@@ -199,14 +199,15 @@ class LMTrainer:
             **moe_kwargs,
         )
         self.world_size = data_axis_size(self.mesh)
+        accum_ok = self.strategy in ("tensor/dp", "sequence")
         self.train_gbs, self.eval_gbs, self.grad_accum = effective_batch_sizes(
-            cfg, self.world_size,
-            allow_derive=self.strategy == "tensor/dp")
-        if self.grad_accum > 1 and self.strategy != "tensor/dp":
+            cfg, self.world_size, allow_derive=accum_ok)
+        if self.grad_accum > 1 and not accum_ok:
             raise NotImplementedError(
-                "gradient accumulation composes with the tensor/dp strategy "
-                f"only (the {self.strategy} step has its own microbatching "
-                f"story); got gradient_accumulation_steps={self.grad_accum}")
+                "gradient accumulation composes with the tensor/dp and "
+                f"sequence strategies (the {self.strategy} step has its own "
+                "microbatching story); got "
+                f"gradient_accumulation_steps={self.grad_accum}")
         self.tx = make_optimizer(cfg.optimizer, cfg.scheduler, self.world_size)
         loss_scale = LossScaleState.create(cfg.precision)
 
@@ -226,7 +227,8 @@ class LMTrainer:
             )
 
             self.train_step = make_lm_train_step(
-                self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size)
+                self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size,
+                grad_accum_steps=self.grad_accum)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
