@@ -328,12 +328,12 @@ def effective_batch_sizes(cfg: TrainConfig, world: int,
     - no ``global_batch_size``: effective = batch_size × world × accum.
     - ``global_batch_size`` set and an exact >1 multiple of batch_size ×
       world while accum was left at 1: accum is *derived* (DeepSpeed:
-      ``accum = train_batch_size / (micro × world)``). Callers whose step
-      cannot accumulate (shard_map local-BN, the pipeline LM strategy —
-      its microbatch scan IS the schedule) pass ``allow_derive=False`` to
-      keep the whole global batch as one step instead of failing on an
-      unsupported accum. The GSPMD image/LM steps and the sequence LM
-      step accumulate.
+      ``accum = train_batch_size / (micro × world)``). The image steps
+      (GSPMD and shard_map local-BN) and the GSPMD/sequence LM steps all
+      accumulate; the one step that cannot is the pipeline LM strategy —
+      its microbatch scan IS the schedule — whose trainer passes
+      ``allow_derive=False`` to keep the whole global batch as one step
+      instead of failing on an unsupported accum.
     - otherwise ``global_batch_size`` wins as the effective batch (the
       reference's ds_config sets only ``train_batch_size: 96``,
       ``deepspeed_train.py:173``) and must divide by accum.

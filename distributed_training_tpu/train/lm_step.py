@@ -142,6 +142,27 @@ def _lm_metrics(new_state: TrainState, ce, aux, accuracy, finite,
     }
 
 
+def _lm_accum_grads(state: TrainState, batch, rng, accum: int,
+                    mesh, ce_chunk: int | None, positions=None):
+    """Shared LM accumulation wrapper over ``accumulate_grads``: scan
+    microbatches through fwd/bwd, average grads and metrics. ``mesh=None``
+    runs shard-locally (the sequence step's partial-manual body);
+    a real mesh adds the GSPMD microbatch sharding constraint.
+    Returns ``(avg_grads, ce, aux, accuracy)``."""
+    from distributed_training_tpu.train.step import accumulate_grads
+
+    def micro_fn(params, mbatch, r, carry):
+        g, ce, aux, acc = _lm_loss_and_grads(
+            state.replace(params=params), mbatch["tokens"],
+            mbatch["targets"], r, positions=positions, ce_chunk=ce_chunk)
+        return g, carry, (ce, aux, acc)
+
+    grads, _, (ces, auxs, accs) = accumulate_grads(
+        state.params, {"tokens": batch["tokens"], "targets": batch["targets"]},
+        rng, accum, mesh, micro_fn, init_carry=jnp.zeros(()))
+    return grads, ces.mean(), auxs.mean(), accs.mean()
+
+
 def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None,
                   accum: int = 1):
     tokens = batch["tokens"]
@@ -155,23 +176,12 @@ def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None,
 
     if accum > 1:
         # Long-context accumulation: the local batch dim is the EFFECTIVE
-        # micro×accum slice; scan fwd/bwd over microbatches inside the
-        # shard_map body (the shared accumulate_grads scan, shard-locally
-        # with mesh=None), average, then one collective + one update.
-        # Equal-sized microbatches ⇒ mean of micro-means is the full mean.
-        from distributed_training_tpu.train.step import accumulate_grads
-
-        def micro_fn(params, mbatch, r, carry):
-            g, ce, aux, acc = _lm_loss_and_grads(
-                state.replace(params=params), mbatch["tokens"],
-                mbatch["targets"], r, positions=positions,
-                ce_chunk=ce_chunk)
-            return g, carry, (ce, aux, acc)
-
-        grads, _, (ces, auxs, accs) = accumulate_grads(
-            state.params, {"tokens": tokens, "targets": targets},
-            shard_rng, accum, None, micro_fn, init_carry=jnp.zeros(()))
-        ce, aux, accuracy = ces.mean(), auxs.mean(), accs.mean()
+        # micro×accum slice; the shared scan runs shard-locally
+        # (mesh=None), then one collective + one update. Equal-sized
+        # microbatches ⇒ mean of micro-means is the full mean.
+        grads, ce, aux, accuracy = _lm_accum_grads(
+            state, {"tokens": tokens, "targets": targets}, shard_rng,
+            accum, None, ce_chunk, positions=positions)
     else:
         grads, ce, aux, accuracy = _lm_loss_and_grads(
             state, tokens, targets, shard_rng, positions=positions,
@@ -269,8 +279,6 @@ def _make_gspmd_lm_step(
     compiled step before the single update (DeepSpeed
     ``gradient_accumulation_steps`` semantics; see ``train/step.py``).
     """
-    from distributed_training_tpu.train.step import accumulate_grads
-
     if grad_accum_steps < 1:
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
@@ -279,21 +287,12 @@ def _make_gspmd_lm_step(
 
     def body(state: TrainState, batch, rng):
         if grad_accum_steps > 1:
-            def micro_fn(params, mbatch, r, carry):
-                grads, ce, aux, acc = _lm_loss_and_grads(
-                    state.replace(params=params), mbatch["tokens"],
-                    mbatch["targets"], r, ce_chunk=ce_chunk)
-                return grads, carry, (ce, aux, acc)
-
-            grads, _, (ces, auxs, accs) = accumulate_grads(
-                state.params, batch, rng, grad_accum_steps, mesh, micro_fn,
-                init_carry=jnp.zeros(()))
-            grads = state.loss_scale.unscale_grads(grads)
-            new_state, finite = commit_gradients(state, grads)
-            return new_state, _lm_metrics(
-                new_state, ces.mean(), auxs.mean(), accs.mean(), finite)
-        grads, ce, aux, accuracy = _lm_loss_and_grads(
-            state, batch["tokens"], batch["targets"], rng, ce_chunk=ce_chunk)
+            grads, ce, aux, accuracy = _lm_accum_grads(
+                state, batch, rng, grad_accum_steps, mesh, ce_chunk)
+        else:
+            grads, ce, aux, accuracy = _lm_loss_and_grads(
+                state, batch["tokens"], batch["targets"], rng,
+                ce_chunk=ce_chunk)
         grads = state.loss_scale.unscale_grads(grads)
         new_state, finite = commit_gradients(state, grads)
         return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)

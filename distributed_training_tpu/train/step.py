@@ -181,30 +181,26 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
     When ``axis_name`` is set (shard_map path), gradients/metrics are
     explicitly ``lax.pmean``-ed over that axis — the hand-written analogue of
     DDP's bucketed NCCL all-reduce. When None (GSPMD path), the same
-    collective is inserted by the partitioner. ``accum_steps > 1`` (GSPMD
-    only) scans microbatches through fwd/bwd before the single update.
+    collective is inserted by the partitioner. ``accum_steps > 1`` scans
+    microbatches through fwd/bwd before the single update — under
+    shard_map the scan runs shard-locally and the one pmean follows
+    (equal microbatches ⇒ mean of micro-means is the full mean).
     """
     if accum_steps > 1:
         grads, loss, accuracy, new_batch_stats = _accum_grads_and_stats(
             state, batch, rng, accum_steps, mesh, label_smoothing,
             input_affine)
-        grads = state.loss_scale.unscale_grads(grads)
-        new_state, finite = commit_gradients(state, grads, new_batch_stats)
-        return new_state, {
-            "loss": loss.astype(jnp.float32),
-            "accuracy": accuracy,
-            "loss_scale": new_state.loss_scale.scale,
-            "grads_finite": finite.astype(jnp.float32),
-        }
+    else:
+        def loss_fn(params):
+            loss, logits, new_bs = _forward_and_loss(
+                state, params, batch, rng, train=True,
+                label_smoothing=label_smoothing, input_affine=input_affine)
+            return state.loss_scale.scale_loss(loss), (loss, logits, new_bs)
 
-    def loss_fn(params):
-        loss, logits, new_bs = _forward_and_loss(
-            state, params, batch, rng, train=True,
-            label_smoothing=label_smoothing, input_affine=input_affine)
-        return state.loss_scale.scale_loss(loss), (loss, logits, new_bs)
-
-    grads, (loss, logits, new_batch_stats) = jax.grad(
-        loss_fn, has_aux=True)(state.params)
+        grads, (loss, logits, new_batch_stats) = jax.grad(
+            loss_fn, has_aux=True)(state.params)
+        accuracy = jnp.mean(
+            (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
 
     if axis_name is not None:
         grads = jax.lax.pmean(grads, axis_name)
@@ -233,8 +229,6 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
                 ema_batch_stats=jax.lax.pmean(
                     es.ema_batch_stats, axis_name)))
 
-    accuracy = jnp.mean(
-        (jnp.argmax(logits, -1) == batch["label"]).astype(jnp.float32))
     if axis_name is not None:
         loss = jax.lax.pmean(loss, axis_name)
         accuracy = jax.lax.pmean(accuracy, axis_name)
@@ -300,7 +294,8 @@ def make_train_step(
 
 def make_shard_map_train_step(mesh: Mesh, donate: bool = True,
                               label_smoothing: float = 0.0,
-                              input_affine: tuple | None = None) -> Callable:
+                              input_affine: tuple | None = None,
+                              grad_accum_steps: int = 1) -> Callable:
     """Explicit-collective DP train step (``shard_map`` + ``lax.pmean``).
 
     The hand-written formulation of DDP's gradient all-reduce
@@ -309,12 +304,20 @@ def make_shard_map_train_step(mesh: Mesh, donate: bool = True,
     optimizer state replicated. Used to pin down collective math in tests
     and as the template for SyncBN (the model's ``axis_name`` must be
     ``'data'`` so BatchNorm stats pmean over the same axis).
+
+    ``grad_accum_steps > 1`` scans microbatches shard-locally before the
+    one pmean + update (local-BN stats thread through the scan, then the
+    final per-shard stats are averaged like the single-shot path).
     """
+    if grad_accum_steps < 1:
+        raise ValueError(
+            f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state: TrainState, batch, rng):
         sharded = shard_map(
             functools.partial(_step_body, axis_name=AXIS_DATA,
+                              accum_steps=grad_accum_steps,
                               label_smoothing=label_smoothing,
                               input_affine=input_affine),
             mesh,

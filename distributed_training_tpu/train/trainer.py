@@ -119,14 +119,14 @@ class Trainer:
         has_bn = bool(jax.tree.leaves(state.batch_stats))
         uses_gspmd_step = cfg.sync_batchnorm or not has_bn
         # Resolve DeepSpeed batch-triple semantics once, where world size is
-        # known (accum may be derived from global_batch_size here — GSPMD
-        # step only; the shard_map local-BN step can't accumulate).
+        # known (accum may be derived from global_batch_size; both the
+        # GSPMD and the shard_map local-BN steps accumulate).
         # batch_size is per *chip* (DDP parity: per-GPU mini-batch ×
         # world), so scale by every mesh device — under a data×expert mesh
         # the data axis is smaller than the chip count, but each chip still
         # contributes batch_size examples of work.
         self.train_gbs, self.eval_gbs, self.grad_accum = effective_batch_sizes(
-            cfg, int(self.mesh.devices.size), allow_derive=uses_gspmd_step)
+            cfg, int(self.mesh.devices.size), allow_derive=True)
         # uint8 batches (decoded-cache loader) defer ToTensor/Normalize to
         # the device, fused into the first conv; the affine encodes the
         # augment mode's normalization. Float batches ignore it. Kept on
@@ -146,13 +146,10 @@ class Trainer:
                     "sync_batchnorm=False uses the explicit shard_map DP "
                     "step, which has no ZeRO sharding; use zero stage 0 "
                     "with local BN")
-            if self.grad_accum > 1:
-                raise NotImplementedError(
-                    "gradient accumulation is built on the GSPMD step; use "
-                    "sync_batchnorm=True with it")
             self.train_step = make_shard_map_train_step(
                 self.mesh, label_smoothing=cfg.label_smoothing,
-                input_affine=input_affine)
+                input_affine=input_affine,
+                grad_accum_steps=self.grad_accum)
         self.eval_step = make_eval_step(self.mesh, input_affine=input_affine)
         self.meter = MetricMeter(cfg.log_interval)
         self.clock = WallClock(cfg.wall_clock_breakdown)
