@@ -15,6 +15,7 @@ DeepSpeed-style JSON dict.
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Any, Mapping, Sequence
 
 # Plugin names mirror resnet/colossal/colossal_train.py:38 choices plus the
@@ -499,6 +500,16 @@ def from_ds_config(ds: Mapping[str, Any], base: TrainConfig | None = None) -> Tr
                 remat = bool(ac["enabled"])
             elif any(ac.get(k) for k in functional):
                 remat = True
+            elif not remat:
+                # The block is present but carries no opt-in signal — a
+                # config written against the old presence-implies-remat
+                # inference would silently lose checkpointing (and can OOM
+                # with no other symptom), so say what happened once.
+                warnings.warn(
+                    "activation_checkpointing block present but all "
+                    "functional sub-knobs are false — remat stays OFF. "
+                    'Set {"activation_checkpointing": {"enabled": true}} '
+                    "to opt in.", stacklevel=2)
         else:
             remat = bool(ac)
 

@@ -20,7 +20,9 @@ Geometry note: the live loader random-crops from the full resized W×H
 image; the cache stores only the center ``base × base`` region, so crops
 near the long-side edges of very non-square images are unavailable. That is
 the standard pre-decoded-cache trade (fixed-size records); eval center
-crops are bit-identical to the live path.
+crops match the live path to within one pixel (the two-stage center offset
+``(w-base)//2 + (base-size)//2`` can differ from the live ``(w-size)//2``
+by one when both gaps are odd).
 """
 
 from __future__ import annotations
@@ -78,10 +80,20 @@ def build_decoded_cache(
     base = _base_size(image_size)
     # Content fingerprint: a renamed/relabeled/reordered tree with the SAME
     # file count must not serve a stale cache — hash the (path, label)
-    # sequence, not just its length.
+    # sequence, not just its length. Per-file byte size is included so files
+    # replaced or re-encoded in place under the same names (a regenerated /
+    # re-downloaded dataset) also invalidate the cache instead of silently
+    # serving stale pixels. Size, not mtime: a different encode virtually
+    # always changes byte length, while mtime churns on metadata-only
+    # operations (cp/tar/touch) and would force full re-decodes of
+    # identical content.
     digest = hashlib.sha256()
     for p, l in zip(paths, np.asarray(labels).tolist()):
-        digest.update(f"{os.path.basename(p)}:{l}\n".encode())
+        try:
+            sig = os.stat(p).st_size
+        except OSError:
+            sig = "?"
+        digest.update(f"{os.path.basename(p)}:{l}:{sig}\n".encode())
     fingerprint = digest.hexdigest()
     meta_path = cache_path + ".meta.json"
     if os.path.exists(meta_path):

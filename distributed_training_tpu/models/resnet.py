@@ -34,6 +34,12 @@ ModuleDef = Any
 # torchvision-style kaiming_normal(fan_out) for convs.
 conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
 
+# The zoo-wide BatchNorm EMA momentum. One shared constant: the precise-BN
+# refresh (train/trainer.py::_refresh_batch_stats) inverts a single EMA tick
+# to recover raw batch moments and must divide by exactly (1 - momentum) —
+# a silent mismatch would mis-scale every refreshed running stat.
+BN_MOMENTUM = 0.9
+
 
 class BasicBlock(nn.Module):
     """3x3 + 3x3 residual block (ResNet-18/34)."""
@@ -142,7 +148,7 @@ class ResNet(nn.Module):
         norm = functools.partial(
             nn.BatchNorm,
             use_running_average=not train,
-            momentum=0.9,
+            momentum=BN_MOMENTUM,
             epsilon=1e-5,
             dtype=self.dtype,
             param_dtype=self.param_dtype,

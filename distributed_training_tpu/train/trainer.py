@@ -263,31 +263,51 @@ class Trainer:
         """Precise-BN: re-estimate running stats with the CURRENT params
         (train-mode forwards, no optimizer) so eval normalizes with
         statistics that match the weights it is evaluating — the EMA lags
-        by design and goes stale whenever params move fast."""
+        by design and goes stale whenever params move fast.
+
+        This is a TRUE average over the ``num_batches`` per-batch moments,
+        not an EMA tick from the stale stats (which would leave a
+        ``momentum**N`` stale residue — ~59% at N=5). A train-mode forward
+        never *reads* the running stats (it normalizes by batch
+        statistics), so ticking from a zero baseline returns exactly
+        ``(1 - momentum) * batch_stat``; dividing recovers the raw moment,
+        which is then averaged across batches."""
         import itertools
 
         if self._stats_refresh is None:
             from distributed_training_tpu.train.step import _input_images
 
-            affine = self._input_affine  # the step's input normalization
+            from distributed_training_tpu.models.resnet import BN_MOMENTUM
 
-            def refresh(state, batch):
-                rngs = {"dropout": jax.random.PRNGKey(0),
-                        "gate": jax.random.PRNGKey(1)}
+            affine = self._input_affine  # the step's input normalization
+            # The zoo-wide BN momentum — needed to undo the single EMA tick
+            # and recover the raw batch statistic.
+            momentum = BN_MOMENTUM
+
+            def batch_stat(state, batch, idx):
+                rngs = {
+                    "dropout": jax.random.fold_in(jax.random.PRNGKey(0), idx),
+                    "gate": jax.random.fold_in(jax.random.PRNGKey(1), idx),
+                }
+                zeros = jax.tree.map(jnp.zeros_like, state.batch_stats)
                 _, mut = state.apply_fn(
-                    {"params": state.params,
-                     "batch_stats": state.batch_stats},
+                    {"params": state.params, "batch_stats": zeros},
                     _input_images(batch, affine), train=True,
                     mutable=["batch_stats", "aux_loss"], rngs=rngs)
-                return state.replace(
-                    batch_stats=dict(mut).get("batch_stats",
-                                              state.batch_stats))
+                ticked = dict(mut).get("batch_stats", zeros)
+                return jax.tree.map(lambda s: s / (1.0 - momentum), ticked)
 
-            self._stats_refresh = jax.jit(refresh, donate_argnums=(0,))
+            self._stats_refresh = jax.jit(batch_stat)
 
         head = itertools.islice(iter(train_loader), num_batches)
+        acc, n = None, 0
         for gbatch in self._batches(head):
-            self.state = self._stats_refresh(self.state, gbatch)
+            b = self._stats_refresh(self.state, gbatch, n)
+            acc = b if acc is None else jax.tree.map(jnp.add, acc, b)
+            n += 1
+        if n:
+            self.state = self.state.replace(
+                batch_stats=jax.tree.map(lambda a: a / n, acc))
 
     def evaluate(self, loader, train_loader=None) -> float:
         """Top-1 accuracy (the ``target_acc`` metric); top-5 is kept on

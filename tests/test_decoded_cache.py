@@ -49,6 +49,29 @@ def test_cache_build_idempotent(tree):
     assert os.path.getmtime(cache + ".npy") == mtime  # not rebuilt
 
 
+def test_cache_rebuilds_when_file_replaced_in_place(tmp_path):
+    """Re-encoding a source image under the SAME filename (a regenerated /
+    re-downloaded dataset) must invalidate the cache — the fingerprint
+    includes per-file byte size, not just (basename, label)."""
+    pil = pytest.importorskip("PIL.Image")
+    rng = np.random.RandomState(7)
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"im{i}.jpg")
+        pil.fromarray(rng.randint(0, 255, (40, 48, 3), dtype=np.uint8)).save(
+            p, quality=95)
+        paths.append(p)
+    labels = np.zeros(3, np.int32)
+    cache = str(tmp_path / "cache")
+    build_decoded_cache(paths, labels, cache, image_size=24, num_workers=2)
+    mtime = os.path.getmtime(cache + ".npy")
+    # Rewrite one file in place: same name, different pixels/size.
+    pil.fromarray(rng.randint(0, 255, (64, 64, 3), dtype=np.uint8)).save(
+        paths[0], quality=60)
+    build_decoded_cache(paths, labels, cache, image_size=24, num_workers=2)
+    assert os.path.getmtime(cache + ".npy") != mtime  # rebuilt
+
+
 def test_cache_layout(tree):
     _, paths, labels, cache = tree
     arr = np.load(cache + ".npy", mmap_mode="r")

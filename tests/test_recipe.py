@@ -133,3 +133,34 @@ class TestPreciseBN:
         assert refreshed["final_acc"] > raw["final_acc"] + 0.2, (
             raw["final_acc"], refreshed["final_acc"])
         assert refreshed["final_acc"] > 0.5
+
+    def test_refresh_is_true_average_no_stale_residue(self):
+        """The refresh must fully replace the running stats with the
+        average of the N per-batch moments — an EMA tick from the stale
+        stats would leave a momentum**N residue (~59% at N=5, round-2
+        advisor finding). Poisoning the stats with a huge constant and
+        refreshing over few batches must erase the poison completely."""
+        import jax
+        import numpy as np
+
+        from distributed_training_tpu import TrainConfig, Trainer
+        from distributed_training_tpu.config import DataConfig
+        from distributed_training_tpu.data.cifar10 import synthetic_cifar10
+        from distributed_training_tpu.data.pipeline import ShardedDataLoader
+
+        cfg = TrainConfig(
+            model="resnet_micro", num_epochs=1,
+            data=DataConfig(dataset="synthetic_cifar", batch_size=8,
+                            max_steps_per_epoch=4, prefetch=0))
+        tr = Trainer(cfg)
+        poison = 1e4
+        tr.state = tr.state.replace(batch_stats=jax.tree.map(
+            lambda s: s + poison, tr.state.batch_stats))
+        images, labels = synthetic_cifar10(64, True, seed=0)
+        loader = ShardedDataLoader(
+            images, labels, global_batch_size=8, augment="none")
+        tr._refresh_batch_stats(loader, num_batches=4)
+        # Activations are O(1); any stale residue of the 1e4 poison (even
+        # 0.9**4 ~ 66%) would leave means in the thousands.
+        for leaf in jax.tree.leaves(tr.state.batch_stats):
+            assert np.all(np.abs(np.asarray(leaf)) < 100.0)
