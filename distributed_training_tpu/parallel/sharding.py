@@ -34,7 +34,11 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_training_tpu.runtime.mesh import AXIS_DATA, AXIS_FSDP
+from distributed_training_tpu.runtime.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_SEQUENCE,
+)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -90,16 +94,23 @@ def zero_stage_axes(mesh: Mesh, zero_stage: int) -> tuple[tuple, tuple]:
 
     The fsdp mesh axis, if sized >1, always shards params/opt (that is its
     meaning); ``zero_stage`` additionally recruits the data axis the way
-    DeepSpeed's stages recruit DP ranks.
+    DeepSpeed's stages recruit DP ranks. On a sequence-parallel mesh the
+    parameter replica group is data × sequence (ring shards hold the same
+    weights for different positions), so ZeRO recruits the sequence axis
+    too — DeepSpeed likewise partitions over the whole replica group.
     """
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     fsdp_on = shape.get(AXIS_FSDP, 1) > 1
+    seq_on = shape.get(AXIS_SEQUENCE, 1) > 1
+    replica_axes = ((AXIS_DATA,)
+                    + ((AXIS_FSDP,) if fsdp_on else ())
+                    + ((AXIS_SEQUENCE,) if seq_on else ()))
     if zero_stage >= 1:
-        opt_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
+        opt_axes = replica_axes
     else:
         opt_axes = (AXIS_FSDP,) if fsdp_on else ()
     if zero_stage >= 3:
-        param_axes = (AXIS_DATA, AXIS_FSDP) if fsdp_on else (AXIS_DATA,)
+        param_axes = replica_axes
     else:
         param_axes = (AXIS_FSDP,) if fsdp_on else ()
     return param_axes, opt_axes

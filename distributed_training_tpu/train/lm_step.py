@@ -163,8 +163,14 @@ def _lm_accum_grads(state: TrainState, batch, rng, accum: int,
     return grads, ces.mean(), auxs.mean(), accs.mean()
 
 
-def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None,
-                  accum: int = 1):
+def _lm_grads_body(gstate: TrainState, batch, rng,
+                   ce_chunk: int | None = None, accum: int = 1):
+    """The manual (shard_map) half of the sequence-parallel step: compute
+    the globally-averaged, unscaled gradient and the shard-averaged metric
+    scalars. The optimizer commit deliberately happens OUTSIDE the manual
+    region (see :func:`make_lm_train_step`) so ZeRO placements of the
+    optimizer state stay in GSPMD-land; ``gstate`` is the train state with
+    ``opt_state`` stripped — the body must not touch it."""
     tokens = batch["tokens"]
     targets = batch["targets"]
     t_local = tokens.shape[1]
@@ -180,32 +186,43 @@ def _lm_step_body(state: TrainState, batch, rng, ce_chunk: int | None = None,
         # (mesh=None), then one collective + one update. Equal-sized
         # microbatches ⇒ mean of micro-means is the full mean.
         grads, ce, aux, accuracy = _lm_accum_grads(
-            state, {"tokens": tokens, "targets": targets}, shard_rng,
+            gstate, {"tokens": tokens, "targets": targets}, shard_rng,
             accum, None, ce_chunk, positions=positions)
     else:
         grads, ce, aux, accuracy = _lm_loss_and_grads(
-            state, tokens, targets, shard_rng, positions=positions,
+            gstate, tokens, targets, shard_rng, positions=positions,
             ce_chunk=ce_chunk)
     grads = lax.pmean(grads, _GRAD_AXES)
-    grads = state.loss_scale.unscale_grads(grads)
-
-    new_state, finite = commit_gradients(state, grads)
-    return new_state, _lm_metrics(
-        new_state, ce, aux, accuracy, finite, pmean_axes=_GRAD_AXES)
+    grads = gstate.loss_scale.unscale_grads(grads)
+    ce = lax.pmean(ce, _GRAD_AXES)
+    aux = lax.pmean(aux, _GRAD_AXES)
+    accuracy = lax.pmean(accuracy, _GRAD_AXES)
+    return grads, (ce, aux, accuracy)
 
 
 def make_lm_train_step(
     mesh: Mesh, *, model=None, max_len: int | None = None,
     donate: bool = True, ce_chunk: int | None = None,
-    grad_accum_steps: int = 1,
+    grad_accum_steps: int = 1, zero_stage: int = 0,
 ) -> Callable:
     """Build the (data × sequence)-parallel jitted LM train step.
 
     Returns ``step(state, batch, rng) -> (state, metrics)`` where ``batch``
-    is ``{'tokens': i32[B, T], 'targets': i32[B, T]}`` as *global* arrays;
-    params/opt state replicated (ZeRO placement of LM states composes via
-    ``parallel/sharding.py`` but the sequence path keeps them replicated —
-    the sequence axis's job is activation memory, not state memory).
+    is ``{'tokens': i32[B, T], 'targets': i32[B, T]}`` as *global* arrays,
+    plus ``.state_shardings(state)`` / ``.batch_shardings`` attributes like
+    the GSPMD steps.
+
+    ``zero_stage`` composes DeepSpeed-style state sharding with the ring:
+    the step is split in two — the shard_map computes the pmean'd gradient
+    only (params and loss scale in, grads out; the optimizer state never
+    enters the manual region), and ``commit_gradients`` runs under plain
+    GSPMD where the ZeRO placement of Adam moments (sharded over the
+    data × sequence replica group, ``parallel/sharding.zero_stage_axes``)
+    propagates automatically: each device updates its slice of the moments
+    and XLA all-gathers the updated params — reduce-scatter/all-gather
+    ZeRO-1 semantics without hand-written collectives. Stage 3 additionally
+    stores params sharded; the shard_map's replicated in_spec makes GSPMD
+    all-gather them once at step entry (gather-on-use).
 
     ``model`` or ``max_len`` (exactly one): the positional-table bound.
     Global positions are traced values inside shard_map, so the model cannot
@@ -223,6 +240,10 @@ def make_lm_train_step(
     ``model`` while the ring hops K/V blocks over ``sequence`` (TP shards
     heads, SP shards positions; the two are orthogonal dims of attention).
     """
+    from distributed_training_tpu.parallel.tensor_parallel import (
+        tp_state_shardings,
+    )
+
     if (model is None) == (max_len is None):
         raise ValueError("pass exactly one of model= or max_len=")
     if model is not None:
@@ -240,25 +261,44 @@ def make_lm_train_step(
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
 
-    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
-    def jitted(state: TrainState, batch, rng):
+    def state_shardings_fn(state: TrainState):
+        return tp_state_shardings(state, mesh, zero_stage=zero_stage)
+
+    batch_sh = {k: NamedSharding(mesh, s) for k, s in batch_spec.items()}
+
+    def body(state: TrainState, batch, rng):
+        gstate = state.replace(opt_state=None)
         sharded = shard_map(
-            functools.partial(_lm_step_body, ce_chunk=ce_chunk,
+            functools.partial(_lm_grads_body, ce_chunk=ce_chunk,
                               accum=grad_accum_steps), mesh,
-            in_specs=(jax.tree.map(lambda _: P(), state), batch_spec, P()),
-            out_specs=(jax.tree.map(lambda _: P(), state), P()),
+            in_specs=(jax.tree.map(lambda _: P(), gstate), batch_spec, P()),
+            out_specs=(jax.tree.map(lambda _: P(), state.params), P()),
             axis_names=axis_names,
         )
-        return sharded(state, batch, rng)
+        grads, (ce, aux, accuracy) = sharded(gstate, batch, rng)
+        new_state, finite = commit_gradients(state, grads)
+        return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
+
+    jitted = None  # built lazily: shardings need a concrete state's pytree
 
     def step(state: TrainState, batch, rng):
+        nonlocal jitted
         t_global = batch["tokens"].shape[1]
         if t_global > max_len:
             raise ValueError(
                 f"global sequence length {t_global} exceeds the model's "
                 f"positional table max_len={max_len}")
+        if jitted is None:
+            repl = NamedSharding(mesh, P())
+            jitted = jax.jit(
+                body,
+                in_shardings=(state_shardings_fn(state), batch_sh, repl),
+                out_shardings=(state_shardings_fn(state), repl),
+                donate_argnums=(0,) if donate else ())
         return jitted(state, batch, rng)
 
+    step.state_shardings = state_shardings_fn
+    step.batch_shardings = batch_sh
     return step
 
 

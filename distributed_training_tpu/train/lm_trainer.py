@@ -51,7 +51,6 @@ from distributed_training_tpu.runtime.mesh import (
     data_axis_size,
 )
 from distributed_training_tpu.train.lm_step import (
-    lm_batch_shardings,
     make_lm_batch,
     make_lm_train_step,
     make_pp_lm_train_step,
@@ -94,13 +93,15 @@ class LMTrainer:
         # ``model`` automatic), so megatron TP shardings propagate inside
         # the shards and GSPMD inserts the row-parallel psums there.
         self.tp_size = model_par
-        if self.strategy != "tensor/dp" and cfg.zero.stage != 0:
+        if self.strategy == "pipeline" and cfg.zero.stage != 0:
             # Refuse rather than silently train unsharded while the banner
-            # advertises a ZeRO stage.
+            # advertises a ZeRO stage. (The sequence strategy composes:
+            # make_lm_train_step commits gradients outside its shard_map so
+            # ZeRO placements of the optimizer state stay in GSPMD-land.)
             raise NotImplementedError(
-                f"zero stage {cfg.zero.stage} composes with the tensor/dp "
-                f"strategy only; the {self.strategy} step keeps non-block "
-                "state replicated")
+                f"zero stage {cfg.zero.stage} does not compose with the "
+                "pipeline strategy; its step keeps non-block state "
+                "replicated")
         if self.strategy == "sequence" and cfg.lm.attn_impl == "flash":
             raise ValueError(
                 "attn_impl='flash' is the unsharded kernel; the sequence "
@@ -222,22 +223,17 @@ class LMTrainer:
                 tx=self.tx, loss_scale=loss_scale)
             self.shardings = self.train_step.state_shardings(state)
         elif self.strategy == "sequence":
-            from distributed_training_tpu.parallel.tensor_parallel import (
-                tp_state_shardings,
-            )
-
             self.train_step = make_lm_train_step(
                 self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size,
-                grad_accum_steps=self.grad_accum)
+                grad_accum_steps=self.grad_accum, zero_stage=cfg.zero.stage)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
-            # TP rule table: over a model axis of size 1 every spec is a
-            # no-op shard (pure-SP state replication, as before); with
+            # TP rule table (+ ZeRO recruitment over data × sequence): over
+            # a model axis of size 1 every TP spec is a no-op shard; with
             # model > 1 the weights shard megatron-style and the sequence
             # step's partial-manual shard_map leaves them automatic.
-            self.shardings = tp_state_shardings(state, self.mesh,
-                                                zero_stage=0)
+            self.shardings = self.train_step.state_shardings(state)
         else:
             self.train_step = make_tp_lm_train_step(
                 self.mesh, model=self.model, zero_stage=cfg.zero.stage,
@@ -249,10 +245,7 @@ class LMTrainer:
             self.shardings = self.train_step.state_shardings(state)
         self.state = place_state(state, self.shardings)
 
-        if self.strategy == "sequence":
-            self.batch_shardings = lm_batch_shardings(self.mesh)
-        else:
-            self.batch_shardings = self.train_step.batch_shardings
+        self.batch_shardings = self.train_step.batch_shardings
 
         # Eval forward: the ring-attention model only applies inside
         # shard_map (its sequence axis must be bound), so the sequence
