@@ -229,7 +229,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, causal, block_q, block_k, interpret):
+def _flash_bwd(res, g, *, causal, block_q, block_k, interpret, g_lse=None):
     q, k, v, out, lse = res
     bh, t, d = q.shape
     bq = _block(t, block_q)
@@ -237,6 +237,12 @@ def _flash_bwd(res, g, *, causal, block_q, block_k, interpret):
     nq, nk = t // bq, t // bk
     scale = 1.0 / (d ** 0.5)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        # lse as a differentiable OUTPUT (the ring-hop composition): its
+        # cotangent folds into the delta term — ds = p·(dp − δ + ḡ_lse)
+        # because ∂lse_i/∂s_ij = p_ij — so the two backward kernels serve
+        # both the plain and the (out, lse) variants unchanged.
+        delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
 
     dq = pl.pallas_call(
@@ -312,6 +318,36 @@ def _flash_core_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k,
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_core_lse(q, k, v, causal, block_q, block_k,
+                    bwd_block_q, bwd_block_k, interpret):
+    """Like :func:`_flash_core` but also returns the per-row logsumexp as a
+    differentiable output — the hop primitive for ring+flash composition
+    (``parallel/ring_attention.py``): per-hop (out, lse) pairs merge across
+    hops with the online-softmax recurrence, and the merge weights
+    back-propagate into lse."""
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    return out, lse[..., 0]
+
+
+def _flash_core_lse_fwd(q, k, v, causal, block_q, block_k,
+                        bwd_block_q, bwd_block_k, interpret):
+    out, lse = _flash_fwd(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    return (out, lse[..., 0]), (q, k, v, out, lse)
+
+
+def _flash_core_lse_bwd(causal, block_q, block_k, bwd_block_q, bwd_block_k,
+                        interpret, res, g):
+    g_out, g_lse = g
+    return _flash_bwd(res, g_out, causal=causal, block_q=bwd_block_q,
+                      block_k=bwd_block_k, interpret=interpret, g_lse=g_lse)
+
+
+_flash_core_lse.defvjp(_flash_core_lse_fwd, _flash_core_lse_bwd)
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -340,10 +376,19 @@ def flash_attention(
     T must divide by the block, so shorter/odd sequences clamp via
     ``_block``.
     """
+    args = _flat_args(q, k, v, block_q, block_k, bwd_block_q, bwd_block_k,
+                      interpret)
+    lead, t, d = q.shape[:-2], *q.shape[-2:]
+    out = _flash_core(*args[:3], causal, *args[3:])
+    return out.reshape(*lead, t, d)
+
+
+def _flat_args(q, k, v, block_q, block_k, bwd_block_q, bwd_block_k,
+               interpret):
+    """Shared arg prep: shape check, auto block rule, flatten lead dims."""
     if q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
     run_interpret = (not on_tpu()) if interpret is None else interpret
-    lead = q.shape[:-2]
     t, d = q.shape[-2:]
     if block_q is None:
         block_q = min(t, 1024)
@@ -356,6 +401,35 @@ def flash_attention(
     qf = q.reshape((-1, t, d))
     kf = k.reshape((-1, t, d))
     vf = v.reshape((-1, t, d))
-    out = _flash_core(qf, kf, vf, causal, block_q, block_k,
-                      bwd_block_q, bwd_block_k, run_interpret)
-    return out.reshape(*lead, t, d)
+    return (qf, kf, vf, block_q, block_k, bwd_block_q, bwd_block_k,
+            run_interpret)
+
+
+def flash_attention_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    causal: bool = False,
+    block_q: int | None = None,
+    block_k: int | None = None,
+    bwd_block_q: int | None = None,
+    bwd_block_k: int | None = None,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Blockwise attention returning ``(out, lse)`` with lse differentiable.
+
+    ``out`` is the softmax-normalized attention output ([..., T, d], input
+    dtype); ``lse`` the per-row logsumexp of the scaled scores ([..., T],
+    fp32; ≈``NEG_INF`` for fully-masked rows). The hop primitive for ring
+    attention with flash compute: per-hop results merge across hops as
+    ``out = Σ_h exp(lse_h − lse_tot)·out_h`` with
+    ``lse_tot = logaddexp_h lse_h`` — exactly the online-softmax recurrence
+    at hop granularity. Block-size defaults and dtypes match
+    :func:`flash_attention`.
+    """
+    args = _flat_args(q, k, v, block_q, block_k, bwd_block_q, bwd_block_k,
+                      interpret)
+    lead, t, d = q.shape[:-2], *q.shape[-2:]
+    out, lse = _flash_core_lse(*args[:3], causal, *args[3:])
+    return out.reshape(*lead, t, d), lse.reshape(*lead, t)

@@ -279,15 +279,31 @@ def make_lm_train_step(
         new_state, finite = commit_gradients(state, grads)
         return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
 
+    return _lazy_jit_step(mesh, state_shardings_fn, body,
+                          batch_sh=batch_sh, max_len=max_len, donate=donate)
+
+
+def _lazy_jit_step(
+    mesh: Mesh,
+    state_shardings_fn: Callable,
+    body: Callable,
+    *,
+    batch_sh: dict,
+    max_len: int | None,
+    donate: bool,
+) -> Callable:
+    """Shared step scaffold for every LM step builder: global-length guard,
+    lazy jit with explicit in/out placements once a concrete state's pytree
+    is known, and the ``.state_shardings`` / ``.batch_shardings``
+    attributes for placing host-built states and batches."""
     jitted = None  # built lazily: shardings need a concrete state's pytree
 
     def step(state: TrainState, batch, rng):
         nonlocal jitted
-        t_global = batch["tokens"].shape[1]
-        if t_global > max_len:
+        if max_len is not None and batch["tokens"].shape[1] > max_len:
             raise ValueError(
-                f"global sequence length {t_global} exceeds the model's "
-                f"positional table max_len={max_len}")
+                f"global sequence length {batch['tokens'].shape[1]} exceeds "
+                f"the positional table max_len={max_len}")
         if jitted is None:
             repl = NamedSharding(mesh, P())
             jitted = jax.jit(
@@ -337,27 +353,8 @@ def _make_gspmd_lm_step(
         new_state, finite = commit_gradients(state, grads)
         return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
 
-    jitted = None  # built lazily: shardings need a concrete state's pytree
-
-    def step(state: TrainState, batch, rng):
-        nonlocal jitted
-        if max_len is not None and batch["tokens"].shape[1] > max_len:
-            raise ValueError(
-                f"sequence length {batch['tokens'].shape[1]} exceeds "
-                f"max_len={max_len}")
-        if jitted is None:
-            repl = NamedSharding(mesh, P())
-            jitted = jax.jit(
-                body,
-                in_shardings=(state_shardings_fn(state), batch_sh, repl),
-                out_shardings=(state_shardings_fn(state), repl),
-                donate_argnums=(0,) if donate else (),
-            )
-        return jitted(state, batch, rng)
-
-    step.batch_shardings = batch_sh
-    step.state_shardings = state_shardings_fn
-    return step
+    return _lazy_jit_step(mesh, state_shardings_fn, body,
+                          batch_sh=batch_sh, max_len=max_len, donate=donate)
 
 
 def make_tp_lm_train_step(

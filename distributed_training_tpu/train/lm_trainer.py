@@ -102,11 +102,6 @@ class LMTrainer:
                 f"zero stage {cfg.zero.stage} does not compose with the "
                 "pipeline strategy; its step keeps non-block state "
                 "replicated")
-        if self.strategy == "sequence" and cfg.lm.attn_impl == "flash":
-            raise ValueError(
-                "attn_impl='flash' is the unsharded kernel; the sequence "
-                "strategy rings K/V blocks itself (use exact)")
-
         expert = shape.get("expert", 1)
         if (cfg.moe.enabled or expert > 1) and self.strategy != "tensor/dp":
             raise NotImplementedError(

@@ -96,24 +96,51 @@ def test_lm_flash_impl_matches_exact():
         gr, gg)
 
 
-def test_flash_inside_ring_raises():
-    from distributed_training_tpu.parallel.ring_attention import (
-        RingSelfAttention,
+def test_flash_lse_matches_exact_logsumexp():
+    """flash_attention_lse: out == exact attention and lse == the row
+    logsumexp of the scaled (masked) scores, with lse's cotangent folding
+    correctly into the q/k grads (the ring-hop merge depends on it)."""
+    from distributed_training_tpu.ops.flash_attention import (
+        flash_attention_lse,
     )
-    from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
-    from distributed_training_tpu.utils.compat import shard_map
-    from jax.sharding import PartitionSpec as P
 
-    mesh = create_mesh(MeshConfig(data=1, sequence=8))
-    attn = RingSelfAttention(num_heads=2, axis_name="sequence",
-                             attn_impl="flash")
-    x = jnp.zeros((1, 64, 32))
-    variables = attn.init(jax.random.PRNGKey(0), x)
+    for causal in (False, True):
+        q, k, v = _qkv((2, 2, 128, 32), seed=causal)
+        s = jnp.einsum("...qd,...kd->...qk", q, k) / np.sqrt(q.shape[-1])
+        if causal:
+            s = jnp.where(jnp.triu(jnp.ones((128, 128), bool), 1),
+                          -jnp.inf, s)
 
-    def body(x):
-        return attn.apply(variables, x)
+        out, lse = flash_attention_lse(q, k, v, causal=causal,
+                                       block_q=64, block_k=64)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(exact_attention(q, k, v, causal)),
+            atol=2e-6, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(lse),
+            np.asarray(jax.scipy.special.logsumexp(s, axis=-1)),
+            atol=1e-5, rtol=1e-5)
 
-    f = shard_map(body, mesh, in_specs=(P(None, "sequence", None),),
-                  out_specs=P(None, "sequence", None))
-    with pytest.raises(ValueError, match="flash"):
-        jax.jit(f)(x)
+        # lse-cotangent path: a loss that reads BOTH outputs.
+        def loss_flash(q, k, v):
+            o, l = flash_attention_lse(q, k, v, causal=causal,
+                                       block_q=64, block_k=64,
+                                       bwd_block_q=64, bwd_block_k=64)
+            return jnp.sum(o ** 2) + jnp.sum(jnp.sin(l))
+
+        def loss_exact(q, k, v):
+            s = jnp.einsum("...qd,...kd->...qk", q, k) / np.sqrt(q.shape[-1])
+            if causal:
+                t = q.shape[-2]
+                s = jnp.where(jnp.triu(jnp.ones((t, t), bool), 1),
+                              -jnp.inf, s)
+            l = jax.scipy.special.logsumexp(s, axis=-1)
+            return (jnp.sum(exact_attention(q, k, v, causal) ** 2)
+                    + jnp.sum(jnp.sin(l)))
+
+        ref = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b in zip("qkv", ref, got):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=5e-5, rtol=1e-4,
+                err_msg=f"d{name} mismatch (causal={causal})")

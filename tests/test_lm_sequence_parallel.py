@@ -192,6 +192,41 @@ def test_sequence_parallel_zero3_shards_params(lm_mesh):
     assert max(moved) > 0
 
 
+def test_sequence_parallel_flash_matches_exact_impl(lm_mesh):
+    """attn_impl='flash' under the sequence strategy (ring+flash, VERDICT
+    r2 #3): the Pallas hop kernel must trace the same training trajectory
+    as the exact-hop ring step."""
+    tokens = _tokens(b=4, t=65)
+    batch = make_lm_batch(tokens)
+
+    def run(attn_impl, steps=2):
+        model = get_model(
+            "transformer_lm", num_classes=VOCAB, seq_axis="sequence",
+            attn_impl=attn_impl,
+            num_layers=2, num_heads=2, hidden_dim=32, max_len=128)
+        tx = optax.sgd(0.1)
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), (2, 16), tx,
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+            input_dtype=jnp.int32)
+        step = make_lm_train_step(lm_mesh, model=model, donate=False)
+        gbatch = jax.device_put(
+            {k: jnp.asarray(v) for k, v in batch.items()},
+            step.batch_shardings)
+        for i in range(steps):
+            state, metrics = step(state, gbatch, jax.random.PRNGKey(i))
+        return state, metrics
+
+    s_exact, m_exact = run("exact")
+    s_flash, m_flash = run("flash")
+    np.testing.assert_allclose(float(m_flash["loss"]),
+                               float(m_exact["loss"]), atol=1e-5, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+        s_flash.params, s_exact.params)
+
+
 def test_lm_dynamic_loss_scale_skips_bad_step(lm_mesh):
     """An overflowed gradient skips the whole update: params frozen, step
     not ticked, one hysteresis credit consumed — the commit_gradients skip

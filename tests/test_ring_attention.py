@@ -80,6 +80,52 @@ def test_ring_attention_grads_match(seq_mesh):
                                    atol=5e-5, rtol=5e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full_attention(seq_mesh, causal):
+    """Ring with the Pallas kernel as hop compute (VERDICT r2 #3) == full
+    attention — T_loc=128 keeps the in-hop kernel multi-block-capable."""
+    q, k, v = _qkv(seed=1, t=1024, d=16)
+    oracle = ring_attention(q, k, v, axis_name=None, causal=causal)
+
+    spec = P(None, None, "sequence", None)
+    ringed = _smap(
+        functools.partial(ring_attention, axis_name="sequence",
+                          causal=causal, impl="flash"),
+        seq_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+    out = jax.jit(ringed)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               atol=5e-5, rtol=5e-5)
+
+
+def test_ring_flash_grads_match(seq_mesh):
+    """ring+flash backward: hop-kernel VJPs (with the lse cotangent from
+    the merge) + ppermute transposes must reproduce full attention's
+    gradients — what training with attn_impl='flash' under SP uses."""
+    q, k, v = _qkv(seed=4, t=256, d=16)
+
+    def loss_full(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, axis_name=None,
+                                      causal=True) ** 2)
+
+    spec = P(None, None, "sequence", None)
+    ringed = _smap(
+        functools.partial(ring_attention, axis_name="sequence",
+                          causal=True, impl="flash"),
+        seq_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ringed(q, k, v) ** 2)
+
+    g_full = jax.grad(loss_full, argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", g_full, g_ring):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
 def test_ring_self_attention_module_single_block():
     """The flax module is exact MHA when no axis is bound."""
     x = jnp.asarray(np.random.RandomState(0).randn(2, 10, 16).astype(np.float32))
