@@ -41,6 +41,27 @@ from distributed_training_tpu.utils.compat import shard_map
 
 _GRAD_AXES = (AXIS_DATA, AXIS_SEQUENCE)
 
+SP_BATCH_SPEC = {"tokens": P(AXIS_DATA, AXIS_SEQUENCE),
+                 "targets": P(AXIS_DATA, AXIS_SEQUENCE)}
+
+
+def _sp_axis_names(mesh: Mesh):
+    """shard_map manual axes for the sequence strategy: partial-manual over
+    (data, sequence) only when a model axis is actually in play — full-
+    manual is semantically identical when every non-manual axis is size 1,
+    and it keeps the plain SP path working on jax versions without
+    axis_names."""
+    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return ((AXIS_DATA, AXIS_SEQUENCE)
+            if shape.get("model", 1) > 1 else None)
+
+
+def _global_positions(t_local: int):
+    """Global token positions of this shard's [*, t_local] slice (the
+    sequence axis must be bound)."""
+    seq_idx = lax.axis_index(AXIS_SEQUENCE)
+    return (seq_idx * t_local + jnp.arange(t_local))[None, :]
+
 
 def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int):
     """CE + token accuracy WITHOUT materializing the [B, T, vocab] logits.
@@ -173,12 +194,11 @@ def _lm_grads_body(gstate: TrainState, batch, rng,
     ``opt_state`` stripped — the body must not touch it."""
     tokens = batch["tokens"]
     targets = batch["targets"]
-    t_local = tokens.shape[1]
-    seq_idx = lax.axis_index(AXIS_SEQUENCE)
-    positions = (seq_idx * t_local + jnp.arange(t_local))[None, :]
+    positions = _global_positions(tokens.shape[1])
     # Decorrelate dropout across shards; no-op when the model has none.
     shard_rng = jax.random.fold_in(
-        rng, seq_idx * lax.axis_size(AXIS_DATA) + lax.axis_index(AXIS_DATA))
+        rng, lax.axis_index(AXIS_SEQUENCE) * lax.axis_size(AXIS_DATA)
+        + lax.axis_index(AXIS_DATA))
 
     if accum > 1:
         # Long-context accumulation: the local batch dim is the EFFECTIVE
@@ -248,14 +268,8 @@ def make_lm_train_step(
         raise ValueError("pass exactly one of model= or max_len=")
     if model is not None:
         max_len = model.max_len
-    batch_spec = {"tokens": P(AXIS_DATA, AXIS_SEQUENCE),
-                  "targets": P(AXIS_DATA, AXIS_SEQUENCE)}
-    # Partial-manual only when a model axis is actually in play: full-manual
-    # is semantically identical when every non-manual axis is size 1, and it
-    # keeps the plain SP path working on jax versions without axis_names.
-    shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-    axis_names = ((AXIS_DATA, AXIS_SEQUENCE)
-                  if shape.get("model", 1) > 1 else None)
+    batch_spec = SP_BATCH_SPEC
+    axis_names = _sp_axis_names(mesh)
 
     if grad_accum_steps < 1:
         raise ValueError(
@@ -316,6 +330,52 @@ def _lazy_jit_step(
     step.state_shardings = state_shardings_fn
     step.batch_shardings = batch_sh
     return step
+
+
+def make_lm_eval_fn(
+    mesh: Mesh, *, model, ce_chunk: int | None = None,
+) -> Callable:
+    """Sharded eval forward for the sequence strategy: ``eval_fn(params,
+    batch) -> mean token CE`` over a (data × sequence)-sharded batch.
+
+    The ring-attention model only applies inside shard_map (its sequence
+    axis must be bound), so eval reuses the train step's sharded forward —
+    global positions from ``axis_index``, ring hops for K/V — with
+    ``train=False`` and no gradient. This is what makes eval possible at
+    contexts that only *fit* sharded (e.g. T16384 on 8 chips): the
+    alternative unsharded twin would need the full [T, T] attention on one
+    device. ``ce_chunk`` composes exactly as in training (the logits tensor
+    never materializes).
+    """
+    axis_names = _sp_axis_names(mesh)
+    batch_spec = SP_BATCH_SPEC
+
+    def body(params, batch):
+        tokens = batch["tokens"]
+        targets = batch["targets"]
+        positions = _global_positions(tokens.shape[1])
+        if ce_chunk:
+            hidden = model.apply(
+                {"params": params}, tokens, positions=positions,
+                train=False, return_hidden=True)
+            ce, _ = chunked_ce_and_accuracy(
+                hidden, params["lm_head"], targets, ce_chunk)
+        else:
+            logits = model.apply(
+                {"params": params}, tokens, positions=positions, train=False)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+        return lax.pmean(ce, _GRAD_AXES)
+
+    @jax.jit
+    def eval_fn(params, batch):
+        sharded = shard_map(
+            body, mesh,
+            in_specs=(jax.tree.map(lambda _: P(), params), batch_spec),
+            out_specs=P(), axis_names=axis_names)
+        return sharded(params, batch)
+
+    return eval_fn
 
 
 def _make_gspmd_lm_step(

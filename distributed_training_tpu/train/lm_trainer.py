@@ -242,37 +242,40 @@ class LMTrainer:
 
         self.batch_shardings = self.train_step.batch_shardings
 
-        # Eval forward: the ring-attention model only applies inside
-        # shard_map (its sequence axis must be bound), so the sequence
-        # strategy evaluates through an unsharded twin — params are
-        # replicated there, and the math is identical by construction
-        # (tests/test_lm_sequence_parallel.py pins this equivalence).
+        # Eval forward. The sequence strategy evaluates through the SHARDED
+        # ring forward (make_lm_eval_fn): the ring model only applies
+        # inside shard_map, and a context that only *fits* sharded (the
+        # T16384 flagship) must be evaluable at its trained length —
+        # tests/test_lm_sequence_parallel.py pins sharded eval == the
+        # unsharded oracle.
         if self.strategy == "sequence":
-            eval_model = self.model.clone(seq_axis=None)
-            eval_apply = eval_model.apply
+            from distributed_training_tpu.train.lm_step import make_lm_eval_fn
+
+            self._eval_fn = make_lm_eval_fn(
+                self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size)
         else:
             eval_apply = self.state.apply_fn
 
-        if lm.ce_chunk_size:
-            from distributed_training_tpu.train.lm_step import (
-                chunked_ce_and_accuracy,
-            )
+            if lm.ce_chunk_size:
+                from distributed_training_tpu.train.lm_step import (
+                    chunked_ce_and_accuracy,
+                )
 
-            def eval_loss(params, batch):
-                hidden = eval_apply({"params": params}, batch["tokens"],
-                                    train=False, return_hidden=True)
-                ce, _ = chunked_ce_and_accuracy(
-                    hidden, params["lm_head"], batch["targets"],
-                    lm.ce_chunk_size)
-                return ce
-        else:
-            def eval_loss(params, batch):
-                logits = eval_apply({"params": params}, batch["tokens"],
-                                    train=False)
-                return optax.softmax_cross_entropy_with_integer_labels(
-                    logits, batch["targets"]).mean()
+                def eval_loss(params, batch):
+                    hidden = eval_apply({"params": params}, batch["tokens"],
+                                        train=False, return_hidden=True)
+                    ce, _ = chunked_ce_and_accuracy(
+                        hidden, params["lm_head"], batch["targets"],
+                        lm.ce_chunk_size)
+                    return ce
+            else:
+                def eval_loss(params, batch):
+                    logits = eval_apply({"params": params}, batch["tokens"],
+                                        train=False)
+                    return optax.softmax_cross_entropy_with_integer_labels(
+                        logits, batch["targets"]).mean()
 
-        self._eval_fn = jax.jit(eval_loss)
+            self._eval_fn = jax.jit(eval_loss)
 
         self.meter = MetricMeter(cfg.log_interval)
         self.clock = WallClock(cfg.wall_clock_breakdown)

@@ -227,6 +227,53 @@ def test_sequence_parallel_flash_matches_exact_impl(lm_mesh):
         s_flash.params, s_exact.params)
 
 
+@pytest.mark.parametrize("ce_chunk", [None, 8])
+def test_sharded_eval_matches_unsharded_oracle(lm_mesh, ce_chunk):
+    """Eval at trained lengths under SP (VERDICT r2 #4): the sharded ring
+    eval forward must produce the same mean CE as an unsharded twin — and
+    it is the only eval path that works when the context fits only
+    sharded."""
+    from distributed_training_tpu.train.lm_step import make_lm_eval_fn
+
+    model, state = _make_state("sequence")
+    batch = make_lm_batch(_tokens(b=4, t=65, seed=11))
+    gbatch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in batch.items()},
+        lm_batch_shardings(lm_mesh))
+
+    eval_fn = make_lm_eval_fn(lm_mesh, model=model, ce_chunk=ce_chunk)
+    ce_sharded = float(eval_fn(state.params, gbatch))
+
+    twin = model.clone(seq_axis=None)
+    logits = twin.apply({"params": state.params},
+                        jnp.asarray(batch["tokens"]), train=False)
+    ce_oracle = float(optax.softmax_cross_entropy_with_integer_labels(
+        logits, jnp.asarray(batch["targets"])).mean())
+    assert ce_sharded == pytest.approx(ce_oracle, abs=1e-5, rel=1e-5)
+
+
+def test_lm_trainer_sequence_eval_end_to_end(lm_mesh):
+    """LMTrainer.evaluate under the sequence strategy goes through the
+    sharded path and returns a finite perplexity."""
+    from distributed_training_tpu.config import (
+        DataConfig,
+        LMConfig,
+        TrainConfig,
+    )
+    from distributed_training_tpu.train.lm_trainer import LMTrainer
+
+    cfg = TrainConfig(
+        model="transformer_lm", num_epochs=1, eval_every=1,
+        lm=LMConfig(seq_len=32, vocab_size=VOCAB, num_layers=2, num_heads=2,
+                    hidden_dim=32, max_len=64, train_sequences=64,
+                    eval_sequences=16, ce_chunk_size=8),
+        data=DataConfig(batch_size=8, prefetch=0))
+    tr = LMTrainer(cfg, mesh=lm_mesh)
+    _, eval_loader = tr.make_loaders()
+    ppl = tr.evaluate(eval_loader)
+    assert np.isfinite(ppl) and ppl > 1.0
+
+
 def test_lm_dynamic_loss_scale_skips_bad_step(lm_mesh):
     """An overflowed gradient skips the whole update: params frozen, step
     not ticked, one hysteresis credit consumed — the commit_gradients skip
