@@ -66,21 +66,25 @@ def unstack_block_params(stacked, rest: dict, prefix: str = "block") -> dict:
 
 
 def spmd_pipeline(
-    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_fn: Callable[..., jnp.ndarray],
     stage_params: Any,
     x: jnp.ndarray,
     *,
     axis_name: str = AXIS_PIPE,
     num_microbatches: int,
+    rng: jax.Array | None = None,
 ) -> jnp.ndarray:
     """Run ``x`` through the S-stage pipeline. Call inside ``shard_map``.
 
     Args:
       stage_fn: ``(stage_params, x_mb) -> y_mb`` applying this device's
-        layers to one microbatch (shape-preserving).
+        layers to one microbatch (shape-preserving); with ``rng`` set it is
+        called as ``(stage_params, x_mb, mb_rng)`` where ``mb_rng`` is
+        unique per (microbatch, stage) — fold in the layer index inside.
       stage_params: this device's stage shard (leading dim = L/S layers).
       x: [B_local, ...] the full local batch of pipeline inputs.
       num_microbatches: M; B_local must divide by it.
+      rng: optional dropout key threaded through the schedule.
 
     Returns [B_local, ...] outputs, replicated over the pipe axis (the last
     stage's results are psum-broadcast so downstream unsharded ops — final
@@ -105,7 +109,15 @@ def spmd_pipeline(
             lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, m - 1), 0,
                                      keepdims=False),
             recv)
-        out = stage_fn(stage_params, inp)
+        if rng is None:
+            out = stage_fn(stage_params, inp)
+        else:
+            # The microbatch at stage ``idx`` on tick ``t`` is ``t - idx``;
+            # folding (microbatch, stage) decorrelates dropout across both
+            # without depending on the tick count.
+            mb_rng = jax.random.fold_in(rng, jnp.clip(t - idx, 0, m - 1) * s
+                                        + idx)
+            out = stage_fn(stage_params, inp, mb_rng)
         j = jnp.clip(t - (s - 1), 0, m - 1)
         written = lax.dynamic_update_index_in_dim(outputs, out, j, 0)
         outputs = jnp.where((idx == s - 1) & (t >= s - 1), written, outputs)
@@ -169,9 +181,6 @@ class PipelinedLM:
         if model.seq_axis is not None:
             raise ValueError("pipelined LM uses full attention per stage; "
                              "build the model with seq_axis=None")
-        if model.dropout_rate:
-            raise ValueError("pipelined LM does not thread dropout rngs "
-                             "through the stage scan yet")
         self.model = model
         self.mesh = mesh
         self.num_microbatches = num_microbatches
@@ -180,6 +189,7 @@ class PipelinedLM:
             mlp_dim=model.mlp_ratio * model.hidden_dim,
             dtype=model.dtype,
             seq_axis=None,
+            dropout_rate=model.dropout_rate,
             attn_impl=model.attn_impl,
             name=None)
         shape = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -207,17 +217,41 @@ class PipelinedLM:
         (or megatron-TP-sharded when the mesh has a model axis)."""
         return pp_tree_shardings(params, self.mesh, tp=self.tp_size > 1)
 
-    def _stage_fn(self, stage_params, x):
-        def layer(h, p):
-            return self.block.apply({"params": p}, h), None
-        h, _ = lax.scan(layer, x, stage_params)
-        return h
+    def _make_stage_fn(self, train: bool):
+        def run_layer(p, h, r):
+            rngs = {"dropout": r} if self.model.dropout_rate else None
+            return self.block.apply({"params": p}, h, train, False,
+                                    rngs=rngs)
+        if self.model.remat:
+            # Activation checkpointing per layer: the pipeline scan already
+            # recomputes nothing across ticks, so remat here trades each
+            # layer's internals for its input — the same lever as the plain
+            # model's nn.remat(DecoderBlock).
+            run_layer = jax.checkpoint(run_layer)
+
+        def stage_fn(stage_params, x, mb_rng=None):
+            n_layers = jax.tree.leaves(stage_params)[0].shape[0]
+
+            def layer(carry, args):
+                h = carry
+                p, li = args
+                r = (jax.random.fold_in(mb_rng, li)
+                     if mb_rng is not None else jax.random.PRNGKey(0))
+                return run_layer(p, h, r), None
+
+            h, _ = lax.scan(layer, x, (stage_params, jnp.arange(n_layers)))
+            return h
+
+        return stage_fn
 
     def apply_fn(self, variables, tokens, positions=None, train=False,
-                 rngs=None, mutable=()):
+                 rngs=None, mutable=(), return_hidden=False):
         """Flax-shaped apply: embeddings/LN/head as plain GSPMD ops (module
         configs single-sourced from ``models/gpt.py`` factories), blocks
-        through the shard_map pipeline."""
+        through the shard_map pipeline. ``rngs={'dropout': key}`` threads
+        dropout through the stage scan (unique fold per microbatch × stage
+        × layer); ``return_hidden=True`` returns the final-norm hidden
+        states for chunked CE (mirrors ``TransformerLM.__call__``)."""
         from distributed_training_tpu.models.gpt import (
             add_pos_embed,
             make_final_norm,
@@ -225,7 +259,7 @@ class PipelinedLM:
             make_tok_embed,
         )
 
-        del train, rngs, mutable  # no dropout/batch_stats in this path
+        del mutable  # no batch_stats/aux collections in this path
         params = variables["params"]
         m = self.model
         if tokens.shape[-1] > m.max_len:
@@ -234,6 +268,12 @@ class PipelinedLM:
                 f"max_len={m.max_len}")
         if positions is None:
             positions = jnp.arange(tokens.shape[-1])[None, :]
+        dropout_rng = None
+        if train and m.dropout_rate:
+            if not rngs or "dropout" not in rngs:
+                raise ValueError(
+                    "dropout_rate is set; pass rngs={'dropout': key}")
+            dropout_rng = rngs["dropout"]
 
         x = make_tok_embed(m).apply({"params": params["tok_embed"]}, tokens)
         x = add_pos_embed(m, params["pos_embed"], x, positions)
@@ -243,17 +283,33 @@ class PipelinedLM:
         # sharding of the stage weights stays automatic — GSPMD inserts the
         # megatron psums inside each stage_fn call. Without a model axis,
         # full-manual is identical and keeps old-jax compatibility.
+        in_specs = [jax.tree.map(lambda _: P(AXIS_PIPE), params["blocks"]),
+                    P(AXIS_DATA, None, None)]
+        args = [params["blocks"], x]
+        if dropout_rng is not None:
+            in_specs.append(P())
+            args.append(dropout_rng)
+
+        def run(blocks, x, *rng_arg):
+            rng = rng_arg[0] if rng_arg else None
+            if rng is not None:
+                # Decorrelate dropout across data shards (each holds
+                # different batch rows but would otherwise draw the same
+                # local-shape masks from the replicated key).
+                rng = jax.random.fold_in(rng, lax.axis_index(AXIS_DATA))
+            return spmd_pipeline(
+                self._make_stage_fn(train), blocks, x,
+                num_microbatches=self.num_microbatches, rng=rng)
+
         pipeline = shard_map(
-            functools.partial(
-                spmd_pipeline, self._stage_fn,
-                num_microbatches=self.num_microbatches),
-            self.mesh,
-            in_specs=(jax.tree.map(lambda _: P(AXIS_PIPE), params["blocks"]),
-                      P(AXIS_DATA, None, None)),
+            run, self.mesh,
+            in_specs=tuple(in_specs),
             out_specs=P(AXIS_DATA, None, None),
             axis_names=(AXIS_PIPE, AXIS_DATA) if self.tp_size > 1 else None,
         )
-        x = pipeline(params["blocks"], x)
+        x = pipeline(*args)
 
         x = make_final_norm(m).apply({"params": params["ln_f"]}, x)
+        if return_hidden:
+            return x
         return make_lm_head(m).apply({"params": params["lm_head"]}, x)

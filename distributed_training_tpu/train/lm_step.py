@@ -47,13 +47,15 @@ SP_BATCH_SPEC = {"tokens": P(AXIS_DATA, AXIS_SEQUENCE),
 
 def _sp_axis_names(mesh: Mesh):
     """shard_map manual axes for the sequence strategy: partial-manual over
-    (data, sequence) only when a model axis is actually in play — full-
-    manual is semantically identical when every non-manual axis is size 1,
-    and it keeps the plain SP path working on jax versions without
-    axis_names."""
+    (data, sequence) only when a model or expert axis is actually in play —
+    full-manual is semantically identical when every non-manual axis is
+    size 1, and it keeps the plain SP path working on jax versions without
+    axis_names. With ``model`` > 1 the megatron psums, and with ``expert``
+    > 1 the MoE all-to-alls, are inserted by GSPMD inside the shards."""
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     return ((AXIS_DATA, AXIS_SEQUENCE)
-            if shape.get("model", 1) > 1 else None)
+            if shape.get("model", 1) > 1 or shape.get("expert", 1) > 1
+            else None)
 
 
 def _global_positions(t_local: int):
@@ -117,10 +119,14 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
     def loss_fn(params):
         rngs = dict(zip(("dropout", "gate"), jax.random.split(rng)))
         if ce_chunk:
-            hidden, mutated = state.apply_fn(
+            out = state.apply_fn(
                 {"params": params}, tokens, positions=positions, train=True,
                 rngs=rngs, mutable=["aux_loss"], return_hidden=True)
-            aux = sown_aux(mutated)
+            if isinstance(out, tuple):  # flax apply with mutable collection
+                hidden, mutated = out
+                aux = sown_aux(mutated)
+            else:  # PipelinedLM.apply_fn (no collections)
+                hidden, aux = out, jnp.float32(0)
             ce, accuracy = chunked_ce_and_accuracy(
                 hidden, params["lm_head"], targets, ce_chunk)
             return state.loss_scale.scale_loss(ce + aux), (ce, aux, accuracy)
@@ -368,12 +374,22 @@ def make_lm_eval_fn(
         return lax.pmean(ce, _GRAD_AXES)
 
     @jax.jit
-    def eval_fn(params, batch):
+    def jitted(params, batch):
         sharded = shard_map(
             body, mesh,
             in_specs=(jax.tree.map(lambda _: P(), params), batch_spec),
             out_specs=P(), axis_names=axis_names)
         return sharded(params, batch)
+
+    def eval_fn(params, batch):
+        # Same silent-clamp guard as the train factories: positions are
+        # traced inside shard_map, so the global length is only checkable
+        # here (an oversized T would silently reuse the last pos-embed row).
+        if batch["tokens"].shape[1] > model.max_len:
+            raise ValueError(
+                f"global sequence length {batch['tokens'].shape[1]} exceeds "
+                f"the positional table max_len={model.max_len}")
+        return jitted(params, batch)
 
     return eval_fn
 
@@ -459,6 +475,7 @@ def make_tp_lm_train_step(
 
 def make_pp_lm_train_step(
     mesh: Mesh, *, model, num_microbatches: int, donate: bool = True,
+    ce_chunk: int | None = None,
 ) -> Callable:
     """Pipeline-parallel LM train step (GPipe schedule over ``pipe``).
 
@@ -492,7 +509,8 @@ def make_pp_lm_train_step(
 
     # max_len is enforced inside PipelinedLM.apply_fn (statically), so the
     # shared builder doesn't need to re-check it.
-    step = _make_gspmd_lm_step(mesh, state_shardings, donate=donate)
+    step = _make_gspmd_lm_step(mesh, state_shardings, donate=donate,
+                               ce_chunk=ce_chunk)
     step.pipelined = plm
     return step
 

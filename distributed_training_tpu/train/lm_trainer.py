@@ -103,10 +103,11 @@ class LMTrainer:
                 "pipeline strategy; its step keeps non-block state "
                 "replicated")
         expert = shape.get("expert", 1)
-        if (cfg.moe.enabled or expert > 1) and self.strategy != "tensor/dp":
+        if (cfg.moe.enabled or expert > 1) and self.strategy == "pipeline":
             raise NotImplementedError(
-                "MoE/expert parallelism composes with the tensor/dp "
-                f"strategy only (got {self.strategy})")
+                "MoE/expert parallelism composes with the tensor/dp and "
+                "sequence strategies (the pipeline executor's stacked "
+                "blocks assume a dense FFN)")
         if expert > 1 and not cfg.moe.enabled:
             raise ValueError(
                 f"expert mesh axis sized {expert} with MoE disabled would "
@@ -121,10 +122,6 @@ class LMTrainer:
             if lm.ce_chunk_size < 1:
                 raise ValueError(
                     f"ce_chunk_size must be >= 1, got {lm.ce_chunk_size}")
-            if self.strategy == "pipeline":
-                raise NotImplementedError(
-                    "ce_chunk_size does not compose with the pipeline "
-                    "executor (its apply returns logits directly)")
             # Token datasets yield seq_len+1 tokens so the shifted loss
             # length is exactly seq_len (seq_len/sp per sequence shard).
             t_loss = lm.seq_len // seq
@@ -176,10 +173,6 @@ class LMTrainer:
                 moe_mlp_type=cfg.moe.mlp_type,
                 moe_expert_axis="expert" if expert > 1 else None,
             )
-        if cfg.remat and self.strategy == "pipeline":
-            raise NotImplementedError(
-                "remat does not compose with the pipeline executor (its "
-                "microbatch scan manages its own recomputation)")
         self.model = get_model(
             "transformer_lm",
             num_classes=lm.vocab_size,
@@ -211,7 +204,8 @@ class LMTrainer:
         if self.strategy == "pipeline":
             self.train_step = make_pp_lm_train_step(
                 self.mesh, model=self.model,
-                num_microbatches=lm.num_microbatches)
+                num_microbatches=lm.num_microbatches,
+                ce_chunk=lm.ce_chunk_size)
             plm = self.train_step.pipelined
             state = TrainState.create(
                 apply_fn=plm.apply_fn, params=plm.init_params(init_rng),

@@ -177,6 +177,93 @@ def test_pp_loss_decreases(pp_mesh):
     assert last < first * 0.5, (first, last)
 
 
+def test_pp_ce_chunk_matches_full_logits(pp_mesh):
+    """ce_chunk through the pipeline executor (VERDICT r2 #7): chunked CE
+    over return_hidden must trace the same trajectory as the full-logits
+    step."""
+    model = _model()
+    batch = make_lm_batch(_tokens(t=33))
+
+    def run(ce_chunk):
+        step = make_pp_lm_train_step(pp_mesh, model=model,
+                                     num_microbatches=2, donate=False,
+                                     ce_chunk=ce_chunk)
+        state = _pp_state(step.pipelined, jax.random.PRNGKey(0), opt="sgd")
+        gbatch = jax.device_put(
+            {k: jnp.asarray(v) for k, v in batch.items()},
+            step.batch_shardings)
+        for i in range(2):
+            state, metrics = step(state, gbatch, jax.random.PRNGKey(i))
+        return state, metrics
+
+    s_full, m_full = run(None)
+    s_chunk, m_chunk = run(8)
+    np.testing.assert_allclose(float(m_chunk["loss"]), float(m_full["loss"]),
+                               atol=1e-5, rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+        s_chunk.params, s_full.params)
+
+
+def test_pp_dropout_trains_and_draws_distinct_masks(pp_mesh):
+    """Dropout rngs thread through the stage scan (VERDICT r2 #7): a
+    dropout model trains through the pipeline, train-mode losses vary with
+    the rng (masks actually apply), and eval mode is deterministic."""
+    model = get_model(
+        "transformer_lm", num_classes=VOCAB, seq_axis=None, num_layers=4,
+        num_heads=2, hidden_dim=32, max_len=128, dropout_rate=0.5)
+    step = make_pp_lm_train_step(pp_mesh, model=model, num_microbatches=2,
+                                 donate=False)
+    state = _pp_state(step.pipelined, jax.random.PRNGKey(0), opt="sgd")
+    batch = make_lm_batch(_tokens())
+    gbatch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in batch.items()}, step.batch_shardings)
+
+    _, m1 = step(state, gbatch, jax.random.PRNGKey(1))
+    _, m2 = step(state, gbatch, jax.random.PRNGKey(2))
+    assert float(m1["loss"]) != float(m2["loss"])  # masks drawn from rng
+
+    # Same rng → same loss (deterministic given the key).
+    _, m1b = step(state, gbatch, jax.random.PRNGKey(1))
+    assert float(m1["loss"]) == float(m1b["loss"])
+
+    # Eval path (train=False) ignores dropout entirely.
+    tokens = jnp.asarray(_tokens())
+    e1 = step.pipelined.apply_fn({"params": state.params}, tokens)
+    e2 = step.pipelined.apply_fn({"params": state.params}, tokens)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+
+
+def test_pp_remat_matches_plain(pp_mesh):
+    """model.remat checkpoints each layer inside the stage scan without
+    changing the math (VERDICT r2 #7)."""
+    batch = make_lm_batch(_tokens())
+
+    def run(remat):
+        model = get_model(
+            "transformer_lm", num_classes=VOCAB, seq_axis=None,
+            num_layers=4, num_heads=2, hidden_dim=32, max_len=128,
+            remat=remat)
+        step = make_pp_lm_train_step(pp_mesh, model=model,
+                                     num_microbatches=2, donate=False)
+        state = _pp_state(step.pipelined, jax.random.PRNGKey(0), opt="sgd")
+        gbatch = jax.device_put(
+            {k: jnp.asarray(v) for k, v in batch.items()},
+            step.batch_shardings)
+        state, metrics = step(state, gbatch, jax.random.PRNGKey(0))
+        return state, metrics
+
+    s_plain, m_plain = run(False)
+    s_remat, m_remat = run(True)
+    np.testing.assert_allclose(float(m_remat["loss"]), float(m_plain["loss"]),
+                               atol=1e-6, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5),
+        s_remat.params, s_plain.params)
+
+
 def test_pp_rejects_bad_config(pp_mesh):
     model = get_model("transformer_lm", num_classes=VOCAB, seq_axis=None,
                       num_layers=3, num_heads=2, hidden_dim=32, max_len=128)
