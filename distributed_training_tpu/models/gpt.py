@@ -157,9 +157,8 @@ class TransformerLM(nn.Module):
     cache_len: int | None = None
     # Rematerialize each decoder block in the backward pass (activation
     # checkpointing: O(depth) activation memory for ~30% extra FLOPs).
-    # Ignored in decode mode (no backward). Does NOT compose with the
-    # pipeline executor (LMTrainer rejects remat + pipe; the pipeline's
-    # microbatch scan manages its own recomputation).
+    # Ignored in decode mode (no backward). The pipeline executor honors
+    # it too (PipelinedLM checkpoints each layer inside its stage scan).
     remat: bool = False
 
     @nn.compact

@@ -318,12 +318,8 @@ def _lazy_jit_step(
     attributes for placing host-built states and batches."""
     jitted = None  # built lazily: shardings need a concrete state's pytree
 
-    def step(state: TrainState, batch, rng):
+    def ensure_jitted(state: TrainState):
         nonlocal jitted
-        if max_len is not None and batch["tokens"].shape[1] > max_len:
-            raise ValueError(
-                f"global sequence length {batch['tokens'].shape[1]} exceeds "
-                f"the positional table max_len={max_len}")
         if jitted is None:
             repl = NamedSharding(mesh, P())
             jitted = jax.jit(
@@ -331,10 +327,28 @@ def _lazy_jit_step(
                 in_shardings=(state_shardings_fn(state), batch_sh, repl),
                 out_shardings=(state_shardings_fn(state), repl),
                 donate_argnums=(0,) if donate else ())
-        return jitted(state, batch, rng)
+        return jitted
+
+    def check_len(batch):
+        if max_len is not None and batch["tokens"].shape[1] > max_len:
+            raise ValueError(
+                f"global sequence length {batch['tokens'].shape[1]} exceeds "
+                f"the positional table max_len={max_len}")
+
+    def step(state: TrainState, batch, rng):
+        check_len(batch)
+        return ensure_jitted(state)(state, batch, rng)
+
+    def lower(state, batch, rng):
+        # AOT hook for collective accounting (utils/hlo.py): lower the
+        # exact step program without executing it. Same silent-clamp guard
+        # as step() — a lowered program can also be compiled and run.
+        check_len(batch)
+        return ensure_jitted(state).lower(state, batch, rng)
 
     step.state_shardings = state_shardings_fn
     step.batch_shardings = batch_sh
+    step.lower = lower
     return step
 
 

@@ -266,7 +266,7 @@ def make_train_step(
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     cache: dict[Any, Callable] = {}
 
-    def step(state: TrainState, batch, rng):
+    def ensure_jitted(state: TrainState, batch):
         treedef = jax.tree.structure((state, batch))
         fn = cache.get(treedef)
         if fn is None:
@@ -287,8 +287,14 @@ def make_train_step(
                 donate_argnums=(0,) if donate else (),
             )
             cache[treedef] = fn
-        return fn(state, batch, rng)
+        return fn
 
+    def step(state: TrainState, batch, rng):
+        return ensure_jitted(state, batch)(state, batch, rng)
+
+    # AOT hook for collective accounting (utils/hlo.py).
+    step.lower = lambda state, batch, rng: ensure_jitted(state, batch).lower(
+        state, batch, rng)
     return step
 
 

