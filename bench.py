@@ -31,16 +31,25 @@ import optax
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 6000.0
 
 
+_PROBED_PLATFORM: list[str] = []
+
+
 def ensure_live_backend(probe_timeout: int = 180) -> str:
     """Return the platform to bench on, falling back to CPU if TPU is stuck.
 
     The axon TPU tunnel serves one client and can wedge (backend init blocks
     forever) if a previous client died uncleanly. Probe it in a subprocess
     with a timeout so bench.py itself never hangs; on failure, run on CPU
-    with an honest label rather than block the driver.
+    with an honest label rather than block the driver. The result is cached
+    for the process: once this process holds the tunnel, a second
+    subprocess probe (e.g. --check's LM leg) would contend with OURSELVES
+    for the one-client tunnel and wrongly conclude it is down.
     """
+    if _PROBED_PLATFORM:
+        return _PROBED_PLATFORM[0]
     if os.environ.get("BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
+        _PROBED_PLATFORM.append("cpu")
         return "cpu"
     try:
         out = subprocess.run(
@@ -48,12 +57,15 @@ def ensure_live_backend(probe_timeout: int = 180) -> str:
              "import jax; d=jax.devices(); print(d[0].platform)"],
             capture_output=True, text=True, timeout=probe_timeout)
         if out.returncode == 0 and out.stdout.strip():
-            return out.stdout.strip().splitlines()[-1]
+            platform = out.stdout.strip().splitlines()[-1]
+            _PROBED_PLATFORM.append(platform)
+            return platform
     except subprocess.TimeoutExpired:
         pass
     print("bench: TPU backend unreachable (tunnel hang?); falling back to CPU",
           file=sys.stderr)
     jax.config.update("jax_platforms", "cpu")
+    _PROBED_PLATFORM.append("cpu")
     return "cpu"
 
 
@@ -290,7 +302,7 @@ def bench_lm(args) -> None:
                           and args.seq_len == 1024
                           and args.attn_impl == "flash"
                           and not args.ce_chunk)
-    print(json.dumps({
+    result = {
         "metric": f"GPT-2-small train throughput (bf16 AdamW, B"
                   f"{args.lm_batch} T{args.seq_len} {args.attn_impl}"
                   f"{', chunked CE' if args.ce_chunk else ''}, "
@@ -299,10 +311,12 @@ def bench_lm(args) -> None:
         "unit": "tokens/sec",
         "vs_baseline": (round(tok_s / 94_600, 4)
                         if is_baseline_config else None),
-    }))
+    }
+    print(json.dumps(result))
+    return result, platform
 
 
-def main():
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50")
     # Defaults are the measured-best throughput config on one v5e chip
@@ -365,15 +379,30 @@ def main():
     ap.add_argument("--attn-impl", default="flash",
                     choices=["flash", "exact"])
     ap.add_argument("--ce-chunk", type=int, default=None)
-    args = ap.parse_args()
+    ap.add_argument("--check", action="store_true", default=False,
+                    help="perf-regression gate: run the image AND LM "
+                         "benches at their baseline configs and exit "
+                         "non-zero if either regresses more than the "
+                         "tolerance in BENCH_BASELINE.json")
+    return ap
+
+
+def main():
+    args = build_parser().parse_args()
 
     if args.data_only:
         bench_data_only(args)
         return
+    if args.check:
+        run_check(args)
+        return
     if args.lm:
         bench_lm(args)
         return
+    bench_image(args)
 
+
+def bench_image(args):
     platform = ensure_live_backend()
     if platform == "cpu" and args.model == "resnet50":
         # CPU fallback: keep the graph identical in kind but tractable.
@@ -460,7 +489,7 @@ def main():
 
     images_per_sec = args.steps * steps_per_call * global_batch / dt
     per_chip = images_per_sec / n_chips
-    print(json.dumps({
+    result = {
         "metric": f"{args.model} synthetic-ImageNet train throughput "
                   f"(bf16, batch {args.batch_size}/chip"
                   f"{', zero-' + str(args.zero_stage) if args.zero_stage else ''}"
@@ -474,7 +503,52 @@ def main():
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4),
-    }))
+    }
+    print(json.dumps(result))
+    return result, platform
+
+
+def run_check(args):
+    """Perf-regression gate (``python bench.py --check``): run the image
+    and LM benches at the configs BENCH_BASELINE.json records, exit
+    non-zero if either regresses more than the stored tolerance.
+
+    The baseline numbers are chip-specific (one v5e through the tunnel);
+    the CPU fallback is incomparable, so a check that cannot reach the TPU
+    fails rather than green-lighting a meaningless number.
+    """
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_BASELINE.json")
+    with open(path) as fh:
+        base = json.load(fh)
+    tol = float(base.get("tolerance", 0.03))
+
+    del args  # the gate ALWAYS measures the baseline configs: fresh
+    # parser defaults per leg (user flags would silently compare an
+    # incomparable config against the stored numbers; each bench also
+    # mutates its args, so the legs must not share a namespace).
+    img_result, img_platform = bench_image(build_parser().parse_args([]))
+    lm_result, lm_platform = bench_lm(build_parser().parse_args([]))
+
+    failures = []
+    for key, (result, platform) in (("image", (img_result, img_platform)),
+                                    ("lm", (lm_result, lm_platform))):
+        expected = float(base[key]["value"])
+        got = float(result["value"])
+        if platform != base[key]["platform"]:
+            print(f"check {key}: FAIL — ran on {platform!r}, baseline is "
+                  f"{base[key]['platform']!r} (unreachable TPU is a "
+                  "failure, not a pass)", file=sys.stderr)
+            failures.append(key)
+            continue
+        ratio = got / expected
+        ok = ratio >= 1.0 - tol
+        print(f"check {key}: {got:.1f} vs baseline {expected:.1f} "
+              f"{base[key]['unit']} (x{ratio:.3f}, tolerance -{tol:.0%}) "
+              f"{'OK' if ok else 'REGRESSION'}", file=sys.stderr)
+        if not ok:
+            failures.append(key)
+    sys.exit(1 if failures else 0)
 
 
 if __name__ == "__main__":

@@ -187,6 +187,20 @@ def test_committed_artifact_covers_all_strategies():
         assert expected in strategies, expected
         assert strategies[expected]["collectives"], expected
         assert strategies[expected]["grad_bytes_fp32"] > 0
+    # Substance, not just coverage: the recorded numbers must satisfy the
+    # same wire invariants the live tests assert, so a regenerated
+    # artifact from drifted builders fails here.
+    dp = strategies["image dp (zero-0)"]
+    assert dp["collectives"]["all-reduce"]["bytes"] >= dp["grad_bytes_fp32"]
+    assert "all-gather" not in dp["collectives"]
+    assert "all-gather" in strategies["image dp zero-3"]["collectives"]
+    sp = strategies["lm dp×sp (ring)"]["collectives"]
+    assert sp["collective-permute"]["count"] >= 4
+    assert sp["all-reduce"]["count"] == 1
+    assert "all-gather" not in sp
+    assert "all-gather" in strategies["lm dp×sp zero-1"]["collectives"]
+    assert "collective-permute" in strategies["lm dp×pp (gpipe)"][
+        "collectives"]
 
 
 def test_parser_handles_tuple_and_async_forms():
