@@ -36,6 +36,15 @@ from distributed_training_tpu.utils.compat import on_tpu
 
 NEG_INF = -1e30
 
+# Lane width of the per-row logsumexp / delta sidecars. Mosaic needs the
+# minor-most BLOCK dim to be a 128-multiple or span the full array dim, so
+# per-row scalars are stored replicated across lanes; 8 lanes (one sublane
+# tile, "full dim" for the block) instead of 128 cuts the sidecar HBM
+# traffic 16x — at B16 H12 T1024 the lse+delta tensors were 100 MB each
+# per layer, written in forward and read by BOTH backward kernels (~5.6
+# GB/step, ~7 ms of the GPT step at v5e bandwidth).
+LSE_LANES = 8
+
 
 def _block(t: int, requested: int) -> int:
     """Largest usable block ≤ ``requested`` for a length-``t`` sequence.
@@ -64,11 +73,19 @@ def _live_block(qi, ki, *, causal, block_q, block_k):
 
 
 def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
-    """fp32 scaled q·kᵀ for one tile, causally masked by global positions."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
+    """Scaled q·kᵀ for one tile (fp32 accumulation), causally masked by
+    global positions.
+
+    The dot runs in the INPUT dtype with ``preferred_element_type=f32`` —
+    NOT on fp32-cast operands. On TPU an explicit f32×f32 matmul runs the
+    MXU at the fp32 rate (~1/4 of bf16 on v5e); bf16 operands with fp32
+    accumulation keep full MXU rate at the same accumulation precision
+    (measured: the fp32-cast version held the whole kernel to ~52 TFLOP/s
+    on bf16 models). fp32 inputs still get an exact fp32 matmul — the
+    tests' oracle tolerances are dtype-driven.
+    """
     s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
     if causal:
         qpos = qi * block_q + jax.lax.broadcasted_iota(
@@ -106,8 +123,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         corr = jnp.exp(m_prev - m_new)
         l[:] = jnp.broadcast_to(
             l[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True), l.shape)
+        # p in the value dtype (standard flash practice: p ∈ [0, 1], bf16
+        # keeps the MXU at full rate), fp32 accumulation into acc.
         acc[:] = acc[:] * corr + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m[:] = jnp.broadcast_to(m_new, m.shape)
 
@@ -144,11 +163,11 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t, 128), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, LSE_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -174,16 +193,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq,
     @pl.when(_live_block(qi, ki, causal=causal, block_q=block_q,
                          block_k=block_k))
     def _():
-        k = k_ref[0].astype(jnp.float32)
         s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k)
         p = jnp.exp(s - lse_ref[0][:, :1])
+        # Input-dtype matmuls, fp32 accumulation (see _masked_scores); ds
+        # is cast back to the key dtype for the dq contraction — the
+        # standard flash-backward precision recipe.
         dp = jax.lax.dot_general(
-            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            do_ref[0], v_ref[0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])
         dq[:] += scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
@@ -205,22 +226,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(_live_block(qi, ki, causal=causal, block_q=block_q,
                          block_k=block_k))
     def _():
-        q = q_ref[0].astype(jnp.float32)
         s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k)
         p = jnp.exp(s - lse_ref[0][:, :1])
-        do = do_ref[0].astype(jnp.float32)
-        # dV += P^T dO
+        do = do_ref[0]
+        # dV += P^T dO — p in the output-grad dtype, fp32 accumulation.
         dv[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])
         # dK += dS^T Q
         dk[:] += scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
@@ -243,7 +263,8 @@ def _flash_bwd(res, g, *, causal, block_q, block_k, interpret, g_lse=None):
         # because ∂lse_i/∂s_ij = p_ij — so the two backward kernels serve
         # both the plain and the (out, lse) variants unchanged.
         delta = delta - g_lse.astype(jnp.float32)
-    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
+    delta = jnp.broadcast_to(delta[..., None],
+                             (*delta.shape, LSE_LANES))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -254,8 +275,8 @@ def _flash_bwd(res, g, *, causal, block_q, block_k, interpret, g_lse=None):
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -272,8 +293,8 @@ def _flash_bwd(res, g, *, causal, block_q, block_k, interpret, g_lse=None):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -395,7 +416,7 @@ def _flat_args(q, k, v, block_q, block_k, bwd_block_q, bwd_block_k,
     if block_k is None:
         block_k = min(t, 2048)
     if bwd_block_q is None:
-        bwd_block_q = min(t, 512)
+        bwd_block_q = min(t, 1024)
     if bwd_block_k is None:
         bwd_block_k = min(t, 1024)
     qf = q.reshape((-1, t, d))

@@ -189,6 +189,68 @@ def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool):
     return o.astype(v.dtype)
 
 
+def _flat_init(rng, shape, dtype, n_in_dims: int):
+    """Replicate flax DenseGeneral's kernel init exactly: the draw happens
+    on the 2D (fan_in, fan_out) flattening and is reshaped — keeping init
+    values bit-identical to the DenseGeneral modules these projections
+    replaced (checkpoints and equivalence tests depend on it)."""
+    import numpy as np
+
+    flat = (int(np.prod(shape[:n_in_dims])), int(np.prod(shape[n_in_dims:])))
+    return nn.initializers.lecun_normal()(rng, flat, dtype).reshape(shape)
+
+
+class _QKVProj(nn.Module):
+    """QKV projection emitting the attention-native [3, B, H, T, d] layout.
+
+    Parameter-compatible with ``nn.DenseGeneral(features=(3, H, d),
+    name='qkv')`` — same ``kernel``/``bias`` shapes, same init draws — but
+    the head/time transpose lives in the einsum's OUTPUT indexing, where
+    XLA folds it into the matmul epilogue, instead of as a separate
+    [B, T, H, d] → [B, H, T, d] HBM pass after the projection (measured at
+    ~5% of the GPT step, ``profiles/gpt_t1024.json``)."""
+
+    num_heads: int
+    head_dim: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d_in = x.shape[-1]
+        kernel = self.param(
+            "kernel", functools.partial(_flat_init, n_in_dims=1),
+            (d_in, 3, self.num_heads, self.head_dim), self.param_dtype)
+        bias = self.param(
+            "bias", nn.initializers.zeros,
+            (3, self.num_heads, self.head_dim), self.param_dtype)
+        y = jnp.einsum("btm,mshd->sbhtd", x.astype(self.dtype),
+                       kernel.astype(self.dtype))
+        return y + bias.astype(self.dtype)[:, None, :, None, :]
+
+
+class _OutProj(nn.Module):
+    """Output projection consuming [B, H, T, d] directly (conjugate of
+    :class:`_QKVProj`; parameter-compatible with ``nn.DenseGeneral(
+    features=D, axis=(-2, -1), name='out')``)."""
+
+    features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h, d = x.shape[1], x.shape[-1]
+        kernel = self.param(
+            "kernel", functools.partial(_flat_init, n_in_dims=2),
+            (h, d, self.features), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,),
+                          self.param_dtype)
+        y = jnp.einsum("bhtd,hdm->btm", x.astype(self.dtype),
+                       kernel.astype(self.dtype))
+        return y + bias.astype(self.dtype)
+
+
 class RingSelfAttention(nn.Module):
     """Multi-head self-attention with ring-parallel sequence sharding.
 
@@ -266,22 +328,26 @@ class RingSelfAttention(nn.Module):
         if d % self.num_heads:
             raise ValueError(f"hidden {d} not divisible by {self.num_heads} heads")
         head_dim = d // self.num_heads
-        dense = functools.partial(
-            nn.DenseGeneral, dtype=self.dtype, param_dtype=self.param_dtype)
 
-        qkv = dense(features=(3, self.num_heads, head_dim), name="qkv")(x)
-        q, k, v = jnp.moveaxis(qkv, -3, 0)
+        # Projections emit/consume the attention-native [B, H, T, d] layout
+        # directly: the head/time permutation rides the matmul epilogues
+        # instead of standalone transpose passes over the activations.
+        qkv = _QKVProj(
+            num_heads=self.num_heads, head_dim=head_dim, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="qkv")(x)
+        q, k, v = qkv[0], qkv[1], qkv[2]  # each [B, H, T, hd]
 
         if decode:
             if self.axis_name is not None:
                 raise ValueError(
                     "decode=True is the unsharded inference path; generation "
                     "does not compose with sequence-parallel attention")
-            out = self._decode_attend(q, k, v, head_dim)  # [B, T, H, hd]
+            # The KV-cache keeps its [B, cache_len, H, hd] layout (decode is
+            # latency-, not layout-bound; T is 1 per step).
+            qd, kd, vd = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+            out = self._decode_attend(qd, kd, vd, head_dim)  # [B, T, H, hd]
+            out = jnp.swapaxes(out, 1, 2)  # [B, H, T, hd]
         else:
-            # [B, T, H, hd] -> [B, H, T, hd]
-            q, k, v = (jnp.swapaxes(t, -3, -2) for t in (q, k, v))
-
             # model.init traces this module outside shard_map where the mesh
             # axis is unbound; params don't depend on the ring, so init uses
             # the exact single-block path. Real applies keep the axis
@@ -300,7 +366,7 @@ class RingSelfAttention(nn.Module):
             else:
                 out = ring_attention(
                     q, k, v, axis_name=axis_name, causal=self.causal)
-            out = jnp.swapaxes(out, -3, -2)  # back to [B, T, H, hd]
 
-        return dense(
-            features=d, axis=(-2, -1), name="out")(out)
+        return _OutProj(
+            features=d, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="out")(out)

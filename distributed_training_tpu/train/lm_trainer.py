@@ -205,7 +205,8 @@ class LMTrainer:
             self.train_step = make_pp_lm_train_step(
                 self.mesh, model=self.model,
                 num_microbatches=lm.num_microbatches,
-                ce_chunk=lm.ce_chunk_size)
+                ce_chunk=lm.ce_chunk_size,
+                accuracy_metric=lm.metrics_accuracy)
             plm = self.train_step.pipelined
             state = TrainState.create(
                 apply_fn=plm.apply_fn, params=plm.init_params(init_rng),
@@ -214,7 +215,8 @@ class LMTrainer:
         elif self.strategy == "sequence":
             self.train_step = make_lm_train_step(
                 self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size,
-                grad_accum_steps=self.grad_accum, zero_stage=cfg.zero.stage)
+                grad_accum_steps=self.grad_accum, zero_stage=cfg.zero.stage,
+                accuracy_metric=lm.metrics_accuracy)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -227,7 +229,8 @@ class LMTrainer:
             self.train_step = make_tp_lm_train_step(
                 self.mesh, model=self.model, zero_stage=cfg.zero.stage,
                 grad_accum_steps=self.grad_accum,
-                ce_chunk=lm.ce_chunk_size)
+                ce_chunk=lm.ce_chunk_size,
+                accuracy_metric=lm.metrics_accuracy)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)

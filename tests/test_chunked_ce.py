@@ -160,19 +160,25 @@ class TestTrainerWiring:
         result = LMTrainer(cfg, mesh=mesh).fit()
         assert np.isfinite(result["final_perplexity"])
 
-    def test_pipeline_rejects_chunking(self, devices):
+    def test_pipeline_composes_with_chunking(self, devices):
+        """ce_chunk through the pipeline executor (round-3; the step-level
+        equivalence is pinned by test_pp_ce_chunk_matches_full_logits) —
+        the trainer wires it end-to-end."""
+        import numpy as np
+
         from distributed_training_tpu.train.lm_trainer import LMTrainer
 
         cfg = TrainConfig(
-            model="transformer_lm",
+            model="transformer_lm", num_epochs=1, eval_every=1,
             mesh=MeshSpec(data=-1, pipe=2),
-            data=DataConfig(batch_size=4),
+            data=DataConfig(batch_size=4, max_steps_per_epoch=2),
             lm=LMConfig(seq_len=16, vocab_size=VOCAB, num_layers=2,
                         num_heads=2, hidden_dim=16, max_len=32,
-                        num_microbatches=2, ce_chunk_size=4),
+                        num_microbatches=2, ce_chunk_size=4,
+                        train_sequences=64, eval_sequences=32),
         )
-        with pytest.raises(NotImplementedError, match="ce_chunk"):
-            LMTrainer(cfg)
+        result = LMTrainer(cfg).fit()
+        assert np.isfinite(result["final_perplexity"])
 
     @pytest.mark.parametrize("bad_chunk", [5, -4, 0])
     def test_invalid_chunk_rejected_at_construction(self, mesh, bad_chunk):

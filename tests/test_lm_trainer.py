@@ -114,3 +114,20 @@ def test_lm_trainer_rejects_bad_meshes(tmp_path):
     with pytest.raises(ValueError, match="num_heads"):
         cfg = _cfg(MeshSpec(data=1, model=8), tmp_path)
         LMTrainer(cfg)
+
+
+def test_metrics_accuracy_off_drops_key_same_loss(tmp_path):
+    """lm.metrics_accuracy=False removes the per-step vocab argmax (a full
+    extra HBM pass over the logits): the 'accuracy' metric key disappears
+    while the training math — loss trajectory, steps — is unchanged."""
+    import dataclasses as dc
+
+    base = _cfg(MeshSpec(data=-1), tmp_path)
+    on = LMTrainer(base)
+    off = LMTrainer(base.replace(lm=dc.replace(LM, metrics_accuracy=False)))
+    train_on, _ = on.make_loaders()
+    train_off, _ = off.make_loaders()
+    m_on = on.train_epoch(0, train_on)
+    m_off = off.train_epoch(0, train_off)
+    assert "accuracy" in m_on and "accuracy" not in m_off
+    assert m_off["loss"] == pytest.approx(m_on["loss"], rel=1e-6)

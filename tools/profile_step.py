@@ -139,7 +139,8 @@ def capture(args) -> None:
             input_dtype=jnp.int32)
         step = make_tp_lm_train_step(
             mesh, model=model, donate=True,
-            ce_chunk=args.ce_chunk)
+            ce_chunk=args.ce_chunk,
+            accuracy_metric=not args.no_accuracy)
         tokens = np.random.RandomState(0).randint(
             0, 50304, (args.batch_size, args.seq_len + 1)).astype(np.int32)
         batch = jax.device_put(
@@ -241,6 +242,7 @@ def main():
     ap.add_argument("--seq-len", type=int, default=1024)
     ap.add_argument("--attn-impl", default="flash")
     ap.add_argument("--ce-chunk", type=int, default=None)
+    ap.add_argument("--no-accuracy", action="store_true", default=False)
     ap.add_argument("--warmup", type=int, default=4)
     ap.add_argument("--trace-steps", type=int, default=3)
     ap.add_argument("--top", type=int, default=15)
