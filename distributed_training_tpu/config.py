@@ -124,6 +124,11 @@ class ZeroConfig:
     reduce_bucket_size: int = 50_000_000
     overlap_comm: bool = True
     contiguous_gradients: bool = True
+    # ZeRO-Offload (functional, round 4): the sharded optimizer state lives
+    # in pinned HOST memory; the step fetches the shard on-device for the
+    # update and streams it back (``parallel/sharding.py``,
+    # ``train/step.py::fetch_offloaded_opt_state``). Requires stage >= 1
+    # (validated); trades step time for ~12 bytes/param of HBM.
     cpu_offload: bool = False
 
 
@@ -147,6 +152,12 @@ class MoEConfig:
     min_capacity: int = 0
     capacity_factor: float = 1.25
     noisy_gate_policy: str | None = None  # None | RSample | Jitter
+    # DeepSpeed ``--moe-param-group``: split expert params into their own
+    # optimizer groups so ZeRO partitions their state per expert-parallel
+    # group (deepspeed_train.py:103-106). Here the rule table always keeps
+    # expert moments expert-sharded (that IS the flag's semantics), so the
+    # flag is a contract marker: ZeRO×EP *requires* it (LMTrainer raises
+    # otherwise) instead of silently implying it.
     moe_param_group: bool = False
 
 

@@ -60,6 +60,7 @@ def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
 
 def zero_leaf_sharding(
     leaf: Any, mesh: Mesh, axes: tuple[str, ...], *, base: P | None = None,
+    memory_kind: str | None = None,
 ) -> NamedSharding:
     """Shard one state tensor over ``axes`` (ZeRO partitioning rule).
 
@@ -75,18 +76,19 @@ def zero_leaf_sharding(
     megatron groups.
     """
     base = base if base is not None else P()
+    kw = {"memory_kind": memory_kind} if memory_kind else {}
     shape = dict(zip(mesh.axis_names, mesh.devices.shape))
     n = int(np.prod([shape.get(a, 1) for a in axes]))
     if n <= 1 or not hasattr(leaf, "shape") or leaf.ndim == 0:
-        return NamedSharding(mesh, base)
+        return NamedSharding(mesh, base, **kw)
     entries = list(base) + [None] * (leaf.ndim - len(base))
     dims = [(leaf.shape[i], i) for i, e in enumerate(entries)
             if e is None and leaf.shape[i] % n == 0 and leaf.shape[i] >= n]
     if not dims:
-        return NamedSharding(mesh, base)
+        return NamedSharding(mesh, base, **kw)
     _, best = max(dims)
     entries[best] = axes if len(axes) > 1 else axes[0]
-    return NamedSharding(mesh, P(*entries))
+    return NamedSharding(mesh, P(*entries), **kw)
 
 
 def zero_stage_axes(mesh: Mesh, zero_stage: int) -> tuple[tuple, tuple]:
@@ -122,17 +124,37 @@ def _tree_shardings(tree: Any, mesh: Mesh, axes: tuple[str, ...], shard: bool):
     return jax.tree.map(lambda x: zero_leaf_sharding(x, mesh, axes), tree)
 
 
-def state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0):
+def check_cpu_offload(cpu_offload: bool, zero_stage: int) -> None:
+    """The ds_config ``cpu_offload`` contract: host placement of the
+    *sharded* optimizer state (DeepSpeed ZeRO-Offload,
+    ``resnet/deepspeed/deepspeed_train.py:218``). Stage 0 has no sharded
+    optimizer partition to offload — DeepSpeed likewise ties offload to
+    ZeRO ≥ 1 — so accepting it would silently mean nothing."""
+    if cpu_offload and zero_stage < 1:
+        raise ValueError(
+            "cpu_offload requires a ZeRO stage >= 1 (it offloads the "
+            "per-replica optimizer-state shard to host memory; stage 0 "
+            "keeps the full state replicated on device)")
+
+
+def state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0,
+                    cpu_offload: bool = False):
     """Shardings for a full TrainState pytree per ZeRO stage.
 
     Returns a pytree of NamedSharding congruent with ``state``; axis
     recruitment per stage lives in :func:`zero_stage_axes`.
+    ``cpu_offload`` places the (sharded) optimizer state in pinned host
+    memory — ZeRO-Offload semantics; the train step moves it to device for
+    the update and jit's out_shardings write it back (see
+    ``train/step.py``).
     """
+    check_cpu_offload(cpu_offload, zero_stage)
     param_axes, opt_axes = zero_stage_axes(mesh, zero_stage)
+    opt_mem = "pinned_host" if cpu_offload else None
 
     params_sh = _tree_shardings(state.params, mesh, param_axes, bool(param_axes))
     opt_sh = jax.tree.map(
-        lambda x: zero_leaf_sharding(x, mesh, opt_axes) if opt_axes else replicated(mesh),
+        lambda x: zero_leaf_sharding(x, mesh, opt_axes, memory_kind=opt_mem),
         state.opt_state,
     )
     batch_stats_sh = jax.tree.map(lambda _: replicated(mesh), state.batch_stats)

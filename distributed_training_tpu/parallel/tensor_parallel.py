@@ -82,6 +82,7 @@ def tp_tree_shardings(
     mesh: Mesh,
     *,
     extra_axes: tuple[str, ...] = (),
+    memory_kind: str | None = None,
 ) -> Any:
     """NamedShardings for every leaf of ``tree`` by the TP rule table.
 
@@ -95,30 +96,41 @@ def tp_tree_shardings(
 
     # Rules are applied unconditionally: a spec over a size-1 mesh axis is a
     # no-op shard, so the same table serves pure-DP, TP, and EP meshes.
+    kw = {"memory_kind": memory_kind} if memory_kind else {}
+
     def leaf_sharding(path, leaf):
         spec = tp_spec_for_path(path_str(path))
         if extra_axes:
-            return zero_leaf_sharding(leaf, mesh, extra_axes, base=spec)
-        return NamedSharding(mesh, spec)
+            return zero_leaf_sharding(leaf, mesh, extra_axes, base=spec,
+                                      memory_kind=memory_kind)
+        return NamedSharding(mesh, spec, **kw)
 
     return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
 
 
-def tp_state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0):
+def tp_state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0,
+                       cpu_offload: bool = False):
     """Shardings for a full TrainState under TP (+ optional ZeRO stages).
 
     Mirrors :func:`distributed_training_tpu.parallel.sharding.state_shardings`
     but lays the ``model`` axis through the transformer weights first, then
     recruits data/fsdp for optimizer (stage≥1) / parameter (stage≥3) sharding
     on the remaining dims (stage→axes mapping shared via
-    ``sharding.zero_stage_axes``).
+    ``sharding.zero_stage_axes``). ``cpu_offload`` places the optimizer
+    state in pinned host memory (ZeRO-Offload; see ``sharding.py``).
     """
-    from distributed_training_tpu.parallel.sharding import zero_stage_axes
+    from distributed_training_tpu.parallel.sharding import (
+        check_cpu_offload,
+        zero_stage_axes,
+    )
 
+    check_cpu_offload(cpu_offload, zero_stage)
     param_axes, opt_axes = zero_stage_axes(mesh, zero_stage)
 
     params_sh = tp_tree_shardings(state.params, mesh, extra_axes=param_axes)
-    opt_sh = tp_tree_shardings(state.opt_state, mesh, extra_axes=opt_axes)
+    opt_sh = tp_tree_shardings(
+        state.opt_state, mesh, extra_axes=opt_axes,
+        memory_kind="pinned_host" if cpu_offload else None)
     repl = NamedSharding(mesh, P())
     batch_stats_sh = jax.tree.map(lambda _: repl, state.batch_stats)
     scale_sh = jax.tree.map(lambda _: repl, state.loss_scale)

@@ -173,9 +173,23 @@ def _accum_grads_and_stats(state: TrainState, batch, rng, accum_steps: int,
     return grads, losses.mean(), accs.mean(), new_bs
 
 
+def fetch_offloaded_opt_state(state: TrainState) -> TrainState:
+    """Move a pinned-host optimizer state to device memory (inside jit).
+
+    The entry half of ZeRO-Offload: with ``cpu_offload`` the jitted step's
+    in/out shardings keep the optimizer state in ``pinned_host`` memory;
+    this transfer brings the shard on-device for the update, and jit's
+    out_shardings stream the updated shard back — XLA schedules both
+    around the compute. (Offload placement: ``parallel/sharding.py``.)
+    """
+    return state.replace(opt_state=jax.device_put(
+        state.opt_state, jax.memory.Space.Device))
+
+
 def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
                accum_steps: int = 1, mesh: Mesh | None = None,
-               label_smoothing: float = 0.0, input_affine=None):
+               label_smoothing: float = 0.0, input_affine=None,
+               cpu_offload: bool = False):
     """Shared step body for the GSPMD and shard_map paths.
 
     When ``axis_name`` is set (shard_map path), gradients/metrics are
@@ -186,6 +200,8 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
     shard_map the scan runs shard-locally and the one pmean follows
     (equal microbatches ⇒ mean of micro-means is the full mean).
     """
+    if cpu_offload:
+        state = fetch_offloaded_opt_state(state)
     if accum_steps > 1:
         grads, loss, accuracy, new_batch_stats = _accum_grads_and_stats(
             state, batch, rng, accum_steps, mesh, label_smoothing,
@@ -249,6 +265,7 @@ def make_train_step(
     grad_accum_steps: int = 1,
     label_smoothing: float = 0.0,
     input_affine: tuple | None = None,
+    cpu_offload: bool = False,
 ) -> Callable:
     """Build the GSPMD jitted train step for a mesh + ZeRO stage.
 
@@ -270,7 +287,8 @@ def make_train_step(
         treedef = jax.tree.structure((state, batch))
         fn = cache.get(treedef)
         if fn is None:
-            sshard = state_shardings(state, mesh, zero_stage)
+            sshard = state_shardings(state, mesh, zero_stage,
+                                     cpu_offload=cpu_offload)
             bshard = {
                 "image": batch_sharding(mesh, batch["image"].ndim),
                 "label": batch_sharding(mesh, batch["label"].ndim),
@@ -281,7 +299,8 @@ def make_train_step(
                     accum_steps=grad_accum_steps,
                     mesh=mesh if grad_accum_steps > 1 else None,
                     label_smoothing=label_smoothing,
-                    input_affine=input_affine),
+                    input_affine=input_affine,
+                    cpu_offload=cpu_offload),
                 in_shardings=(sshard, bshard, replicated(mesh)),
                 out_shardings=(sshard, replicated(mesh)),
                 donate_argnums=(0,) if donate else (),

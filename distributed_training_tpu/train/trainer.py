@@ -110,7 +110,8 @@ class Trainer:
         state = init_train_state(
             self.model, init_rng, input_shape, self.tx,
             loss_scale=LossScaleState.create(cfg.precision))
-        self.shardings = state_shardings(state, self.mesh, cfg.zero.stage)
+        self.shardings = state_shardings(state, self.mesh, cfg.zero.stage,
+                                         cpu_offload=cfg.zero.cpu_offload)
         self.state = place_state(state, self.shardings)
 
         # Local-vs-sync BN only differs for models that actually carry
@@ -139,13 +140,18 @@ class Trainer:
                 self.mesh, zero_stage=cfg.zero.stage,
                 grad_accum_steps=self.grad_accum,
                 label_smoothing=cfg.label_smoothing,
-                input_affine=input_affine)
+                input_affine=input_affine,
+                cpu_offload=cfg.zero.cpu_offload)
         else:
             if cfg.zero.stage != 0:
                 raise NotImplementedError(
                     "sync_batchnorm=False uses the explicit shard_map DP "
                     "step, which has no ZeRO sharding; use zero stage 0 "
                     "with local BN")
+            if cfg.zero.cpu_offload:
+                raise NotImplementedError(
+                    "cpu_offload rides the ZeRO opt-state sharding of the "
+                    "GSPMD step; the local-BN shard_map step has neither")
             self.train_step = make_shard_map_train_step(
                 self.mesh, label_smoothing=cfg.label_smoothing,
                 input_affine=input_affine,
