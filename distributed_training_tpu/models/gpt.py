@@ -115,8 +115,14 @@ def make_final_norm(m: "TransformerLM", name: str | None = None) -> nn.LayerNorm
 
 
 def make_lm_head(m: "TransformerLM", name: str | None = None) -> nn.Dense:
-    # Untied head; fp32 logits for a stable softmax under bf16 compute.
-    return nn.Dense(m.vocab_size, dtype=jnp.float32, name=name)
+    # Untied head. Default fp32 logits (stable softmax under bf16 compute);
+    # logits_dtype=bf16 halves the [B, T, vocab] HBM round-trips — at
+    # GPT-2-small B16 T1024 the fp32 logits are 3.3 GB/step written forward
+    # and re-read twice backward, the profiled top cost of the whole step
+    # (profiles/gpt_t1024_r4.json: the head fusions at 330-420 GB/s). The
+    # CE still reduces in fp32 (the loss path upcasts in-register); only
+    # the stored logits are rounded, a ~2^-8 relative perturbation.
+    return nn.Dense(m.vocab_size, dtype=m.logits_dtype, name=name)
 
 
 def add_pos_embed(m: "TransformerLM", pos_tab, x, positions):
@@ -138,6 +144,7 @@ class TransformerLM(nn.Module):
     mlp_ratio: int = 4
     max_len: int = 2048
     dtype: Any = jnp.float32
+    logits_dtype: Any = jnp.float32  # see make_lm_head
     seq_axis: str | None = None
     dropout_rate: float = 0.0
     attn_impl: str = "exact"  # exact | flash (pallas kernel, unsharded path)
@@ -259,6 +266,7 @@ def make_transformer_lm(
     moe_mlp_type: str = "standard",
     moe_expert_axis: str | None = None,
     remat: bool = False,
+    logits_dtype: Any = jnp.float32,
 ) -> TransformerLM:
     """Registry factory. ``num_classes`` doubles as vocab size; ``axis_name``
     (the registry's SyncBN slot) is unused — LM has no BatchNorm. Unknown
@@ -285,4 +293,5 @@ def make_transformer_lm(
         moe_mlp_type=moe_mlp_type,
         moe_expert_axis=moe_expert_axis,
         remat=remat,
+        logits_dtype=logits_dtype,
     )

@@ -136,9 +136,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         # Fully-masked rows (causal warmup of padded blocks) have l == 0.
         o_ref[0] = jnp.where(
             lsum > 0, acc[:] / lsum, 0.0).astype(o_ref.dtype)
-        # 128-lane broadcast layout: Mosaic requires the last block dim be
-        # 128 (or the full array dim), so the per-row logsumexp is stored
-        # replicated across lanes — same trick as jax's reference kernel.
+        # LSE_LANES-wide broadcast layout: Mosaic requires the last block
+        # dim be a 128-multiple OR span the full array dim; the sidecar's
+        # minor dim is LSE_LANES (= the whole array dim), so the per-row
+        # logsumexp is stored replicated across those lanes.
         lse_ref[0] = jnp.broadcast_to(
             m[:, :1] + jnp.log(jnp.maximum(lsum, 1e-30)), lse_ref.shape[1:])
 
@@ -249,22 +250,148 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, causal, block_q, block_k, interpret, g_lse=None):
+def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, dk, dv,
+                      *, scale, causal, block_q, block_k, nq):
+    """One kernel for all three gradients — the round-4 backward.
+
+    The separate dq / dkv kernels each recomputed the masked scores and the
+    softmax probabilities (7 tile matmuls + two mask/exp chains per [bq, bk]
+    tile in total); fusing shares s, p and dp across the three gradient
+    contractions (5 matmuls + one chain). dk/dv accumulate in VMEM scratch
+    across the inner q sweep exactly as before; dq cannot (its block index
+    varies along the INNER grid dim), so each k block writes its own partial
+    dq tile to HBM and XLA sums the ``nk`` partials outside the kernel —
+    the same partial-accumulation layout jax's fused splash-attention
+    backward uses. At the default blocks the partial sum is 1-2 extra
+    passes over dq, far cheaper than a second score recompute sweep.
+    """
+    qi = pl.program_id(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _():
+        dk[:] = jnp.zeros_like(dk)
+        dv[:] = jnp.zeros_like(dv)
+
+    live = _live_block(qi, ki, causal=causal, block_q=block_q,
+                       block_k=block_k)
+
+    @pl.when(live)
+    def _():
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        do = do_ref[0]
+        # dV += P^T dO — p in the output-grad dtype, fp32 accumulation.
+        dv[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0][:, :1])).astype(q_ref.dtype)
+        # dK += dS^T Q
+        dk[:] += scale * jax.lax.dot_general(
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dQ partial for this k block (summed over k blocks outside).
+        dq_ref[0, 0] = scale * jax.lax.dot_general(
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(jnp.logical_not(live))
+    def _():
+        # Dead causal tiles still own a partial-dq slot in HBM: zero it so
+        # the outside sum reads defined memory.
+        dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv[:].astype(dv_ref.dtype)
+
+
+# A/B switch for tools/flash_kernel_bench.py --split-bwd; the model path
+# always runs the fused backward.
+_USE_SPLIT_BWD = False
+
+
+def _bwd_prologue(res, g, block_q, block_k, g_lse):
+    """Shared backward prep: block math and the delta sidecar.
+
+    ``g_lse`` is the cotangent of lse as a differentiable OUTPUT (the
+    ring-hop composition): it folds into the delta term —
+    ``ds = p·(dp − δ + ḡ_lse)`` because ∂lse_i/∂s_ij = p_ij — so the same
+    backward kernels serve both the plain and the (out, lse) variants.
+    """
     q, k, v, out, lse = res
-    bh, t, d = q.shape
+    t, d = q.shape[-2:]
     bq = _block(t, block_q)
     bk = _block(t, block_k)
-    nq, nk = t // bq, t // bk
     scale = 1.0 / (d ** 0.5)
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     if g_lse is not None:
-        # lse as a differentiable OUTPUT (the ring-hop composition): its
-        # cotangent folds into the delta term — ds = p·(dp − δ + ḡ_lse)
-        # because ∂lse_i/∂s_ij = p_ij — so the two backward kernels serve
-        # both the plain and the (out, lse) variants unchanged.
         delta = delta - g_lse.astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None],
                              (*delta.shape, LSE_LANES))
+    return q, k, v, lse, delta, bq, bk, t // bq, t // bk, scale
+
+
+def _flash_bwd(res, g, *, causal, block_q, block_k, interpret, g_lse=None):
+    if _USE_SPLIT_BWD:
+        return _flash_bwd_split(res, g, causal=causal, block_q=block_q,
+                                block_k=block_k, interpret=interpret,
+                                g_lse=g_lse)
+    q, k, v, lse, delta, bq, bk, nq, nk, scale = _bwd_prologue(
+        res, g, block_q, block_k, g_lse)
+    bh, t, d = q.shape
+
+    dq_partial, dk, dv = pl.pallas_call(
+        functools.partial(_fused_bwd_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LSE_LANES), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b, j, i: (j, b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nk, bh, t, d), jnp.float32),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    dq = (dq_partial[0] if nk == 1
+          else dq_partial.sum(axis=0)).astype(q.dtype)
+    return dq, dk, dv
+
+
+def _flash_bwd_split(res, g, *, causal, block_q, block_k, interpret,
+                     g_lse=None):
+    """The pre-round-4 two-kernel backward (dq sweep; dk/dv sweep).
+
+    Kept for A/B measurement (``tools/flash_kernel_bench.py --split-bwd``)
+    and as the fallback shape for tilings where the fused kernel's
+    partial-dq HBM cost could exceed the saved recompute (nk large with
+    tiny blocks). Not reachable from the model path.
+    """
+    q, k, v, lse, delta, bq, bk, nq, nk, scale = _bwd_prologue(
+        res, g, block_q, block_k, g_lse)
+    bh, t, d = q.shape
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -388,12 +515,12 @@ def flash_attention(
     TPU, interpret mode elsewhere (bit-compatible semantics).
 
     Block sizes default to the v5e-measured auto rule: forward
-    ``min(T, 1024) × min(T, 2048)`` (round-2 sweep, bf16 causal fwd+bwd:
-    T1024 GPT-2-small shape 6.9 ms vs 7.6 ms at the old 512×512; T4096
-    10.7 ms vs 21.3 ms; T16384 39 ms vs 59 ms — wide K blocks keep the MXU
-    fed and amortize the recurrence), backward ``min(T, 512) ×
-    min(T, 1024)`` (the dq/dkv kernels hold more operands per tile; bigger
-    bwd blocks blow the 16 MB scoped-VMEM stack inside full train steps).
+    ``min(T, 1024) × min(T, 2048)`` (round-2 sweep: wide K blocks keep the
+    MXU fed and amortize the recurrence), backward ``min(T, 512) ×
+    min(T, 2048)`` (round-4 sweep over the FUSED backward kernel, bf16
+    causal fwd+bwd: T1024 6.15 ms / T4096 6.77 ms / T16384 49.8 ms vs
+    7.9 / 9.7 / 63.2 for the round-3 two-kernel backward at its auto
+    blocks; wider q or k blocks fail Mosaic compile at T≥4096 — VMEM).
     T must divide by the block, so shorter/odd sequences clamp via
     ``_block``.
     """
@@ -416,9 +543,9 @@ def _flat_args(q, k, v, block_q, block_k, bwd_block_q, bwd_block_k,
     if block_k is None:
         block_k = min(t, 2048)
     if bwd_block_q is None:
-        bwd_block_q = min(t, 1024)
+        bwd_block_q = min(t, 512)
     if bwd_block_k is None:
-        bwd_block_k = min(t, 1024)
+        bwd_block_k = min(t, 2048)
     qf = q.reshape((-1, t, d))
     kf = k.reshape((-1, t, d))
     vf = v.reshape((-1, t, d))

@@ -201,11 +201,12 @@ def _flat_init(rng, shape, dtype, n_in_dims: int):
 
 
 class _QKVProj(nn.Module):
-    """QKV projection emitting the attention-native [3, B, H, T, d] layout.
+    """QKV projection emitting q/k/v in the attention-native [B, H, T, d]
+    layout as a tuple.
 
     Parameter-compatible with ``nn.DenseGeneral(features=(3, H, d),
     name='qkv')`` — same ``kernel``/``bias`` shapes, same init draws — but
-    the head/time transpose lives in the einsum's OUTPUT indexing, where
+    the head/time transpose lives in each einsum's OUTPUT indexing, where
     XLA folds it into the matmul epilogue, instead of as a separate
     [B, T, H, d] → [B, H, T, d] HBM pass after the projection (measured at
     ~5% of the GPT step, ``profiles/gpt_t1024.json``)."""
@@ -224,9 +225,21 @@ class _QKVProj(nn.Module):
         bias = self.param(
             "bias", nn.initializers.zeros,
             (3, self.num_heads, self.head_dim), self.param_dtype)
-        y = jnp.einsum("btm,mshd->sbhtd", x.astype(self.dtype),
-                       kernel.astype(self.dtype))
-        return y + bias.astype(self.dtype)[:, None, :, None, :]
+        # One einsum per q/k/v over a PARAM slice (tiny), not one fused
+        # einsum sliced afterwards: the q/k/v consumers are Pallas custom
+        # calls, whose operands cannot fuse a producer — slicing a fused
+        # [3, B, H, T, d] output materializes three full activation copies
+        # (profiled at ~0.29 ms × 12 blocks forward, plus the mirrored
+        # backward concat, profiles/gpt_t1024_r4e.json). Param layout is
+        # unchanged (still DenseGeneral-compatible).
+        xc = x.astype(self.dtype)
+        kc = kernel.astype(self.dtype)
+        bc = bias.astype(self.dtype)
+        q, k, v = (
+            jnp.einsum("btm,mhd->bhtd", xc, kc[:, s])
+            + bc[s][None, :, None, :]
+            for s in range(3))
+        return q, k, v
 
 
 class _OutProj(nn.Module):
@@ -332,10 +345,9 @@ class RingSelfAttention(nn.Module):
         # Projections emit/consume the attention-native [B, H, T, d] layout
         # directly: the head/time permutation rides the matmul epilogues
         # instead of standalone transpose passes over the activations.
-        qkv = _QKVProj(
+        q, k, v = _QKVProj(
             num_heads=self.num_heads, head_dim=head_dim, dtype=self.dtype,
-            param_dtype=self.param_dtype, name="qkv")(x)
-        q, k, v = qkv[0], qkv[1], qkv[2]  # each [B, H, T, hd]
+            param_dtype=self.param_dtype, name="qkv")(x)  # each [B, H, T, hd]
 
         if decode:
             if self.axis_name is not None:

@@ -30,7 +30,6 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -65,8 +64,49 @@ def _global_positions(t_local: int):
     return (seq_idx * t_local + jnp.arange(t_local))[None, :]
 
 
+def model_logits_dtype(model):
+    """Head compute dtype of ``model`` (fp32 when absent/None) — the single
+    resolver for every step/eval builder, so a bf16-logits model gets the
+    same CE math on the chunked, unchunked, train, and eval paths."""
+    return getattr(model, "logits_dtype", jnp.float32)
+
+
+def _fused_softmax_ce(logits, targets):
+    """Mean CE as ``logsumexp − label_logit``, fusion-friendly.
+
+    ``optax.softmax_cross_entropy_with_integer_labels`` goes through
+    ``log_softmax``, which materializes a full fp32 [B, T, vocab] log-prob
+    tensor — at GPT-2-small B16 T1024 a 3.3 GB HBM round-trip the profiler
+    shows as its own 7.6 ms convert/loop fusion
+    (profiles/gpt_t1024_r4b.json, fusion.1592). This form reduces straight
+    out of the (bf16 or fp32) logits: the max and sum-exp passes fuse with
+    the upcast in registers, and only [B, T] rows land in HBM. Same math,
+    fp32 accumulation; the backward rematerializes ``softmax − onehot``
+    into the head-matmul fusions instead of reading saved log-probs.
+    """
+    return _fused_ce_rows(logits, targets).mean()
+
+
+def _fused_ce_rows(logits, targets):
+    """Per-row CE ([..., vocab] logits → [...] fp32), fusion-friendly.
+
+    Max and gather read the logits in their STORED dtype (a gather's
+    operand cannot fuse, so gathering from an fp32 cast would materialize
+    the full cast tensor — the exact round-trip this form removes); only
+    the sum-exp reduction sees the in-register fp32 upcast.
+    """
+    m = lax.stop_gradient(
+        jnp.max(logits, axis=-1, keepdims=True)).astype(jnp.float32)
+    lse = jnp.log(jnp.sum(
+        jnp.exp(logits.astype(jnp.float32) - m), axis=-1)) + m[..., 0]
+    lab = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    return lse - lab
+
+
 def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
-                            accuracy_metric: bool = True):
+                            accuracy_metric: bool = True,
+                            logits_dtype=jnp.float32):
     """CE + token accuracy WITHOUT materializing the [B, T, vocab] logits.
 
     For long contexts × large vocabs the logits tensor dominates memory
@@ -75,15 +115,17 @@ def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
     time, and reduce CE/accuracy to scalars. The body is
     ``jax.checkpoint``-ed so the backward also recomputes each chunk's
     logits instead of saving softmax residuals (which would re-create the
-    full tensor). Math matches ``make_lm_head`` exactly: fp32 matmul
-    (``gpt.py::make_lm_head`` sets dtype=fp32, which promotes inputs).
+    full tensor). Math matches ``make_lm_head`` exactly: callers pass the
+    model's ``logits_dtype`` so the per-chunk matmul runs in the same
+    dtype the unchunked head would (the CE reduction is fp32 either way,
+    :func:`_fused_ce_rows`).
     """
     b, t, d = hidden.shape
     if t % chunk:
         raise ValueError(f"ce_chunk {chunk} must divide sequence length {t}")
     n = t // chunk
-    w = head_params["kernel"].astype(jnp.float32)
-    bias = head_params["bias"].astype(jnp.float32)
+    w = head_params["kernel"].astype(logits_dtype)
+    bias = head_params["bias"].astype(logits_dtype)
     hs = jnp.swapaxes(hidden.reshape(b, n, chunk, d), 0, 1)  # [n, B, C, D]
     ts = jnp.swapaxes(targets.reshape(b, n, chunk), 0, 1)    # [n, B, C]
 
@@ -91,8 +133,8 @@ def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
     def body(carry, xs):
         ce_sum, acc_sum = carry
         hc, tc = xs
-        logits = hc.astype(jnp.float32) @ w + bias
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits, tc).sum()
+        logits = hc.astype(logits_dtype) @ w + bias
+        ce = _fused_ce_rows(logits, tc).sum()
         acc = (jnp.sum((jnp.argmax(logits, -1) == tc).astype(jnp.float32))
                if accuracy_metric else jnp.float32(0))
         return (ce_sum + ce, acc_sum + acc), None
@@ -105,7 +147,8 @@ def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
 
 def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
                        positions=None, ce_chunk: int | None = None,
-                       accuracy_metric: bool = True):
+                       accuracy_metric: bool = True,
+                       logits_dtype=jnp.float32):
     """Scaled-CE (+ MoE aux) value-and-grad shared by every LM step variant.
 
     Returns ``(grads, ce, aux, accuracy)`` — CE and the MoE load-balancing
@@ -135,7 +178,7 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
                 hidden, aux = out, jnp.float32(0)
             ce, accuracy = chunked_ce_and_accuracy(
                 hidden, params["lm_head"], targets, ce_chunk,
-                accuracy_metric=accuracy_metric)
+                accuracy_metric=accuracy_metric, logits_dtype=logits_dtype)
             return state.loss_scale.scale_loss(ce + aux), (ce, aux, accuracy)
         out = state.apply_fn(
             {"params": params}, tokens, positions=positions, train=True,
@@ -145,8 +188,7 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
             aux = sown_aux(mutated)
         else:  # PipelinedLM.apply_fn (no collections)
             logits, aux = out, jnp.float32(0)
-        ce = optax.softmax_cross_entropy_with_integer_labels(
-            logits, targets).mean()
+        ce = _fused_softmax_ce(logits, targets)
         accuracy = (jnp.mean(
             (jnp.argmax(logits, -1) == targets).astype(jnp.float32))
             if accuracy_metric else None)
@@ -184,7 +226,8 @@ def _lm_metrics(new_state: TrainState, ce, aux, accuracy, finite,
 
 def _lm_accum_grads(state: TrainState, batch, rng, accum: int,
                     mesh, ce_chunk: int | None, positions=None,
-                    accuracy_metric: bool = True):
+                    accuracy_metric: bool = True,
+                    logits_dtype=jnp.float32):
     """Shared LM accumulation wrapper over ``accumulate_grads``: scan
     microbatches through fwd/bwd, average grads and metrics. ``mesh=None``
     runs shard-locally (the sequence step's partial-manual body);
@@ -196,7 +239,7 @@ def _lm_accum_grads(state: TrainState, batch, rng, accum: int,
         g, ce, aux, acc = _lm_loss_and_grads(
             state.replace(params=params), mbatch["tokens"],
             mbatch["targets"], r, positions=positions, ce_chunk=ce_chunk,
-            accuracy_metric=accuracy_metric)
+            accuracy_metric=accuracy_metric, logits_dtype=logits_dtype)
         return g, carry, (ce, aux, acc)
 
     grads, _, (ces, auxs, accs) = accumulate_grads(
@@ -208,7 +251,8 @@ def _lm_accum_grads(state: TrainState, batch, rng, accum: int,
 
 def _lm_grads_body(gstate: TrainState, batch, rng,
                    ce_chunk: int | None = None, accum: int = 1,
-                   accuracy_metric: bool = True):
+                   accuracy_metric: bool = True,
+                   logits_dtype=jnp.float32):
     """The manual (shard_map) half of the sequence-parallel step: compute
     the globally-averaged, unscaled gradient and the shard-averaged metric
     scalars. The optimizer commit deliberately happens OUTSIDE the manual
@@ -231,11 +275,12 @@ def _lm_grads_body(gstate: TrainState, batch, rng,
         grads, ce, aux, accuracy = _lm_accum_grads(
             gstate, {"tokens": tokens, "targets": targets}, shard_rng,
             accum, None, ce_chunk, positions=positions,
-            accuracy_metric=accuracy_metric)
+            accuracy_metric=accuracy_metric, logits_dtype=logits_dtype)
     else:
         grads, ce, aux, accuracy = _lm_loss_and_grads(
             gstate, tokens, targets, shard_rng, positions=positions,
-            ce_chunk=ce_chunk, accuracy_metric=accuracy_metric)
+            ce_chunk=ce_chunk, accuracy_metric=accuracy_metric,
+            logits_dtype=logits_dtype)
     grads = lax.pmean(grads, _GRAD_AXES)
     grads = gstate.loss_scale.unscale_grads(grads)
     ce = lax.pmean(ce, _GRAD_AXES)
@@ -249,7 +294,8 @@ def make_lm_train_step(
     mesh: Mesh, *, model=None, max_len: int | None = None,
     donate: bool = True, ce_chunk: int | None = None,
     grad_accum_steps: int = 1, zero_stage: int = 0,
-    accuracy_metric: bool = True,
+    accuracy_metric: bool = True, cpu_offload: bool = False,
+    logits_dtype=None,
 ) -> Callable:
     """Build the (data × sequence)-parallel jitted LM train step.
 
@@ -292,6 +338,17 @@ def make_lm_train_step(
 
     if (model is None) == (max_len is None):
         raise ValueError("pass exactly one of model= or max_len=")
+    if logits_dtype is None:
+        if model is None and ce_chunk:
+            # The chunked CE re-applies the head OUTSIDE the model, so it
+            # must know the head's compute dtype; with only max_len= there
+            # is no model to read it from, and silently assuming fp32
+            # would diverge from a bf16-logits model's own head/eval math.
+            raise ValueError(
+                "ce_chunk with max_len= needs an explicit logits_dtype= "
+                "(pass model= to derive it, or logits_dtype=jnp.float32/"
+                "bfloat16 matching the model's head)")
+        logits_dtype = model_logits_dtype(model)
     if model is not None:
         max_len = model.max_len
     batch_spec = SP_BATCH_SPEC
@@ -302,16 +359,26 @@ def make_lm_train_step(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
 
     def state_shardings_fn(state: TrainState):
-        return tp_state_shardings(state, mesh, zero_stage=zero_stage)
+        return tp_state_shardings(state, mesh, zero_stage=zero_stage,
+                                  cpu_offload=cpu_offload)
 
     batch_sh = {k: NamedSharding(mesh, s) for k, s in batch_spec.items()}
 
     def body(state: TrainState, batch, rng):
+        if cpu_offload:
+            from distributed_training_tpu.train.step import (
+                fetch_offloaded_opt_state,
+            )
+
+            # The manual region never touches opt_state (gstate strips it);
+            # the on-device copy only feeds the GSPMD commit below.
+            state = fetch_offloaded_opt_state(state)
         gstate = state.replace(opt_state=None)
         sharded = shard_map(
             functools.partial(_lm_grads_body, ce_chunk=ce_chunk,
                               accum=grad_accum_steps,
-                              accuracy_metric=accuracy_metric), mesh,
+                              accuracy_metric=accuracy_metric,
+                              logits_dtype=logits_dtype), mesh,
             in_specs=(jax.tree.map(lambda _: P(), gstate), batch_spec, P()),
             out_specs=(jax.tree.map(lambda _: P(), state.params), P()),
             axis_names=axis_names,
@@ -400,12 +467,12 @@ def make_lm_eval_fn(
                 {"params": params}, tokens, positions=positions,
                 train=False, return_hidden=True)
             ce, _ = chunked_ce_and_accuracy(
-                hidden, params["lm_head"], targets, ce_chunk)
+                hidden, params["lm_head"], targets, ce_chunk,
+                logits_dtype=model_logits_dtype(model))
         else:
             logits = model.apply(
                 {"params": params}, tokens, positions=positions, train=False)
-            ce = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets).mean()
+            ce = _fused_softmax_ce(logits, targets)
         return lax.pmean(ce, _GRAD_AXES)
 
     @jax.jit
@@ -438,6 +505,8 @@ def _make_gspmd_lm_step(
     grad_accum_steps: int = 1,
     ce_chunk: int | None = None,
     accuracy_metric: bool = True,
+    logits_dtype=jnp.float32,
+    cpu_offload: bool = False,
 ) -> Callable:
     """Shared GSPMD LM step builder (the TP and PP steps differ only in how
     the train state is placed): batch over ``data``, lazy jit once a
@@ -454,14 +523,21 @@ def _make_gspmd_lm_step(
                 "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
 
     def body(state: TrainState, batch, rng):
+        if cpu_offload:
+            from distributed_training_tpu.train.step import (
+                fetch_offloaded_opt_state,
+            )
+
+            state = fetch_offloaded_opt_state(state)
         if grad_accum_steps > 1:
             grads, ce, aux, accuracy = _lm_accum_grads(
                 state, batch, rng, grad_accum_steps, mesh, ce_chunk,
-                accuracy_metric=accuracy_metric)
+                accuracy_metric=accuracy_metric, logits_dtype=logits_dtype)
         else:
             grads, ce, aux, accuracy = _lm_loss_and_grads(
                 state, batch["tokens"], batch["targets"], rng,
-                ce_chunk=ce_chunk, accuracy_metric=accuracy_metric)
+                ce_chunk=ce_chunk, accuracy_metric=accuracy_metric,
+                logits_dtype=logits_dtype)
         grads = state.loss_scale.unscale_grads(grads)
         new_state, finite = commit_gradients(state, grads)
         return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
@@ -473,7 +549,7 @@ def _make_gspmd_lm_step(
 def make_tp_lm_train_step(
     mesh: Mesh, *, model, zero_stage: int = 0, donate: bool = True,
     grad_accum_steps: int = 1, ce_chunk: int | None = None,
-    accuracy_metric: bool = True,
+    accuracy_metric: bool = True, cpu_offload: bool = False,
 ) -> Callable:
     """Tensor-parallel (megatron-style) LM train step via GSPMD placement.
 
@@ -506,10 +582,13 @@ def make_tp_lm_train_step(
             "seq_axis=None (ring attention needs the shard_map step)")
     return _make_gspmd_lm_step(
         mesh,
-        lambda state: tp_state_shardings(state, mesh, zero_stage=zero_stage),
+        lambda state: tp_state_shardings(state, mesh, zero_stage=zero_stage,
+                                         cpu_offload=cpu_offload),
         max_len=model.max_len, donate=donate,
         grad_accum_steps=grad_accum_steps, ce_chunk=ce_chunk,
-        accuracy_metric=accuracy_metric)
+        accuracy_metric=accuracy_metric,
+        logits_dtype=model_logits_dtype(model),
+        cpu_offload=cpu_offload)
 
 
 def make_pp_lm_train_step(
@@ -548,9 +627,10 @@ def make_pp_lm_train_step(
 
     # max_len is enforced inside PipelinedLM.apply_fn (statically), so the
     # shared builder doesn't need to re-check it.
-    step = _make_gspmd_lm_step(mesh, state_shardings, donate=donate,
-                               ce_chunk=ce_chunk,
-                               accuracy_metric=accuracy_metric)
+    step = _make_gspmd_lm_step(
+        mesh, state_shardings, donate=donate, ce_chunk=ce_chunk,
+        accuracy_metric=accuracy_metric,
+        logits_dtype=model_logits_dtype(model))
     step.pipelined = plm
     return step
 

@@ -193,3 +193,65 @@ class TestTrainerWiring:
         )
         with pytest.raises(ValueError, match="ce_chunk_size"):
             LMTrainer(cfg, mesh=mesh)
+
+
+class TestLogitsDtype:
+    """The bf16-logits throughput lever (models/gpt.py::make_lm_head)."""
+
+    def test_fused_ce_matches_optax_fp32(self):
+        rng = np.random.RandomState(1)
+        logits = jnp.asarray(rng.randn(4, 16, VOCAB) * 5, jnp.float32)
+        targets = jnp.asarray(rng.randint(0, VOCAB, (4, 16)), jnp.int32)
+        from distributed_training_tpu.train.lm_step import _fused_softmax_ce
+
+        want = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+        got = _fused_softmax_ce(logits, targets)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6)
+        gw = jax.grad(lambda l: optax.softmax_cross_entropy_with_integer_labels(
+            l, targets).mean())(logits)
+        gg = jax.grad(lambda l: _fused_softmax_ce(l, targets))(logits)
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(gw),
+                                   atol=1e-7, rtol=1e-5)
+
+    def test_bf16_logits_model_emits_bf16_and_tracks_fp32_loss(self):
+        model32 = _model(dtype=jnp.bfloat16)
+        model16 = _model(dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16)
+        params = model32.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, VOCAB, (2, 17)), jnp.int32)
+        batch = make_lm_batch(toks)
+        lo16 = model16.apply({"params": params}, batch["tokens"])
+        lo32 = model32.apply({"params": params}, batch["tokens"])
+        assert lo16.dtype == jnp.bfloat16
+        from distributed_training_tpu.train.lm_step import _fused_softmax_ce
+
+        ce16 = _fused_softmax_ce(lo16, batch["targets"])
+        ce32 = _fused_softmax_ce(lo32, batch["targets"])
+        assert ce16.dtype == jnp.float32
+        # bf16 rounding of the logits perturbs the loss by O(2^-8) relative.
+        np.testing.assert_allclose(np.asarray(ce16), np.asarray(ce32),
+                                   rtol=3e-2)
+
+    def test_chunked_ce_honors_logits_dtype(self):
+        """ce_chunk × logits_dtype=bf16: the chunked path must compute the
+        same bf16-logit CE as the unchunked head, not silently fp32."""
+        model = _model(dtype=jnp.bfloat16, logits_dtype=jnp.bfloat16)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, VOCAB, (2, 17)), jnp.int32)
+        batch = make_lm_batch(toks)
+        logits = model.apply({"params": params}, batch["tokens"])
+        from distributed_training_tpu.train.lm_step import _fused_softmax_ce
+
+        want = _fused_softmax_ce(logits, batch["targets"])
+        hidden = model.apply({"params": params}, batch["tokens"],
+                             return_hidden=True)
+        ce, _ = chunked_ce_and_accuracy(
+            hidden, params["lm_head"], batch["targets"], 8,
+            logits_dtype=jnp.bfloat16)
+        np.testing.assert_allclose(np.asarray(ce), np.asarray(want),
+                                   rtol=1e-5)

@@ -1,0 +1,146 @@
+"""Flash-attention kernel microbenchmark + on-chip correctness check.
+
+Times the Pallas kernel (fwd+bwd through the custom VJP) at the BASELINE.md
+shapes on the real device, and first verifies the COMPILED path (not
+interpret mode) against exact attention — the Mosaic-acceptance check the
+CPU test suite cannot provide (tests run in interpret mode; see
+ops/flash_attention.py LSE_LANES note).
+
+Usage:
+    python tools/flash_kernel_bench.py            # verify + bench defaults
+    python tools/flash_kernel_bench.py --no-verify --shapes gpt
+    python tools/flash_kernel_bench.py --blocks 512x1024 ...
+
+Prints one JSON line per shape with ms per fwd+bwd call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_training_tpu.ops.flash_attention import flash_attention
+
+# (label, bh, t, d) — bh = batch*heads flattened, matching BASELINE.md rows.
+SHAPES = {
+    "gpt": ("B16 H12 T1024 D64", 192, 1024, 64),
+    "t4096": ("B4 H8 T4096 D64", 32, 4096, 64),
+    "t16k": ("B2 H12 T16384 D64", 24, 16384, 64),
+}
+
+
+def exact_attention(q, k, v, causal=True):
+    s = jnp.einsum("...qd,...kd->...qk", q, k) / np.sqrt(q.shape[-1])
+    if causal:
+        t = q.shape[-2]
+        s = jnp.where(jnp.triu(jnp.ones((t, t), bool), 1), -jnp.inf, s)
+    p = jax.nn.softmax(s.astype(jnp.float32), -1)
+    return jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+
+
+def verify_compiled(flash_kwargs):
+    """Compiled-kernel (Mosaic) correctness vs exact attention, fwd + grads."""
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(4, 512, 64), jnp.bfloat16)
+               for _ in range(3))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)
+
+    ref_out = exact_attention(q, k, v)
+    got_out = flash_attention(q, k, v, causal=True, **flash_kwargs)
+    np.testing.assert_allclose(
+        np.asarray(got_out, np.float32), np.asarray(ref_out, np.float32),
+        atol=2e-2, rtol=2e-2)
+    ref_g = jax.grad(loss(lambda q, k, v: exact_attention(q, k, v)),
+                     argnums=(0, 1, 2))(q, k, v)
+    got_g = jax.grad(
+        loss(lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                             **flash_kwargs)),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", ref_g, got_g):
+        np.testing.assert_allclose(
+            np.asarray(b, np.float32), np.asarray(a, np.float32),
+            atol=2e-1, rtol=5e-2, err_msg=f"d{name}")
+    print("verify: compiled fwd+bwd matches exact attention (bf16 tolerances)",
+          file=sys.stderr)
+
+
+def bench_shape(label, bh, t, d, flash_kwargs, iters=20, warmup=3):
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(bh, t, d), jnp.bfloat16)
+               for _ in range(3))
+
+    @jax.jit
+    def fwd_bwd(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal=True,
+                                **flash_kwargs).astype(jnp.float32))
+        l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        return l, grads
+
+    for _ in range(warmup):
+        l, g = fwd_bwd(q, k, v)
+    float(l)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        l, g = fwd_bwd(q, k, v)
+    float(l)  # host fetch = the honest barrier through the tunnel
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    # Causal attention FLOPs: ~0.5 * 4 matmuls fwd + equivalent bwd.
+    flops = 0.5 * (2 + 5) * 2 * bh * t * t * d
+    print(json.dumps({
+        "shape": label, "ms": round(ms, 2),
+        "tflops_per_sec": round(flops / (ms / 1e3) / 1e12, 1),
+        "blocks": flash_kwargs or "auto",
+    }))
+    return ms
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shapes", nargs="+", default=list(SHAPES),
+                    choices=list(SHAPES))
+    ap.add_argument("--no-verify", action="store_true")
+    ap.add_argument("--blocks", default=None,
+                    help="fwd blocks as QxK (e.g. 1024x2048); default auto")
+    ap.add_argument("--bwd-blocks", default=None,
+                    help="bwd blocks as QxK (e.g. 512x1024); default auto")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--split-bwd", action="store_true",
+                    help="A/B: run the pre-round-4 two-kernel backward "
+                         "instead of the fused one")
+    args = ap.parse_args()
+
+    if args.split_bwd:
+        import distributed_training_tpu.ops.flash_attention as fa
+        fa._USE_SPLIT_BWD = True
+        print("backward: SPLIT (two-kernel)", file=sys.stderr)
+
+    kwargs = {}
+    if args.blocks:
+        bq, bk = map(int, args.blocks.split("x"))
+        kwargs.update(block_q=bq, block_k=bk)
+    if args.bwd_blocks:
+        bq, bk = map(int, args.bwd_blocks.split("x"))
+        kwargs.update(bwd_block_q=bq, bwd_block_k=bk)
+
+    print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+    if not args.no_verify:
+        verify_compiled(kwargs)
+    for s in args.shapes:
+        bench_shape(*SHAPES[s], kwargs, iters=args.iters)
+
+
+if __name__ == "__main__":
+    main()

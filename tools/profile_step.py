@@ -131,7 +131,9 @@ def capture(args) -> None:
         model = get_model(
             "transformer_lm", num_classes=50304, dtype=jnp.bfloat16,
             num_layers=12, num_heads=12, hidden_dim=768,
-            max_len=args.seq_len, attn_impl=args.attn_impl)
+            max_len=args.seq_len, attn_impl=args.attn_impl,
+            logits_dtype=(jnp.bfloat16 if args.logits_dtype == "bf16"
+                          else jnp.float32))
         tx = optax.adamw(3e-4)
         state = init_train_state(
             model, jax.random.PRNGKey(0), (1, 8), tx,
@@ -243,6 +245,8 @@ def main():
     ap.add_argument("--attn-impl", default="flash")
     ap.add_argument("--ce-chunk", type=int, default=None)
     ap.add_argument("--no-accuracy", action="store_true", default=False)
+    ap.add_argument("--logits-dtype", default="fp32",
+                    choices=["fp32", "bf16"])
     ap.add_argument("--warmup", type=int, default=4)
     ap.add_argument("--trace-steps", type=int, default=3)
     ap.add_argument("--top", type=int, default=15)
