@@ -256,6 +256,7 @@ def bench_lm(args) -> None:
     from distributed_training_tpu.train.lm_step import (
         make_lm_batch,
         make_tp_lm_train_step,
+    parse_logits_dtype,
     )
     from distributed_training_tpu.train.precision import LossScaleState
     from distributed_training_tpu.train.train_state import init_train_state
@@ -272,8 +273,7 @@ def bench_lm(args) -> None:
         "transformer_lm", num_classes=50304, dtype=jnp.bfloat16,
         num_layers=12, num_heads=12, hidden_dim=768,
         max_len=args.seq_len, attn_impl=args.attn_impl,
-        logits_dtype=(jnp.bfloat16 if args.logits_dtype == "bf16"
-                      else jnp.float32))
+        logits_dtype=parse_logits_dtype(args.logits_dtype))
     if args.lm_optimizer == "hybrid_adam":
         from distributed_training_tpu.ops.fused_adam import fused_adam
 

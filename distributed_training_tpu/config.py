@@ -248,6 +248,12 @@ class LMConfig:
     # [B, T, vocab] logits (measured 4.4 ms / +3.8% tok/s on GPT-2-small
     # T1024); turn it off for peak-throughput runs.
     metrics_accuracy: bool = True
+    # Head/logits compute dtype: "fp32" (default; stable softmax) or
+    # "bf16" — halves the [B, T, vocab] logits HBM round-trips (measured
+    # +7% tok/s on GPT-2-small T1024, BASELINE.md round 4); the CE still
+    # reduces in fp32 (train/lm_step.py::_fused_ce_rows), only the stored
+    # logits round to bf16.
+    logits_dtype: str = "fp32"
     corpus_path: str | None = None  # byte-level text file; None → synthetic
     train_sequences: int = 2048     # synthetic dataset size
     eval_sequences: int = 256

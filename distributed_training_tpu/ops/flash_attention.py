@@ -295,10 +295,12 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk[:] += scale * jax.lax.dot_general(
             ds, q_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        # dQ partial for this k block (summed over k blocks outside).
-        dq_ref[0, 0] = scale * jax.lax.dot_general(
+        # dQ partial for this k block (summed over k blocks outside; with
+        # nk == 1 the "partial" IS dq and the out dtype is q's, casting
+        # in-kernel to skip an external fp32->bf16 convert pass).
+        dq_ref[0, 0] = (scale * jax.lax.dot_general(
             ds, k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32)).astype(dq_ref.dtype)
 
     @pl.when(jnp.logical_not(live))
     def _():
@@ -365,7 +367,8 @@ def _flash_bwd(res, g, *, causal, block_q, block_k, interpret, g_lse=None):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nk, bh, t, d), jnp.float32),
+            jax.ShapeDtypeStruct((nk, bh, t, d),
+                                 q.dtype if nk == 1 else jnp.float32),
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
@@ -376,7 +379,7 @@ def _flash_bwd(res, g, *, causal, block_q, block_k, interpret, g_lse=None):
         interpret=interpret,
     )(q, k, v, g, lse, delta)
     dq = (dq_partial[0] if nk == 1
-          else dq_partial.sum(axis=0)).astype(q.dtype)
+          else dq_partial.sum(axis=0).astype(q.dtype))
     return dq, dk, dv
 
 

@@ -52,6 +52,7 @@ from distributed_training_tpu.runtime.mesh import (
 from distributed_training_tpu.train.lm_step import (
     make_lm_batch,
     model_logits_dtype,
+    parse_logits_dtype,
     make_lm_train_step,
     make_pp_lm_train_step,
     make_tp_lm_train_step,
@@ -208,6 +209,7 @@ class LMTrainer:
             mlp_ratio=lm.mlp_ratio,
             max_len=lm.max_len,
             attn_impl=lm.attn_impl,
+            logits_dtype=parse_logits_dtype(lm.logits_dtype),
             **moe_kwargs,
         )
         self.world_size = data_axis_size(self.mesh)

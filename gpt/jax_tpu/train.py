@@ -46,6 +46,11 @@ def add_argument() -> argparse.Namespace:
                         help="chunked cross-entropy: tokens per lm_head+CE "
                              "chunk (never materializes [B,T,vocab] logits; "
                              "for long-context × large-vocab runs)")
+    parser.add_argument("--logits-dtype", type=str, default="fp32",
+                        choices=["fp32", "bf16"],
+                        help="head/logits compute dtype; bf16 halves the "
+                             "[B,T,vocab] HBM traffic (CE reduces in fp32 "
+                             "either way)")
     # MoE surface (DeepSpeed flag names, resnet/deepspeed parity) — here
     # they swap alternating decoder FFNs for expert-parallel MoE layers.
     parser.add_argument("--moe", action="store_true", default=False)
@@ -146,6 +151,7 @@ def build_config(args: argparse.Namespace):
             num_microbatches=args.microbatches,
             attn_impl=args.attn_impl,
             ce_chunk_size=args.ce_chunk_size,
+            logits_dtype=args.logits_dtype,
             corpus_path=args.corpus,
         ),
     )
