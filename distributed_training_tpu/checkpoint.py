@@ -37,7 +37,8 @@ def _epoch_dir(directory: str, epoch: int) -> str:
 
 def save_checkpoint(directory: str, epoch: int, state: Any,
                     next_epoch: int | None = None,
-                    epoch_step: int = 0) -> str:
+                    epoch_step: int = 0,
+                    layout: dict[str, int] | None = None) -> str:
     """Save the train state tagged ``epoch``; returns the checkpoint path.
 
     ``next_epoch`` is the epoch a resume should start at — ``epoch + 1``
@@ -46,15 +47,21 @@ def save_checkpoint(directory: str, epoch: int, state: Any,
     batches of that epoch were already consumed, so a resume skips exactly
     that prefix of the epoch's deterministic shuffle instead of re-training
     it (step-accurate resume; see ``runtime/preemption.py``).
+
+    ``layout`` records storage-layout parameters the arrays' SHAPES cannot
+    encode — e.g. the circular pipeline's layer permutation (a function of
+    pipe_size × virtual_stages): a resume into a different layout would
+    load shape-identical but silently permuted weights, so restore
+    validates it (see :func:`restore_checkpoint`).
     """
     path = _epoch_dir(directory, epoch)
-    payload = {
-        "state": serialization.to_state_dict(state),
-        "meta": {"epoch": np.int32(epoch),
-                 "next_epoch": np.int32(
-                     epoch + 1 if next_epoch is None else next_epoch),
-                 "epoch_step": np.int32(epoch_step)},
-    }
+    meta = {"epoch": np.int32(epoch),
+            "next_epoch": np.int32(
+                epoch + 1 if next_epoch is None else next_epoch),
+            "epoch_step": np.int32(epoch_step)}
+    for k, v in (layout or {}).items():
+        meta[f"layout_{k}"] = np.int32(v)
+    payload = {"state": serialization.to_state_dict(state), "meta": meta}
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, payload, force=True)
     return path
@@ -105,8 +112,9 @@ def _legacy_block_rename(saved_state: Any, new_state: dict) -> dict[str, str]:
     return dict(zip(legacy, new))
 
 
-def restore_checkpoint(directory: str, epoch: int,
-                       state: Any) -> tuple[Any, int, int]:
+def restore_checkpoint(directory: str, epoch: int, state: Any,
+                       layout: dict[str, int] | None = None,
+                       ) -> tuple[Any, int, int]:
     """Restore the checkpoint tagged ``epoch``; returns
     ``(state, start_epoch, start_step)``.
 
@@ -140,11 +148,28 @@ def restore_checkpoint(directory: str, epoch: int,
             state_template, {n: o for o, n in rename.items()})
     saved_meta = saved.get("meta", {})
     meta_template = {"epoch": np.int32(0)}
-    for key in ("next_epoch", "epoch_step"):
-        if key in saved_meta:
+    for key in saved_meta:
+        if key in ("next_epoch", "epoch_step") or key.startswith("layout_"):
             meta_template[key] = np.int32(0)
     restored = ckptr.restore(
         path, item={"state": state_template, "meta": meta_template})
+    # Storage-layout guard BEFORE handing weights back: identical shapes
+    # can hide a permuted layout (the circular pipeline's layer stacking).
+    # Symmetric compare with default 1/identity on both sides, so legacy
+    # saves without the key count as identity and a saved non-identity key
+    # the caller did not declare still refuses.
+    saved_layout = {k[len("layout_"):]: int(v)
+                    for k, v in restored["meta"].items()
+                    if k.startswith("layout_")}
+    want_layout = {k: int(v) for k, v in (layout or {}).items()}
+    for k in sorted(set(saved_layout) | set(want_layout)):
+        have, want = saved_layout.get(k, 1), want_layout.get(k, 1)
+        if have != want:
+            raise ValueError(
+                f"checkpoint at {path} was saved with layout {k}={have}, "
+                f"but this run expects {k}={want}; the stacked arrays are "
+                f"shape-identical but PERMUTED — resume with the saving "
+                f"configuration instead of loading silently wrong weights")
     meta = restored["meta"]
     next_epoch = (int(meta["next_epoch"]) if "next_epoch" in meta
                   else int(meta["epoch"]) + 1)

@@ -235,6 +235,11 @@ class LMConfig:
     mlp_ratio: int = 4
     max_len: int = 2048
     num_microbatches: int = 1
+    # Interleaved/circular pipeline: each pipe device holds this many
+    # non-contiguous layer chunks and the activation ring wraps that many
+    # times — bubble (S-1)/(v·M+S-1) vs GPipe's (S-1)/(M+S-1). 1 = GPipe.
+    # Pipeline strategy only; num_layers must divide by pipe × v.
+    virtual_stages: int = 1
     attn_impl: str = "exact"  # exact | flash (Pallas kernel; not w/ sequence)
     # Chunked cross-entropy: apply the lm_head + CE over time chunks of
     # this many tokens so the [B, T, vocab] logits never materialize

@@ -24,7 +24,17 @@ The TPU-native formulation is a single SPMD program:
   (and, via the TP rule table, with ``model``).
 
 The pipeline bubble is the usual GPipe ``(S-1)/(M+S-1)`` fraction; raise
-``num_microbatches`` to amortize it.
+``num_microbatches`` to amortize it, or ``virtual_stages`` (the
+megatron-style interleaved/circular schedule, round 4) to divide the
+numerator's weight: each device holds ``v`` non-contiguous layer chunks
+(device d owns global chunks d, d+S, ..., d+(v-1)S) and the activation ring
+wraps ``v`` times, giving bubble ``(S-1)/(v·M+S-1)``. The tick math stays a
+single scan + one ppermute per tick: at local time ``u = t - d`` a device
+runs local chunk ``(u // S) % v`` on microbatch ``(u // (v·S))·S + u % S``,
+and every activation is consumed by the ring neighbor exactly one tick
+after it is produced — including the wrap from the last device back to the
+first, whose +S chunk offset cancels the -(S-1) device offset.
+``virtual_stages=1`` degenerates to exactly GPipe.
 """
 
 from __future__ import annotations
@@ -41,27 +51,54 @@ from distributed_training_tpu.runtime.mesh import AXIS_DATA, AXIS_PIPE
 from distributed_training_tpu.utils.compat import shard_map
 
 
-def stack_block_params(params: dict, num_layers: int, prefix: str = "block"):
+def circular_layer_order(num_layers: int, stages: int,
+                         virtual_stages: int) -> list[int]:
+    """Storage order of layers for the circular schedule.
+
+    The stacked dim is sharded P(pipe) in CONTIGUOUS slices, so device d's
+    slice must contain its chunk set {d, d+S, ..., d+(v-1)S} in execution
+    order: storage row ``d·(L/S) + ℓ·(L/C) + j`` holds layer
+    ``(ℓ·S + d)·(L/C) + j`` (C = S·v chunks of L/C layers). v=1 is the
+    identity (GPipe layout).
+    """
+    c = stages * virtual_stages
+    per_chunk = num_layers // c
+    order = []
+    for d in range(stages):
+        for ell in range(virtual_stages):
+            g = ell * stages + d
+            order.extend(range(g * per_chunk, (g + 1) * per_chunk))
+    return order
+
+
+def stack_block_params(params: dict, num_layers: int, prefix: str = "block",
+                       layer_order: list[int] | None = None):
     """Split model params into (stacked decoder blocks, everything else).
 
     The per-layer trees ``params['block0'] .. params['block{L-1}']`` are
     congruent, so they stack leaf-wise into one tree with a leading layer
     dim — the representation the ``pipe`` axis shards (stage = a contiguous
-    slice of layers).
+    slice of layers). ``layer_order`` permutes the stacking (storage row i
+    holds layer ``layer_order[i]``) — the circular schedule's strided
+    chunk-to-device assignment rides the same contiguous P(pipe) sharding.
     """
-    blocks = [params[f"{prefix}{i}"] for i in range(num_layers)]
+    order = layer_order if layer_order is not None else range(num_layers)
+    blocks = [params[f"{prefix}{i}"] for i in order]
     rest = {k: v for k, v in params.items()
             if not (k.startswith(prefix) and k[len(prefix):].isdigit())}
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
     return stacked, rest
 
 
-def unstack_block_params(stacked, rest: dict, prefix: str = "block") -> dict:
+def unstack_block_params(stacked, rest: dict, prefix: str = "block",
+                         layer_order: list[int] | None = None) -> dict:
     """Inverse of :func:`stack_block_params` (checkpoint interop)."""
     num_layers = jax.tree.leaves(stacked)[0].shape[0]
+    order = list(layer_order) if layer_order is not None \
+        else list(range(num_layers))
     out = dict(rest)
     for i in range(num_layers):
-        out[f"{prefix}{i}"] = jax.tree.map(lambda x: x[i], stacked)
+        out[f"{prefix}{order[i]}"] = jax.tree.map(lambda x: x[i], stacked)
     return out
 
 
@@ -73,18 +110,26 @@ def spmd_pipeline(
     axis_name: str = AXIS_PIPE,
     num_microbatches: int,
     rng: jax.Array | None = None,
+    virtual_stages: int = 1,
 ) -> jnp.ndarray:
     """Run ``x`` through the S-stage pipeline. Call inside ``shard_map``.
 
     Args:
-      stage_fn: ``(stage_params, x_mb) -> y_mb`` applying this device's
-        layers to one microbatch (shape-preserving); with ``rng`` set it is
-        called as ``(stage_params, x_mb, mb_rng)`` where ``mb_rng`` is
-        unique per (microbatch, stage) — fold in the layer index inside.
-      stage_params: this device's stage shard (leading dim = L/S layers).
+      stage_fn: ``(stage_params, chunk, x_mb) -> y_mb`` applying local
+        chunk ``chunk`` (a traced int32 in [0, virtual_stages)) of this
+        device's layers to one microbatch (shape-preserving); with ``rng``
+        set it is called as ``(stage_params, chunk, x_mb, mb_rng)`` where
+        ``mb_rng`` is unique per (microbatch, global chunk) — fold in the
+        layer index inside.
+      stage_params: this device's stage shard (leading dim = L/S layers,
+        laid out in local-chunk execution order — see
+        :func:`circular_layer_order`).
       x: [B_local, ...] the full local batch of pipeline inputs.
       num_microbatches: M; B_local must divide by it.
       rng: optional dropout key threaded through the schedule.
+      virtual_stages: v; 1 = GPipe, >1 = the interleaved/circular schedule
+        (bubble ``(S-1)/(v·M+S-1)``). M must divide by S when v > 1 (the
+        schedule moves microbatches in groups of S between chunk switches).
 
     Returns [B_local, ...] outputs, replicated over the pipe axis (the last
     stage's results are psum-broadcast so downstream unsharded ops — final
@@ -93,38 +138,56 @@ def spmd_pipeline(
     s = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = num_microbatches
+    v = virtual_stages
     b = x.shape[0]
     if b % m:
         raise ValueError(f"local batch {b} not divisible by microbatches {m}")
+    if v > 1 and m % s:
+        # Silently violating this would zero the trailing microbatches'
+        # outputs (their final-chunk ticks fall past the scan).
+        raise ValueError(
+            f"the circular schedule moves microbatches in groups of the "
+            f"pipe size; num_microbatches {m} must divide by {s}")
     mb = x.reshape(m, b // m, *x.shape[1:])
     perm = [(j, (j + 1) % s) for j in range(s)]
 
     def tick(carry, t):
         recv, outputs = carry
-        # Stage 0 feeds itself from the microbatch queue; everyone else
-        # consumes what the previous stage sent last tick. Clipped indices
-        # make warmup/drain ticks well-defined (their results are masked).
+        # Local schedule: device idx at tick t works local time u = t - idx
+        # (valid when 0 <= u < v*m), running local chunk (u // S) % v on
+        # microbatch (u // (v*S))*S + u % S. Clipped indices make warmup/
+        # drain ticks well-defined (their results are masked); v == 1
+        # degenerates to chunk 0 / microbatch u — exactly GPipe.
+        u = t - idx
+        chunk = (jnp.maximum(u, 0) // s) % v
+        mu = jnp.clip((u // (v * s)) * s + u % s, 0, m - 1)
+        # The first device feeds fresh microbatches only at its chunk-0
+        # slots; every other slot consumes the ring (for the wrap, device
+        # S-1's chunk ℓ output arrives as device 0's chunk ℓ+1 input one
+        # tick later). Warmup ticks (u < 0) never write output, so their
+        # garbage compute is masked.
+        feed = (idx == 0) & (chunk == 0)
         inp = jnp.where(
-            idx == 0,
-            lax.dynamic_index_in_dim(mb, jnp.clip(t, 0, m - 1), 0,
-                                     keepdims=False),
+            feed,
+            lax.dynamic_index_in_dim(mb, mu, 0, keepdims=False),
             recv)
+        # Global chunk = chunk*S + idx; folding (microbatch, global chunk)
+        # decorrelates dropout across both without depending on ticks.
         if rng is None:
-            out = stage_fn(stage_params, inp)
+            out = stage_fn(stage_params, chunk, inp)
         else:
-            # The microbatch at stage ``idx`` on tick ``t`` is ``t - idx``;
-            # folding (microbatch, stage) decorrelates dropout across both
-            # without depending on the tick count.
-            mb_rng = jax.random.fold_in(rng, jnp.clip(t - idx, 0, m - 1) * s
-                                        + idx)
-            out = stage_fn(stage_params, inp, mb_rng)
-        j = jnp.clip(t - (s - 1), 0, m - 1)
-        written = lax.dynamic_update_index_in_dim(outputs, out, j, 0)
-        outputs = jnp.where((idx == s - 1) & (t >= s - 1), written, outputs)
+            mb_rng = jax.random.fold_in(rng, mu * (v * s) + chunk * s + idx)
+            out = stage_fn(stage_params, chunk, inp, mb_rng)
+        # The last device's last local chunk is global chunk C-1: its
+        # output for microbatch mu is final. It runs at u = (mu//S)*v*S
+        # + (v-1)*S + mu%S, i.e. any valid u with chunk == v-1.
+        done = (idx == s - 1) & (chunk == v - 1) & (u >= 0) & (u < v * m)
+        written = lax.dynamic_update_index_in_dim(outputs, out, mu, 0)
+        outputs = jnp.where(done, written, outputs)
         return (lax.ppermute(out, axis_name, perm), outputs), None
 
     init = (jnp.zeros_like(mb[0]), jnp.zeros_like(mb))
-    (_, outputs), _ = lax.scan(tick, init, jnp.arange(m + s - 1))
+    (_, outputs), _ = lax.scan(tick, init, jnp.arange(v * m + s - 1))
     # Only the last stage holds real outputs; broadcast them to every pipe
     # rank (psum of a one-hot-by-rank value == broadcast from that rank).
     outputs = lax.psum(
@@ -132,7 +195,9 @@ def spmd_pipeline(
     return outputs.reshape(b, *x.shape[1:])
 
 
-def pp_tree_shardings(tree: Any, mesh: Mesh, *, tp: bool = False) -> Any:
+def pp_tree_shardings(tree: Any, mesh: Mesh, *, tp: bool = False,
+                      extra_axes: tuple = (),
+                      memory_kind: str | None = None) -> Any:
     """Shardings for any tree congruent with PP params (incl. Adam moments):
     leaves under a ``blocks`` key shard their leading (layer) dim over
     ``pipe``; everything else is replicated. The match is on an exact path
@@ -144,7 +209,13 @@ def pp_tree_shardings(tree: Any, mesh: Mesh, *, tp: bool = False) -> Any:
     one), and the out-of-pipeline leaves (vocab-parallel ``tok_embed`` /
     ``lm_head``) take their TP spec directly — each pipeline stage then
     holds only its ``1/tp`` slice of its layers' weights.
+
+    ``extra_axes`` recruits data(/fsdp) on a dim the pipe/TP specs left
+    free, via the shared ZeRO placement rule — PP×ZeRO-1: each data
+    replica of a pipeline stage owns a slice of that stage's optimizer
+    state, exactly as DeepSpeed partitions ZeRO within pipeline stages.
     """
+    from distributed_training_tpu.parallel.sharding import zero_leaf_sharding
     from distributed_training_tpu.parallel.tensor_parallel import (
         tp_spec_for_path,
     )
@@ -152,14 +223,20 @@ def pp_tree_shardings(tree: Any, mesh: Mesh, *, tp: bool = False) -> Any:
 
     def leaf(path, x):
         if "blocks" in path_keys(path) and getattr(x, "ndim", 0) >= 1:
+            spec = P(AXIS_PIPE)
             if tp:
                 tp_spec = tp_spec_for_path(path_str(path))
                 if len(tp_spec) == getattr(x, "ndim", 0) - 1:
-                    return NamedSharding(mesh, P(AXIS_PIPE, *tp_spec))
-            return NamedSharding(mesh, P(AXIS_PIPE))
-        if tp:
-            return NamedSharding(mesh, tp_spec_for_path(path_str(path)))
-        return NamedSharding(mesh, P())
+                    spec = P(AXIS_PIPE, *tp_spec)
+        elif tp:
+            spec = tp_spec_for_path(path_str(path))
+        else:
+            spec = P()
+        if extra_axes:
+            return zero_leaf_sharding(x, mesh, extra_axes, base=spec,
+                                      memory_kind=memory_kind)
+        kw = {"memory_kind": memory_kind} if memory_kind else {}
+        return NamedSharding(mesh, spec, **kw)
 
     return jax.tree_util.tree_map_with_path(leaf, tree)
 
@@ -175,7 +252,8 @@ class PipelinedLM:
     ``commit_gradients`` and the LM metrics helpers all work unchanged.
     """
 
-    def __init__(self, model, mesh: Mesh, *, num_microbatches: int):
+    def __init__(self, model, mesh: Mesh, *, num_microbatches: int,
+                 virtual_stages: int = 1):
         from distributed_training_tpu.models.gpt import DecoderBlock
 
         if model.seq_axis is not None:
@@ -184,6 +262,7 @@ class PipelinedLM:
         self.model = model
         self.mesh = mesh
         self.num_microbatches = num_microbatches
+        self.virtual_stages = virtual_stages
         self.block = DecoderBlock(
             num_heads=model.num_heads,
             mlp_dim=model.mlp_ratio * model.hidden_dim,
@@ -199,17 +278,35 @@ class PipelinedLM:
         # over (pipe, data) so GSPMD inserts the model-axis psums inside
         # each stage's compute.
         self.tp_size = shape.get("model", 1)
-        if model.num_layers % max(self.pipe_size, 1):
+        if virtual_stages < 1:
+            raise ValueError(f"virtual_stages must be >= 1, got "
+                             f"{virtual_stages}")
+        if model.num_layers % max(self.pipe_size * virtual_stages, 1):
             raise ValueError(
                 f"{model.num_layers} layers not divisible into "
-                f"{self.pipe_size} pipeline stages")
+                f"{self.pipe_size} stages x {virtual_stages} virtual chunks")
+        if virtual_stages > 1 and num_microbatches % max(self.pipe_size, 1):
+            raise ValueError(
+                f"the circular schedule moves microbatches in groups of the "
+                f"pipe size; num_microbatches {num_microbatches} must divide "
+                f"by {self.pipe_size}")
+        self.layer_order = circular_layer_order(
+            model.num_layers, max(self.pipe_size, 1), virtual_stages)
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Idle fraction of the pipeline schedule: (S-1)/(v·M+S-1)."""
+        s = max(self.pipe_size, 1)
+        return (s - 1) / (self.virtual_stages * self.num_microbatches + s - 1)
 
     def init_params(self, rng: jax.Array) -> dict:
-        """Init via the wrapped model, then stack the blocks."""
+        """Init via the wrapped model, then stack the blocks (in circular
+        storage order when virtual_stages > 1)."""
         dummy = jnp.zeros((1, 8), jnp.int32)
         variables = self.model.init({"params": rng}, dummy, train=False)
         stacked, rest = stack_block_params(
-            dict(variables["params"]), self.model.num_layers)
+            dict(variables["params"]), self.model.num_layers,
+            layer_order=self.layer_order)
         return {"blocks": stacked, **rest}
 
     def param_shardings(self, params: dict) -> dict:
@@ -229,8 +326,18 @@ class PipelinedLM:
             # model's nn.remat(DecoderBlock).
             run_layer = jax.checkpoint(run_layer)
 
-        def stage_fn(stage_params, x, mb_rng=None):
-            n_layers = jax.tree.leaves(stage_params)[0].shape[0]
+        v = self.virtual_stages
+
+        def stage_fn(stage_params, chunk, x, mb_rng=None):
+            n_rows = jax.tree.leaves(stage_params)[0].shape[0]
+            per_chunk = n_rows // v
+            # Local chunk ``chunk`` (traced) = rows [chunk*per_chunk, ...)
+            # of this device's slice (execution order by construction of
+            # circular_layer_order).
+            chunk_params = jax.tree.map(
+                lambda p: lax.dynamic_slice_in_dim(
+                    p, chunk * per_chunk, per_chunk, 0),
+                stage_params) if v > 1 else stage_params
 
             def layer(carry, args):
                 h = carry
@@ -239,7 +346,8 @@ class PipelinedLM:
                      if mb_rng is not None else jax.random.PRNGKey(0))
                 return run_layer(p, h, r), None
 
-            h, _ = lax.scan(layer, x, (stage_params, jnp.arange(n_layers)))
+            h, _ = lax.scan(layer, x,
+                            (chunk_params, jnp.arange(per_chunk)))
             return h
 
         return stage_fn
@@ -299,7 +407,8 @@ class PipelinedLM:
                 rng = jax.random.fold_in(rng, lax.axis_index(AXIS_DATA))
             return spmd_pipeline(
                 self._make_stage_fn(train), blocks, x,
-                num_microbatches=self.num_microbatches, rng=rng)
+                num_microbatches=self.num_microbatches, rng=rng,
+                virtual_stages=self.virtual_stages)
 
         pipeline = shard_map(
             run, self.mesh,

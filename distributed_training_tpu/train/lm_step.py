@@ -606,14 +606,28 @@ def make_tp_lm_train_step(
 def make_pp_lm_train_step(
     mesh: Mesh, *, model, num_microbatches: int, donate: bool = True,
     ce_chunk: int | None = None, accuracy_metric: bool = True,
+    zero_stage: int = 0, virtual_stages: int = 1,
+    cpu_offload: bool = False,
 ) -> Callable:
-    """Pipeline-parallel LM train step (GPipe schedule over ``pipe``).
+    """Pipeline-parallel LM train step (GPipe or circular schedule over
+    ``pipe``).
 
     Decoder blocks are stacked and sharded over the ``pipe`` mesh axis; the
     forward runs the ``lax.scan`` + ``lax.ppermute`` schedule from
     ``parallel/pipeline.py`` and the backward pipeline falls out of
     autodiff (ppermute's transpose is the reverse hop). Embeddings and the
     LM head are plain GSPMD ops sharded over ``data``, so DP composes.
+    ``virtual_stages > 1`` selects the interleaved/circular schedule
+    (bubble ``(S-1)/(v·M+S-1)`` instead of GPipe's ``(S-1)/(M+S-1)``).
+
+    ``zero_stage`` 1/2 composes DeepSpeed-style: the optimizer state of
+    every leaf — pipe-stacked blocks and the replicated embeddings/head —
+    additionally shards over the data axis on a dim the pipe/TP specs left
+    free, and ``commit_gradients`` runs under plain GSPMD where the
+    placement propagates (reduce-scatter + sharded update + all-gather).
+    Stage 3 is refused: sharding the *parameters* over data would make the
+    pipeline shard_map all-gather every stage's weights each tick —
+    DeepSpeed likewise does not compose ZeRO-3 with its pipeline engine.
 
     Returns ``step(state, batch, rng) -> (state, metrics)`` with a
     ``.pipelined`` attribute (the :class:`PipelinedLM`) and
@@ -623,9 +637,24 @@ def make_pp_lm_train_step(
         PipelinedLM,
         pp_tree_shardings,
     )
+    from distributed_training_tpu.parallel.sharding import (
+        check_cpu_offload,
+        zero_stage_axes,
+    )
 
-    plm = PipelinedLM(model, mesh, num_microbatches=num_microbatches)
+    if zero_stage >= 3:
+        raise NotImplementedError(
+            "zero stage 3 does not compose with the pipeline strategy "
+            "(data-sharded params would be all-gathered every pipeline "
+            "tick; DeepSpeed's pipeline engine refuses ZeRO-3 for the same "
+            "reason) — use stage 1/2, or the tensor/dp or sequence "
+            "strategies for stage 3")
+    check_cpu_offload(cpu_offload, zero_stage)
+    plm = PipelinedLM(model, mesh, num_microbatches=num_microbatches,
+                      virtual_stages=virtual_stages)
     tp = plm.tp_size > 1
+    _, opt_axes = zero_stage_axes(mesh, zero_stage)
+    opt_mem = "pinned_host" if cpu_offload else None
 
     def state_shardings(state: TrainState):
         repl = NamedSharding(mesh, P())
@@ -633,7 +662,9 @@ def make_pp_lm_train_step(
             step=repl,
             params=pp_tree_shardings(state.params, mesh, tp=tp),
             batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
-            opt_state=pp_tree_shardings(state.opt_state, mesh, tp=tp),
+            opt_state=pp_tree_shardings(
+                state.opt_state, mesh, tp=tp, extra_axes=opt_axes,
+                memory_kind=opt_mem),
             loss_scale=jax.tree.map(lambda _: repl, state.loss_scale),
         )
 
@@ -642,7 +673,8 @@ def make_pp_lm_train_step(
     step = _make_gspmd_lm_step(
         mesh, state_shardings, donate=donate, ce_chunk=ce_chunk,
         accuracy_metric=accuracy_metric,
-        logits_dtype=model_logits_dtype(model))
+        logits_dtype=model_logits_dtype(model),
+        cpu_offload=cpu_offload)
     step.pipelined = plm
     return step
 

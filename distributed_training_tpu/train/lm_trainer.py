@@ -94,15 +94,17 @@ class LMTrainer:
         # ``model`` automatic), so megatron TP shardings propagate inside
         # the shards and GSPMD inserts the row-parallel psums there.
         self.tp_size = model_par
-        if self.strategy == "pipeline" and cfg.zero.stage != 0:
-            # Refuse rather than silently train unsharded while the banner
-            # advertises a ZeRO stage. (The sequence strategy composes:
-            # make_lm_train_step commits gradients outside its shard_map so
-            # ZeRO placements of the optimizer state stay in GSPMD-land.)
+        if self.strategy == "pipeline" and cfg.zero.stage >= 3:
+            # Stages 1/2 compose since round 4 (make_pp_lm_train_step
+            # shards the optimizer state over data on dims the pipe/TP
+            # specs leave free); stage 3 would all-gather every stage's
+            # params each pipeline tick — DeepSpeed's pipeline engine
+            # refuses ZeRO-3 for the same reason.
             raise NotImplementedError(
                 f"zero stage {cfg.zero.stage} does not compose with the "
-                "pipeline strategy; its step keeps non-block state "
-                "replicated")
+                "pipeline strategy (params sharded over data would be "
+                "all-gathered every tick); use stage 1/2 or another "
+                "strategy")
         from distributed_training_tpu.parallel.sharding import (
             check_cpu_offload,
         )
@@ -231,7 +233,10 @@ class LMTrainer:
                 self.mesh, model=self.model,
                 num_microbatches=lm.num_microbatches,
                 ce_chunk=lm.ce_chunk_size,
-                accuracy_metric=lm.metrics_accuracy)
+                accuracy_metric=lm.metrics_accuracy,
+                zero_stage=cfg.zero.stage,
+                virtual_stages=lm.virtual_stages,
+                cpu_offload=cfg.zero.cpu_offload)
             plm = self.train_step.pipelined
             state = TrainState.create(
                 apply_fn=plm.apply_fn, params=plm.init_params(init_rng),
@@ -452,6 +457,22 @@ class LMTrainer:
         finally:
             self.metrics_writer.close()
 
+    def _ckpt_layout(self) -> dict:
+        """Storage-layout metadata for save/restore validation: the
+        pipeline strategy stacks blocks in a (pipe_size × virtual_stages)-
+        dependent permutation (parallel/pipeline.circular_layer_order);
+        shape-identical checkpoints across different layouts would load
+        silently permuted (see checkpoint.restore_checkpoint)."""
+        if self.strategy != "pipeline":
+            return {}
+        plm = self.train_step.pipelined
+        if plm.virtual_stages == 1:
+            # GPipe stacking is the identity for ANY pipe size — only the
+            # circular permutation makes the layout pipe-size-dependent.
+            return {"virtual_stages": 1}
+        return {"pipe_size": plm.pipe_size,
+                "virtual_stages": plm.virtual_stages}
+
     def _fit(self) -> dict:
         cfg = self.cfg
         train_loader, eval_loader = self.make_loaders()
@@ -461,7 +482,8 @@ class LMTrainer:
         resume = ckpt_lib.resolve_resume(cfg.checkpoint)
         if resume >= 0:
             self.state, start_epoch, start_step = ckpt_lib.restore_checkpoint(
-                cfg.checkpoint.directory, resume, self.state)
+                cfg.checkpoint.directory, resume, self.state,
+                layout=self._ckpt_layout())
             self.state = place_state(self.state, self.shardings)
             # Metric sinks continue the restored step axis (see trainer.py).
             self._global_step = int(jax.device_get(self.state.step))
@@ -484,7 +506,8 @@ class LMTrainer:
                         estep = 0 if done else self._epoch_step
                         ckpt_lib.save_checkpoint(
                             cfg.checkpoint.directory, epoch, self.state,
-                            next_epoch=next_ep, epoch_step=estep)
+                            next_epoch=next_ep, epoch_step=estep,
+                            layout=self._ckpt_layout())
                         self.coord.print(
                             f"[lm_trainer] SIGTERM: saved preemption "
                             f"checkpoint (resumes at epoch {next_ep} "
@@ -497,7 +520,8 @@ class LMTrainer:
                 if cfg.checkpoint.interval and (
                         epoch + 1) % cfg.checkpoint.interval == 0:
                     ckpt_lib.save_checkpoint(
-                        cfg.checkpoint.directory, epoch, self.state)
+                        cfg.checkpoint.directory, epoch, self.state,
+                        layout=self._ckpt_layout())
                     ckpt_lib.prune_checkpoints(
                         cfg.checkpoint.directory, cfg.checkpoint.keep)
         self._guard = None

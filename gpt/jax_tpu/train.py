@@ -73,6 +73,10 @@ def add_argument() -> argparse.Namespace:
                         help="pipeline-parallel (pipe axis) size")
     parser.add_argument("--sp", type=int, default=1,
                         help="sequence-parallel (ring) size")
+    parser.add_argument("--virtual-stages", type=int, default=1,
+                        help="interleaved/circular pipeline: layer chunks "
+                             "per pipe device (1 = GPipe); cuts the bubble "
+                             "to (S-1)/(v*M+S-1)")
     parser.add_argument("--microbatches", type=int, default=2,
                         help="GPipe microbatches (only with --pp)")
     parser.add_argument("-c", "--checkpoint", type=str, default="./checkpoint")
@@ -149,6 +153,7 @@ def build_config(args: argparse.Namespace):
             hidden_dim=args.hidden_dim,
             max_len=args.max_len,
             num_microbatches=args.microbatches,
+            virtual_stages=args.virtual_stages,
             attn_impl=args.attn_impl,
             ce_chunk_size=args.ce_chunk_size,
             logits_dtype=args.logits_dtype,

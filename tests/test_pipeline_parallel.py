@@ -274,3 +274,175 @@ def test_pp_rejects_bad_config(pp_mesh):
                           hidden_dim=32, max_len=128)
     with pytest.raises(ValueError, match="seq_axis"):
         PipelinedLM(seq_model, pp_mesh, num_microbatches=2)
+
+
+class TestCircularSchedule:
+    """Interleaved/circular pipeline (virtual_stages > 1, round 4): same
+    math as GPipe and the plain model, smaller bubble."""
+
+    def test_layer_order_roundtrip(self):
+        from distributed_training_tpu.parallel.pipeline import (
+            circular_layer_order,
+        )
+
+        order = circular_layer_order(8, 4, 2)
+        # device d's contiguous slice (2 rows) = chunks {d, d+4} of 1 layer
+        assert order == [0, 4, 1, 5, 2, 6, 3, 7]
+        model = _model(num_layers=8)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+            train=False)
+        params = dict(variables["params"])
+        stacked, rest = stack_block_params(params, 8, layer_order=order)
+        restored = unstack_block_params(stacked, rest, layer_order=order)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), restored, params)
+
+    def test_circular_forward_matches_plain(self, pp_mesh):
+        model = _model(num_layers=8)
+        rng = jax.random.PRNGKey(0)
+        variables = model.init({"params": rng}, jnp.zeros((1, 8), jnp.int32),
+                               train=False)
+        plm = PipelinedLM(model, pp_mesh, num_microbatches=4,
+                          virtual_stages=2)
+        pp_params = plm.init_params(rng)
+        tokens = jnp.asarray(_tokens(b=8))
+        ref = model.apply(variables, tokens, train=False)
+        got = jax.jit(lambda p, t: plm.apply_fn({"params": p}, t))(
+            pp_params, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-4)
+
+    def test_circular_grads_match_gpipe(self, pp_mesh):
+        """The schedule is an execution order, not math: circular and GPipe
+        steps from the same init must produce the same updated params."""
+        model = _model(num_layers=8)
+        rng0 = jax.random.PRNGKey(0)
+        batch = make_lm_batch(_tokens(b=8))
+        results = {}
+        for v, m in ((1, 4), (2, 4)):
+            step = make_pp_lm_train_step(
+                pp_mesh, model=model, num_microbatches=m, virtual_stages=v)
+            plm = step.pipelined
+            state = _pp_state(plm, rng0)
+            state = jax.device_put(state, step.state_shardings(state))
+            new_state, metrics = step(
+                state, jax.device_put(batch, step.batch_shardings),
+                jax.random.PRNGKey(7))
+            # Compare in the canonical (unstacked) layout: the two
+            # schedules store layers in different stacking orders.
+            results[v] = (
+                unstack_block_params(
+                    new_state.params["blocks"],
+                    {k: w for k, w in new_state.params.items()
+                     if k != "blocks"},
+                    layer_order=plm.layer_order),
+                float(metrics["loss"]))
+        np.testing.assert_allclose(results[1][1], results[2][1],
+                                   atol=1e-5, rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4),
+            results[1][0], results[2][0])
+
+    def test_bubble_fraction_drops(self, pp_mesh):
+        model = _model(num_layers=8)
+        gpipe = PipelinedLM(model, pp_mesh, num_microbatches=8)
+        circ = PipelinedLM(model, pp_mesh, num_microbatches=8,
+                           virtual_stages=2)
+        assert gpipe.bubble_fraction == pytest.approx(3 / 11)
+        assert circ.bubble_fraction == pytest.approx(3 / 19)
+        assert circ.bubble_fraction < gpipe.bubble_fraction
+
+    def test_microbatch_group_constraint(self, pp_mesh):
+        model = _model(num_layers=8)
+        with pytest.raises(ValueError, match="groups of the pipe size"):
+            PipelinedLM(model, pp_mesh, num_microbatches=3, virtual_stages=2)
+
+
+class TestPPZero:
+    """PP x ZeRO-1 (round 4): optimizer state shards over data on dims the
+    pipe spec leaves free; stage 3 refused (DeepSpeed parity)."""
+
+    def test_opt_state_sharded_over_data(self, pp_mesh):
+        model = _model()
+        step = make_pp_lm_train_step(
+            pp_mesh, model=model, num_microbatches=2, zero_stage=1)
+        state = _pp_state(step.pipelined, jax.random.PRNGKey(0), opt="adam")
+        sh = step.state_shardings(state)
+        flat = jax.tree_util.tree_flatten_with_path(sh.opt_state)[0]
+        block_mu = [s for p, s in flat
+                    if "blocks" in str(p) and "mu" in str(p)
+                    and "qkv" in str(p) and "kernel" in str(p)]
+        assert block_mu, "no block moment shardings found"
+        for s in block_mu:
+            axes = [a for e in s.spec if e
+                    for a in ((e,) if isinstance(e, str) else e)]
+            assert "pipe" in axes and "data" in axes, s.spec
+        # Non-block (embedding) moments shard over data too.
+        embed_mu = [s for p, s in flat
+                    if "tok_embed" in str(p) and "mu" in str(p)]
+        assert embed_mu
+        for s in embed_mu:
+            axes = [a for e in s.spec if e
+                    for a in ((e,) if isinstance(e, str) else e)]
+            assert "data" in axes, s.spec
+
+    def test_pp_zero1_step_matches_pp_zero0(self, pp_mesh):
+        model = _model()
+        rng0 = jax.random.PRNGKey(0)
+        batch = make_lm_batch(_tokens())
+        results = {}
+        for stage in (0, 1):
+            step = make_pp_lm_train_step(
+                pp_mesh, model=model, num_microbatches=2, zero_stage=stage)
+            state = _pp_state(step.pipelined, rng0, opt="adam")
+            state = jax.device_put(state, step.state_shardings(state))
+            new_state, metrics = step(
+                state, jax.device_put(batch, step.batch_shardings),
+                jax.random.PRNGKey(7))
+            results[stage] = (new_state.params, float(metrics["loss"]))
+        np.testing.assert_allclose(results[0][1], results[1][1],
+                                   rtol=1e-6)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-6, rtol=1e-5),
+            results[0][0], results[1][0])
+
+    def test_stage3_refused(self, pp_mesh):
+        model = _model()
+        with pytest.raises(NotImplementedError, match="stage 3"):
+            make_pp_lm_train_step(
+                pp_mesh, model=model, num_microbatches=2, zero_stage=3)
+
+
+def test_circular_checkpoint_layout_guard(tmp_path):
+    """A checkpoint saved under one stacking layout must refuse to restore
+    into a different one (shape-identical but permuted weights)."""
+    from distributed_training_tpu.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    model = _model(num_layers=8)
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    plm = PipelinedLM(model, mesh, num_microbatches=4, virtual_stages=2)
+    state = _pp_state(plm, jax.random.PRNGKey(0))
+    save_checkpoint(str(tmp_path), 0, state,
+                    layout={"pipe_size": 4, "virtual_stages": 2})
+    # Same layout restores fine.
+    restored, nxt, st = restore_checkpoint(
+        str(tmp_path), 0, state,
+        layout={"pipe_size": 4, "virtual_stages": 2})
+    assert nxt == 1 and st == 0
+    # Different virtual_stages (or a GPipe run) refuses.
+    with pytest.raises(ValueError, match="PERMUTED"):
+        restore_checkpoint(str(tmp_path), 0, state,
+                           layout={"virtual_stages": 1})
+    # Legacy save without layout meta counts as identity: restoring into a
+    # circular run refuses too.
+    save_checkpoint(str(tmp_path), 1, state)
+    with pytest.raises(ValueError, match="PERMUTED"):
+        restore_checkpoint(str(tmp_path), 1, state,
+                           layout={"pipe_size": 4, "virtual_stages": 2})
