@@ -151,15 +151,15 @@ def restore_checkpoint(directory: str, epoch: int, state: Any,
     for key in saved_meta:
         if key in ("next_epoch", "epoch_step") or key.startswith("layout_"):
             meta_template[key] = np.int32(0)
-    restored = ckptr.restore(
-        path, item={"state": state_template, "meta": meta_template})
-    # Storage-layout guard BEFORE handing weights back: identical shapes
-    # can hide a permuted layout (the circular pipeline's layer stacking).
-    # Symmetric compare with default 1/identity on both sides, so legacy
-    # saves without the key count as identity and a saved non-identity key
-    # the caller did not declare still refuses.
-    saved_layout = {k[len("layout_"):]: int(v)
-                    for k, v in restored["meta"].items()
+    # Meta first (a handful of scalars, partial restore): the layout guard
+    # must refuse BEFORE the potentially-multi-GB state read. Identical
+    # shapes can hide a permuted layout (the circular pipeline's layer
+    # stacking); symmetric compare with default 1/identity on both sides,
+    # so legacy saves without the key count as identity and a saved
+    # non-identity key the caller did not declare still refuses.
+    meta = ckptr.restore(
+        path, item={"meta": meta_template}, partial_restore=True)["meta"]
+    saved_layout = {k[len("layout_"):]: int(v) for k, v in meta.items()
                     if k.startswith("layout_")}
     want_layout = {k: int(v) for k, v in (layout or {}).items()}
     for k in sorted(set(saved_layout) | set(want_layout)):
@@ -170,7 +170,10 @@ def restore_checkpoint(directory: str, epoch: int, state: Any,
                 f"but this run expects {k}={want}; the stacked arrays are "
                 f"shape-identical but PERMUTED — resume with the saving "
                 f"configuration instead of loading silently wrong weights")
-    meta = restored["meta"]
+    # Full STRICT restore (no partial_restore: a tree mismatch must raise,
+    # not silently hand back template values for missing leaves).
+    restored = ckptr.restore(
+        path, item={"state": state_template, "meta": meta_template})
     next_epoch = (int(meta["next_epoch"]) if "next_epoch" in meta
                   else int(meta["epoch"]) + 1)
     start_step = int(meta.get("epoch_step", 0))
