@@ -146,6 +146,10 @@ class MoEConfig:
 
     enabled: bool = False
     ep_world_size: int = 1
+    # One count for every MoE layer, or a per-layer list (DeepSpeed's
+    # `--num-experts 64 64 128` nargs surface, deepspeed_train.py:71-75);
+    # list length must be 1 or the number of MoE layers
+    # (models/gpt.py::moe_layer_experts).
     num_experts: Sequence[int] = (1,)
     mlp_type: str = "standard"  # standard | residual
     top_k: int = 1

@@ -104,6 +104,34 @@ class DecoderBlock(nn.Module):
         return x + y
 
 
+def moe_layer_experts(num_layers: int, moe_every: int,
+                      moe_num_experts) -> dict[int, int]:
+    """{layer index: expert count} for the MoE layers of a decoder stack.
+
+    ``moe_num_experts`` int → that count at every ``moe_every``-th layer;
+    tuple → DeepSpeed per-layer semantics (length 1 broadcasts; length =
+    number of MoE layers assigns in order; any other length raises — a
+    truncated or padded assignment would silently train a different
+    architecture than the flags describe).
+    """
+    counts = (tuple(int(c) for c in moe_num_experts)
+              if isinstance(moe_num_experts, (tuple, list))
+              else (int(moe_num_experts),))
+    if moe_every <= 0 or not any(counts):
+        return {}
+    layers = [i for i in range(num_layers)
+              if i % moe_every == moe_every - 1]
+    if len(counts) == 1:
+        counts = counts * len(layers)
+    if len(counts) != len(layers):
+        raise ValueError(
+            f"per-layer expert counts {counts} do not match the "
+            f"{len(layers)} MoE layers (num_layers={num_layers}, "
+            f"moe_every={moe_every}); pass one count or exactly "
+            f"{len(layers)}")
+    return dict(zip(layers, counts))
+
+
 def make_tok_embed(m: "TransformerLM", name: str | None = None) -> nn.Embed:
     """Token-embedding module; single source of its config for both the
     plain model and the pipelined executor (``parallel/pipeline.py``)."""
@@ -150,7 +178,12 @@ class TransformerLM(nn.Module):
     attn_impl: str = "exact"  # exact | flash (pallas kernel, unsharded path)
     # MoE: every ``moe_every``-th block (GShard convention: alternating)
     # swaps its dense FFN for an expert-parallel MoEMlp. 0 experts = dense.
-    moe_num_experts: int = 0
+    # An int applies to every MoE layer; a tuple gives PER-MOE-LAYER counts
+    # (DeepSpeed's `--num-experts 64 64 128` nargs surface,
+    # resnet/deepspeed/deepspeed_train.py:71-75) — length 1 broadcasts,
+    # length = number of MoE layers assigns in order, anything else raises
+    # (see moe_layer_experts).
+    moe_num_experts: int | tuple = 0
     moe_every: int = 2
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
@@ -219,9 +252,9 @@ class TransformerLM(nn.Module):
         # self); remat only matters when a backward pass exists.
         block_cls = (nn.remat(DecoderBlock, static_argnums=(2, 3))
                      if self.remat and not decode else DecoderBlock)
+        experts_by_layer = moe_layer_experts(
+            self.num_layers, self.moe_every, self.moe_num_experts)
         for i in range(self.num_layers):
-            is_moe = (self.moe_num_experts > 0 and self.moe_every > 0
-                      and i % self.moe_every == self.moe_every - 1)
             x = block_cls(
                 num_heads=self.num_heads,
                 mlp_dim=self.mlp_ratio * self.hidden_dim,
@@ -229,7 +262,7 @@ class TransformerLM(nn.Module):
                 seq_axis=self.seq_axis,
                 dropout_rate=self.dropout_rate,
                 attn_impl=self.attn_impl,
-                moe_num_experts=self.moe_num_experts if is_moe else 0,
+                moe_num_experts=experts_by_layer.get(i, 0),
                 moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,
                 moe_min_capacity=self.moe_min_capacity,
@@ -257,7 +290,7 @@ def make_transformer_lm(
     max_len: int = 2048,
     dropout_rate: float = 0.0,
     attn_impl: str = "exact",
-    moe_num_experts: int = 0,
+    moe_num_experts: int | tuple = 0,
     moe_every: int = 2,
     moe_top_k: int = 1,
     moe_capacity_factor: float = 1.25,

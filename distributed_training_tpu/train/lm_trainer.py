@@ -164,18 +164,16 @@ class LMTrainer:
                 raise ValueError(
                     f"num_microbatches {lm.num_microbatches} must divide "
                     f"the per-shard batch_size (= {cfg.data.batch_size})")
-        if cfg.moe.enabled and len(cfg.moe.num_experts) != 1:
-            # DeepSpeed's per-layer expert-count lists are not supported;
-            # refusing beats silently training with num_experts[0] only.
-            raise NotImplementedError(
-                f"per-layer expert counts {tuple(cfg.moe.num_experts)} are "
-                "not supported; pass a single num_experts value")
         if cfg.moe.enabled and expert > 1:
-            ne = int(cfg.moe.num_experts[0])
-            if ne % expert:
-                raise ValueError(
-                    f"expert-parallel size {expert} must divide "
-                    f"num_experts (= {ne})")
+            # Per-layer lists (DeepSpeed --num-experts nargs surface) are
+            # honored since round 4; EVERY layer's expert set shards over
+            # the expert axis, so each count must divide it.
+            for ne in cfg.moe.num_experts:
+                if int(ne) % expert:
+                    raise ValueError(
+                        f"expert-parallel size {expert} must divide every "
+                        f"per-layer num_experts "
+                        f"(= {tuple(cfg.moe.num_experts)})")
         if model_par > 1:
             # The megatron rule table shards heads / mlp columns / vocab over
             # the model axis; device_put fails opaquely on non-divisible
@@ -191,7 +189,7 @@ class LMTrainer:
         moe_kwargs = {}
         if cfg.moe.enabled:
             moe_kwargs = dict(
-                moe_num_experts=int(cfg.moe.num_experts[0]),
+                moe_num_experts=tuple(int(n) for n in cfg.moe.num_experts),
                 moe_top_k=cfg.moe.top_k,
                 moe_capacity_factor=cfg.moe.capacity_factor,
                 moe_min_capacity=cfg.moe.min_capacity,

@@ -82,11 +82,11 @@ def main() -> int:
     precision = PrecisionConfig(dtype=args.dtype)
     moe_kwargs = {}
     if args.moe:
-        if len(args.num_experts) != 1:
-            raise SystemExit("per-layer expert counts are not supported; "
-                             "pass a single --num-experts value")
+        # Per-layer lists build the same per-layer architecture training
+        # used (models/gpt.py::moe_layer_experts), so checkpoints trained
+        # with e.g. --num-experts 4 8 sample with the matching flags.
         moe_kwargs = dict(
-            moe_num_experts=int(args.num_experts[0]),
+            moe_num_experts=tuple(int(n) for n in args.num_experts),
             moe_top_k=args.moe_top_k,
             moe_min_capacity=args.min_capacity,
             moe_mlp_type=args.mlp_type,

@@ -186,3 +186,60 @@ class TestMoeParamGroup:
                          ((e,) if isinstance(e, str) else e)]
             assert "expert" not in flat_axes
             assert "data" in flat_axes, dense_specs
+
+
+class TestPerLayerExperts:
+    """DeepSpeed `--num-experts 4 8` per-layer lists (round 4): each MoE
+    layer builds its own expert count; EP sharding requires every count to
+    divide the expert axis."""
+
+    def test_layer_map(self):
+        from distributed_training_tpu.models.gpt import moe_layer_experts
+
+        assert moe_layer_experts(4, 2, (4, 8)) == {1: 4, 3: 8}
+        assert moe_layer_experts(4, 2, (4,)) == {1: 4, 3: 4}
+        assert moe_layer_experts(4, 2, 4) == {1: 4, 3: 4}
+        assert moe_layer_experts(4, 2, 0) == {}
+        with pytest.raises(ValueError, match="do not match"):
+            moe_layer_experts(4, 2, (4, 8, 16))
+
+    def test_model_builds_per_layer_counts(self):
+        from distributed_training_tpu.models import get_model
+
+        model = get_model(
+            "transformer_lm", num_classes=32, seq_axis=None,
+            num_layers=4, num_heads=2, hidden_dim=16, max_len=64,
+            moe_num_experts=(4, 8), moe_top_k=1)
+        params = model.init(
+            {"params": jax.random.PRNGKey(0), "gate": jax.random.PRNGKey(1)},
+            jnp.zeros((2, 8), jnp.int32), train=False)["params"]
+        assert params["block1"]["moe_mlp"]["experts"]["w1"].shape[0] == 4
+        assert params["block3"]["moe_mlp"]["experts"]["w1"].shape[0] == 8
+        assert "moe_mlp" not in params["block0"]
+        logits = model.apply(
+            {"params": params}, jnp.zeros((2, 8), jnp.int32),
+            rngs={"gate": jax.random.PRNGKey(2)})
+        assert np.all(np.isfinite(np.asarray(logits, np.float32)))
+
+    def test_trainer_end_to_end_per_layer(self):
+        cfg = TrainConfig(model="transformer_lm").replace(
+            num_epochs=1, log_interval=4,
+            data=DataConfig(batch_size=8, max_steps_per_epoch=4),
+            lm=LMConfig(seq_len=32, num_layers=4, num_heads=4, hidden_dim=32,
+                        max_len=64, train_sequences=64, eval_sequences=32),
+            moe=MoEConfig(enabled=True, num_experts=(4, 8), top_k=1),
+            mesh=MeshSpec(data=4, expert=2),
+        )
+        result = LMTrainer(cfg).fit()
+        assert np.isfinite(result["final_perplexity"])
+
+    def test_ep_divisibility_checked_per_layer(self):
+        cfg = TrainConfig(model="transformer_lm").replace(
+            data=DataConfig(batch_size=8),
+            lm=LMConfig(seq_len=32, num_layers=4, num_heads=4, hidden_dim=32,
+                        max_len=64),
+            moe=MoEConfig(enabled=True, num_experts=(4, 3), top_k=1),
+            mesh=MeshSpec(data=4, expert=2),
+        )
+        with pytest.raises(ValueError, match="every"):
+            LMTrainer(cfg)
