@@ -85,6 +85,33 @@ def _leaf_shapes(tree: Any, prefix: tuple = ()) -> dict[tuple, tuple]:
     return {prefix: tuple(getattr(tree, "shape", ()) or ())}
 
 
+def _legacy_vit_rename(saved_state: Any, new_state: dict) -> dict[str, str]:
+    """old-name → new-name map for pre-round-4 ViT saves (empty otherwise).
+
+    Round 4 named ViT's submodules for the TP rule table
+    (``models/vit.py``): flax auto names became ``attn``/``fc1``/``fc2``.
+    Detected structurally: the template's encoder blocks carry ``attn``
+    while the save carries the auto name. The map is applied at every tree
+    level by ``_rename_keys``; within a ViT state the auto names are
+    unambiguous (the only Dense_0/Dense_1 live under MlpBlock_0).
+    """
+    saved_params = (saved_state or {}).get("params")
+    new_params = new_state.get("params")
+    if not isinstance(saved_params, dict) or not isinstance(new_params, dict):
+        return {}
+    enc_new = new_params.get("encoder_0")
+    enc_old = saved_params.get("encoder_0")
+    if not (isinstance(enc_new, dict) and isinstance(enc_old, dict)):
+        return {}
+    if "attn" not in enc_new or "attn" in enc_old:
+        return {}
+    mapping = {"Dense_0": "fc1", "Dense_1": "fc2"}
+    for legacy in ("MultiHeadDotProductAttention_0", "RingSelfAttention_0"):
+        if legacy in enc_old:
+            mapping[legacy] = "attn"
+    return mapping
+
+
 def _legacy_block_rename(saved_state: Any, new_state: dict) -> dict[str, str]:
     """old-name → new-name map for pre-rename ResNet checkpoints (empty if
     the save already uses explicit names or the shapes don't line up).
@@ -141,6 +168,7 @@ def restore_checkpoint(directory: str, epoch: int, state: Any,
     saved = ckptr.metadata(path).item_metadata.tree or {}
     state_template = serialization.to_state_dict(state)
     rename = _legacy_block_rename(saved.get("state"), state_template)
+    rename.update(_legacy_vit_rename(saved.get("state"), state_template))
     if rename:
         # Present orbax a template keyed by the on-disk (legacy) names while
         # keeping the template's array leaves (shardings drive the restore).

@@ -26,11 +26,14 @@ class MlpBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):
+        # fc1/fc2 names match the megatron rule table
+        # (parallel/tensor_parallel.py): fc1 column-parallel, fc2
+        # row-parallel over the ``model`` mesh axis.
         d = x.shape[-1]
-        x = nn.Dense(self.mlp_dim, dtype=self.dtype)(x)
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
         x = nn.gelu(x)
         x = nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
-        x = nn.Dense(d, dtype=self.dtype)(x)
+        x = nn.Dense(d, dtype=self.dtype, name="fc2")(x)
         return nn.Dropout(self.dropout_rate)(x, deterministic=deterministic)
 
 
@@ -53,12 +56,17 @@ class EncoderBlock(nn.Module):
                 num_heads=self.num_heads,
                 dtype=self.dtype,
                 axis_name=self.seq_axis_name,
+                name="attn",
             )(y, deterministic=deterministic)
         else:
+            # Named so the TP rule table reaches the projections
+            # (query/key/value column-parallel over heads, out
+            # row-parallel).
             y = nn.MultiHeadDotProductAttention(
                 num_heads=self.num_heads,
                 dtype=self.dtype,
                 dropout_rate=self.dropout_rate,
+                name="attn",
             )(y, y, deterministic=deterministic)
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype)(x)

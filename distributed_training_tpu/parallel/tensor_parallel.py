@@ -53,6 +53,15 @@ LM_TP_RULES: tuple[tuple[str, P], ...] = (
     (r"attn/qkv/bias$", P(None, AXIS_MODEL, None)),
     (r"attn/out/kernel$", P(AXIS_MODEL, None, None)),
     (r"attn/out/bias$", P()),
+    # ViT blocks (flax MultiHeadDotProductAttention named 'attn',
+    # models/vit.py): separate q/k/v DenseGeneral projections [d, H, hd]
+    # shard heads (column-parallel); 'attn/out' reuses the row-parallel
+    # rule above (same [H, hd, d] layout). The classifier head is
+    # class-column-parallel like lm_head.
+    (r"attn/(?:query|key|value)/kernel$", P(None, AXIS_MODEL, None)),
+    (r"attn/(?:query|key|value)/bias$", P(AXIS_MODEL, None)),
+    (r"(?:^|/)head/kernel$", P(None, AXIS_MODEL)),
+    (r"(?:^|/)head/bias$", P(AXIS_MODEL)),
     (r"fc1/kernel$", P(None, AXIS_MODEL)),
     (r"fc1/bias$", P(AXIS_MODEL)),
     (r"fc2/kernel$", P(AXIS_MODEL, None)),

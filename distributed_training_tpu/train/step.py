@@ -266,6 +266,7 @@ def make_train_step(
     label_smoothing: float = 0.0,
     input_affine: tuple | None = None,
     cpu_offload: bool = False,
+    tensor_parallel: bool = False,
 ) -> Callable:
     """Build the GSPMD jitted train step for a mesh + ZeRO stage.
 
@@ -287,8 +288,19 @@ def make_train_step(
         treedef = jax.tree.structure((state, batch))
         fn = cache.get(treedef)
         if fn is None:
-            sshard = state_shardings(state, mesh, zero_stage,
-                                     cpu_offload=cpu_offload)
+            if tensor_parallel:
+                # Megatron placement by the shared rule table (ViT blocks:
+                # q/k/v column-parallel over heads, out/fc2 row-parallel,
+                # head class-parallel) + the same ZeRO/offload recruitment.
+                from distributed_training_tpu.parallel.tensor_parallel import (
+                    tp_state_shardings,
+                )
+
+                sshard = tp_state_shardings(state, mesh, zero_stage,
+                                            cpu_offload=cpu_offload)
+            else:
+                sshard = state_shardings(state, mesh, zero_stage,
+                                         cpu_offload=cpu_offload)
             bshard = {
                 "image": batch_sharding(mesh, batch["image"].ndim),
                 "label": batch_sharding(mesh, batch["label"].ndim),

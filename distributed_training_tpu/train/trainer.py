@@ -110,8 +110,35 @@ class Trainer:
         state = init_train_state(
             self.model, init_rng, input_shape, self.tx,
             loss_scale=LossScaleState.create(cfg.precision))
-        self.shardings = state_shardings(state, self.mesh, cfg.zero.stage,
-                                         cpu_offload=cfg.zero.cpu_offload)
+        mesh_shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        self.tp_size = mesh_shape.get("model", 1)
+        if self.tp_size > 1:
+            # Megatron TP for image transformers (round 4: the rule table
+            # covers ViT blocks). A model without matching rules would
+            # silently replicate its weights over the model axis — idle
+            # chips wearing a TP banner.
+            if not cfg.model.startswith("vit"):
+                raise NotImplementedError(
+                    f"a model mesh axis of {self.tp_size} is only wired for "
+                    f"the vit_* models (parallel/tensor_parallel.py rule "
+                    f"table); {cfg.model!r} would replicate over it")
+            # device_put fails opaquely on non-divisible dims; check here
+            # where the message can name the knob (mirrors lm_trainer).
+            for what, n in (("num_heads", self.model.num_heads),
+                            ("mlp_dim", self.model.mlp_dim),
+                            ("num_classes", cfg.data.num_classes)):
+                if n % self.tp_size:
+                    raise ValueError(
+                        f"tensor parallelism size {self.tp_size} must "
+                        f"divide {what} (= {n})")
+        if self.tp_size > 1:
+            from distributed_training_tpu.parallel.tensor_parallel import (
+                tp_state_shardings as shardings_fn,
+            )
+        else:
+            shardings_fn = state_shardings
+        self.shardings = shardings_fn(state, self.mesh, cfg.zero.stage,
+                                      cpu_offload=cfg.zero.cpu_offload)
         self.state = place_state(state, self.shardings)
 
         # Local-vs-sync BN only differs for models that actually carry
@@ -141,7 +168,8 @@ class Trainer:
                 grad_accum_steps=self.grad_accum,
                 label_smoothing=cfg.label_smoothing,
                 input_affine=input_affine,
-                cpu_offload=cfg.zero.cpu_offload)
+                cpu_offload=cfg.zero.cpu_offload,
+                tensor_parallel=self.tp_size > 1)
         else:
             if cfg.zero.stage != 0:
                 raise NotImplementedError(

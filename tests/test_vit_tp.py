@@ -1,0 +1,181 @@
+"""Megatron TP for ViT (round 4: VERDICT item 8).
+
+The rule table (parallel/tensor_parallel.py) reaches ViT blocks: q/k/v
+projections column-parallel over heads, attention-out and fc2 row-parallel,
+the classifier head class-parallel. The invariants: dp×tp placements
+actually shard the weights, and one dp×tp train step produces the same
+loss and updated params as the dp-only step (TP is a placement, not math).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_training_tpu.config import PrecisionConfig
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.parallel.sharding import place_state
+from distributed_training_tpu.parallel.tensor_parallel import (
+    tp_state_shardings,
+)
+from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+from distributed_training_tpu.train.precision import LossScaleState
+from distributed_training_tpu.train.step import make_train_step
+from distributed_training_tpu.train.train_state import init_train_state
+
+
+def _vit():
+    return get_model(
+        "vit_b16", num_classes=10, patch_size=4, hidden_size=32,
+        num_layers=2, num_heads=4, mlp_dim=64)
+
+
+def _state(model, shape=(8, 16, 16, 3)):
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+    return init_train_state(
+        model, jax.random.PRNGKey(0), shape, tx,
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+
+
+def _batch(n=8, size=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "image": rng.rand(n, size, size, 3).astype(np.float32),
+        "label": rng.randint(0, 10, n).astype(np.int32),
+    }
+
+
+def test_vit_tp_rules_hit_blocks():
+    model = _vit()
+    mesh = create_mesh(MeshConfig(data=4, model=2))
+    state = _state(model)
+    sh = tp_state_shardings(state, mesh)
+    p = sh.params
+    assert p["encoder_0"]["attn"]["query"]["kernel"].spec == \
+        P(None, "model", None)
+    assert p["encoder_0"]["attn"]["out"]["kernel"].spec == \
+        P("model", None, None)
+    assert p["encoder_0"]["MlpBlock_0"]["fc1"]["kernel"].spec == \
+        P(None, "model")
+    assert p["encoder_0"]["MlpBlock_0"]["fc2"]["kernel"].spec == \
+        P("model", None)
+    assert p["head"]["kernel"].spec == P(None, "model")
+    # Norms/pos-embed replicated.
+    assert p["encoder_norm"]["scale"].spec == P()
+
+
+def test_vit_dp_tp_step_matches_dp():
+    """dp×tp == dp: same loss, same updated params (grad equivalence)."""
+    model = _vit()
+    batch = _batch()
+    results = {}
+    for name, meshspec, tp in (
+            ("dp", MeshConfig(data=-1), False),
+            ("dp_tp", MeshConfig(data=4, model=2), True)):
+        mesh = create_mesh(meshspec)
+        state = _state(model)
+        if tp:
+            state = place_state(state, tp_state_shardings(state, mesh))
+        step = make_train_step(mesh, donate=False, tensor_parallel=tp)
+        new_state, metrics = step(state, batch, jax.random.PRNGKey(1))
+        results[name] = (jax.device_get(new_state.params),
+                         float(metrics["loss"]))
+    np.testing.assert_allclose(results["dp"][1], results["dp_tp"][1],
+                               rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=1e-4),
+        results["dp"][0], results["dp_tp"][0])
+
+
+def test_vit_tp_params_actually_sharded():
+    model = _vit()
+    mesh = create_mesh(MeshConfig(data=4, model=2))
+    state = _state(model)
+    state = place_state(state, tp_state_shardings(state, mesh))
+    step = make_train_step(mesh, donate=False, tensor_parallel=True)
+    new_state, _ = step(state, _batch(), jax.random.PRNGKey(1))
+    q = new_state.params["encoder_0"]["attn"]["query"]["kernel"]
+    assert q.sharding.spec == P(None, "model", None)
+    assert q.addressable_shards[0].data.shape[1] == 2  # 4 heads / 2 ranks
+
+
+def test_vit_tp_zero1_composes():
+    """TP × ZeRO-1: Adam moments recruit data on a TP-free dim."""
+    model = _vit()
+    mesh = create_mesh(MeshConfig(data=4, model=2))
+    state = _state(model)
+    sh = tp_state_shardings(state, mesh, zero_stage=1)
+    flat = jax.tree_util.tree_flatten_with_path(sh.opt_state)[0]
+    fc1_mu = [s for p, s in flat
+              if "fc1" in str(p) and "kernel" in str(p) and "mu" in str(p)]
+    assert fc1_mu
+    for s in fc1_mu:
+        axes = [a for e in s.spec if e
+                for a in ((e,) if isinstance(e, str) else e)]
+        assert "model" in axes and "data" in axes, s.spec
+
+
+def test_trainer_refuses_tp_for_resnet():
+    from distributed_training_tpu.config import DataConfig, MeshSpec, TrainConfig
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(model="resnet18").replace(
+        mesh=MeshSpec(data=4, model=2),
+        data=DataConfig(dataset="synthetic_cifar", batch_size=8,
+                        image_size=32, num_classes=10))
+    with pytest.raises(NotImplementedError, match="vit"):
+        Trainer(cfg)
+
+
+def test_legacy_vit_checkpoint_migrates(tmp_path):
+    """Pre-round-4 ViT saves used flax auto names
+    (MultiHeadDotProductAttention_0 / Dense_0); restore migrates them to
+    the TP-rule names (attn / fc1 / fc2)."""
+    from flax import serialization
+
+    from distributed_training_tpu.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    model = _vit()
+    state = _state(model)
+    # Synthesize a legacy-named save from the current state.
+    legacy = serialization.to_state_dict(state)
+
+    def rename(tree):
+        if not isinstance(tree, dict):
+            return tree
+        out = {}
+        for k, v in tree.items():
+            k = {"attn": "MultiHeadDotProductAttention_0",
+                 "fc1": "Dense_0", "fc2": "Dense_1"}.get(k, k)
+            out[k] = rename(v)
+        return out
+
+    import orbax.checkpoint as ocp
+
+    ocp.PyTreeCheckpointer().save(
+        str(tmp_path / "epoch_0"),
+        {"state": rename(legacy),
+         "meta": {"epoch": __import__("numpy").int32(0)}}, force=True)
+    restored, nxt, _ = restore_checkpoint(str(tmp_path), 0, state)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        jax.device_get(restored.params), jax.device_get(state.params))
+
+
+def test_trainer_tp_divisibility_guard():
+    from distributed_training_tpu.config import DataConfig, MeshSpec, TrainConfig
+    from distributed_training_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(model="vit_b16").replace(
+        mesh=MeshSpec(data=1, model=8),
+        data=DataConfig(dataset="synthetic_cifar", batch_size=8,
+                        image_size=32, num_classes=10))
+    with pytest.raises(ValueError, match="must divide"):
+        Trainer(cfg)
