@@ -244,6 +244,146 @@ def bench_data_only(args) -> None:
     }))
 
 
+def bench_data_concurrent(args) -> None:
+    """Host pipeline measured CONCURRENT with training (round 4).
+
+    The --data-only numbers measure the loader on an idle host; the real
+    question is whether the host feeds the chip while the training loop,
+    dispatch, and metric fetches compete for the same core(s). This mode
+    trains ResNet-50 end-to-end on REAL batches from the decoded cache
+    (multi-worker assembly + double-buffered device prefetch) and
+    simultaneously runs a second flat-out loader in a stress thread:
+
+    - ``value`` = end-to-end train img/s on real data (vs the
+      device-resident synthetic bound, BENCH_BASELINE.json image value);
+    - ``spare_host_images_per_sec`` = what the stress loader sustained
+      DURING training — the headroom available to feed additional chips.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from distributed_training_tpu.data.decoded_cache import (
+        DecodedCacheLoader,
+        build_decoded_cache,
+    )
+    from distributed_training_tpu.data.prefetch import DevicePrefetcher
+
+    platform = ensure_live_backend()
+    if platform == "cpu":
+        args.batch_size = min(args.batch_size, 32)
+        args.image_size = min(args.image_size, 64)
+        args.steps = min(args.steps, 6)
+        args.data_images = min(args.data_images, 256)
+
+    from PIL import Image
+
+    n_chips_probe = jax.device_count()
+    # A global batch larger than the dataset would make every epoch yield
+    # zero batches (drop_last) and the feed loop spin forever.
+    min_images = 2 * args.batch_size * n_chips_probe
+    if args.data_images < min_images:
+        print(f"bench: --data-images {args.data_images} < 2x the global "
+              f"batch; raising to {min_images}", file=sys.stderr)
+        args.data_images = min_images
+
+    root = tempfile.mkdtemp(prefix="bench_concurrent_")
+    try:
+        rng = np.random.RandomState(0)
+        paths, labels = [], []
+        for i in range(args.data_images):
+            arr = rng.randint(0, 255, (256, 256, 3), dtype=np.uint8)
+            p = os.path.join(root, f"im{i}.jpg")
+            Image.fromarray(arr).save(p, quality=85)
+            paths.append(p)
+            labels.append(i % 8)
+        cache = os.path.join(root, f"cache_{args.image_size}")
+        build_decoded_cache(paths, labels, cache,
+                            image_size=args.image_size,
+                            num_workers=args.data_workers)
+
+        n_chips = jax.device_count()
+        batch = args.batch_size * n_chips
+        mesh, state, step = build(
+            args.model, batch, args.image_size, 8,
+            grad_accum=1)
+        from distributed_training_tpu.parallel.sharding import batch_sharding
+
+        shardings = {"image": batch_sharding(mesh, 4),
+                     "label": batch_sharding(mesh, 1)}
+
+        def loader():
+            return DecodedCacheLoader(
+                cache, global_batch_size=batch, augment="pad_crop_flip",
+                train=True, process_index=0, process_count=1,
+                num_workers=args.data_workers)
+
+        def batches():
+            ld = loader()
+            epoch = 0
+            while True:
+                ld.set_epoch(epoch)
+                yield from ld
+                epoch += 1
+
+        place = lambda b: jax.device_put(b, shardings)  # noqa: E731
+        key = jax.random.PRNGKey(0)
+
+        # Stress loader: counts host images assembled while training runs.
+        stress_count = [0]
+        stop = threading.Event()
+
+        def stress():
+            ld = loader()
+            epoch = 100
+            while not stop.is_set():
+                ld.set_epoch(epoch)
+                for b in ld:
+                    stress_count[0] += len(b["label"])
+                    if stop.is_set():
+                        return
+                epoch += 1
+
+        it = iter(DevicePrefetcher(batches(), place, depth=2))
+        for _ in range(args.warmup):
+            state, metrics = step(state, next(it), key)
+        if args.warmup:
+            float(metrics["loss"])
+
+        t = threading.Thread(target=stress, daemon=True)
+        t0 = time.perf_counter()
+        if args.data_stress:
+            t.start()
+        for i in range(args.steps):
+            state, metrics = step(state, next(it), key)
+            if args.sync_interval > 0 and (i + 1) % args.sync_interval == 0:
+                float(metrics["loss"])
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+        stop.set()
+        if args.data_stress:
+            t.join(timeout=30)
+
+        img_s = args.steps * batch / dt / n_chips
+        result = {
+            "metric": f"{args.model} end-to-end train on decoded cache "
+                      f"(real batches, {args.data_workers} workers, "
+                      f"prefetch 2, batch {args.batch_size}/chip, "
+                      f"{n_chips} {platform} chip(s))"
+                      + (" + concurrent stress loader"
+                         if args.data_stress else ""),
+            "value": round(img_s, 2),
+            "unit": "images/sec/chip",
+            "vs_baseline": round(img_s / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4),
+        }
+        if args.data_stress:
+            result["spare_host_images_per_sec"] = round(
+                stress_count[0] / dt, 1)
+        print(json.dumps(result))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_lm(args) -> None:
     """GPT-2-small train throughput in tokens/sec (BASELINE.md LM rows).
 
@@ -407,6 +547,16 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--data-only", action="store_true", default=False,
                     help="bench the HOST input pipeline instead of the "
                          "device step (no TPU touched)")
+    ap.add_argument("--data-stress", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="run the flat-out stress loader during "
+                         "--data-concurrent (measures spare host capacity; "
+                         "on a 1-core host it competes with the trainer)")
+    ap.add_argument("--data-concurrent", action="store_true", default=False,
+                    help="train on REAL decoded-cache batches while a "
+                         "stress loader measures spare host capacity "
+                         "(the concurrent-with-training measurement "
+                         "--data-only cannot give)")
     ap.add_argument("--data-mode", default="both",
                     choices=["imagefolder", "cached", "augment", "both"])
     ap.add_argument("--data-path", default=None,
@@ -451,6 +601,9 @@ def main():
 
     if args.data_only:
         bench_data_only(args)
+        return
+    if args.data_concurrent:
+        bench_data_concurrent(args)
         return
     if args.check:
         run_check(args)

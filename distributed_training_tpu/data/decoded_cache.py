@@ -148,6 +148,7 @@ class DecodedCacheLoader(ShardedBatchIndexer):
         process_index: int | None = None,
         process_count: int | None = None,
         max_steps: int | None = None,
+        num_workers: int = 0,
     ):
         with open(cache_path + ".meta.json") as fh:
             meta = json.load(fh)
@@ -163,6 +164,14 @@ class DecodedCacheLoader(ShardedBatchIndexer):
             raise ValueError(f"unknown augment mode {augment!r}")
         self.augment = augment
         self.train = train
+        # num_workers > 0: assemble batches in a thread pool, a bounded
+        # window ahead of the consumer (the gather/crop C kernel and the
+        # memmap reads release the GIL, so workers overlap each other AND
+        # the trainer's dispatch). Order and RNG draws are preserved: all
+        # randomness is drawn sequentially in the producer, only the
+        # assembly is parallel — num_workers changes throughput, never the
+        # batch stream.
+        self.num_workers = int(num_workers)
         super().__init__(
             len(self.labels), global_batch_size=global_batch_size,
             shuffle=shuffle, drop_last=drop_last, seed=seed,
@@ -172,15 +181,49 @@ class DecodedCacheLoader(ShardedBatchIndexer):
     def __iter__(self) -> Iterator[dict]:
         return self.iter_from(0)
 
-    def iter_from(self, start_step: int) -> Iterator[dict]:
+    def _assemble(self, lidx, pad, xs, ys, flips) -> dict:
+        """Gather + crop/flip one batch (GIL-releasing hot path)."""
         from distributed_training_tpu.ops.native import native
 
+        size = self.image_size
+        n = len(lidx)
+        # Emit uint8: ToTensor (/255) and the normalize_only affine run
+        # ON DEVICE (train/step.py::_input_images) fused into the first
+        # conv — the host stays crop/flip-bound (memcpy-speed) and the
+        # host→device transfer is 4× smaller than f32.
+        if native.available():
+            # Fused C gather+crop reads windows straight from the
+            # memmap: no intermediate [n, base, base, 3] copy.
+            out = native.gather_crop_flip(
+                self.images, lidx, ys, xs, flips, size)
+        else:
+            gathered = self.images[lidx]
+            out = np.empty((n, size, size, 3), np.uint8)
+            for j in range(n):
+                crop = gathered[j, ys[j]:ys[j] + size, xs[j]:xs[j] + size]
+                if flips[j]:
+                    crop = crop[:, ::-1]
+                out[j] = crop
+        labels = self.labels[lidx].astype(np.int32)
+        mask = np.ones(n, np.float32)
+        if pad:
+            out = np.concatenate(
+                [out, np.zeros((pad, size, size, 3), np.uint8)])
+            labels = np.concatenate([labels, np.zeros(pad, np.int32)])
+            mask = np.concatenate([mask, np.zeros(pad, np.float32)])
+        batch = {"image": out, "label": labels}
+        if not self.drop_last:
+            batch["mask"] = mask
+        return batch
+
+    def _batch_args(self, start_step: int) -> Iterator[tuple]:
+        """(lidx, pad, xs, ys, flips) per batch — ALL randomness drawn here,
+        sequentially, so worker count never changes the stream."""
         size, base = self.image_size, self.base
         span = base - size + 1
         rng = np.random.RandomState(
             (self.seed * 7 + self.epoch * 13 + self.process_index) % (2 ** 31))
         randomize = self.train and self.augment == "pad_crop_flip"
-        use_native = native.available()
         for lidx, pad in self.batches(start_step):
             n = len(lidx)
             if randomize:
@@ -190,31 +233,28 @@ class DecodedCacheLoader(ShardedBatchIndexer):
             else:
                 xs = ys = np.full(n, (base - size) // 2)
                 flips = np.zeros(n, np.int64)
-            # Emit uint8: ToTensor (/255) and the normalize_only affine run
-            # ON DEVICE (train/step.py::_input_images) fused into the first
-            # conv — the host stays crop/flip-bound (memcpy-speed) and the
-            # host→device transfer is 4× smaller than f32.
-            if use_native:
-                # Fused C gather+crop reads windows straight from the
-                # memmap: no intermediate [n, base, base, 3] copy.
-                out = native.gather_crop_flip(
-                    self.images, lidx, ys, xs, flips, size)
-            else:
-                gathered = self.images[lidx]
-                out = np.empty((n, size, size, 3), np.uint8)
-                for j in range(n):
-                    crop = gathered[j, ys[j]:ys[j] + size, xs[j]:xs[j] + size]
-                    if flips[j]:
-                        crop = crop[:, ::-1]
-                    out[j] = crop
-            labels = self.labels[lidx].astype(np.int32)
-            mask = np.ones(n, np.float32)
-            if pad:
-                out = np.concatenate(
-                    [out, np.zeros((pad, size, size, 3), np.uint8)])
-                labels = np.concatenate([labels, np.zeros(pad, np.int32)])
-                mask = np.concatenate([mask, np.zeros(pad, np.float32)])
-            batch = {"image": out, "label": labels}
-            if not self.drop_last:
-                batch["mask"] = mask
-            yield batch
+            yield lidx, pad, xs, ys, flips
+
+    def iter_from(self, start_step: int) -> Iterator[dict]:
+        if self.num_workers <= 0:
+            for args in self._batch_args(start_step):
+                yield self._assemble(*args)
+            return
+        # Ordered sliding window of in-flight assemblies: submit up to
+        # 2×workers ahead, always yield the oldest — double buffering
+        # generalized to a pool.
+        from collections import deque
+
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            window: deque = deque()
+            args_it = self._batch_args(start_step)
+            try:
+                for args in args_it:
+                    window.append(pool.submit(self._assemble, *args))
+                    if len(window) > 2 * self.num_workers:
+                        yield window.popleft().result()
+                while window:
+                    yield window.popleft().result()
+            finally:
+                for f in window:
+                    f.cancel()

@@ -281,7 +281,8 @@ def build_dataloaders(cfg, coordinator=None, *, seed: int = 0,
             else:
                 _build()
             cached = dict(image_size=data.image_size, seed=seed,
-                          augment=data.augment)
+                          augment=data.augment,
+                          num_workers=data.num_workers)
             train_loader = DecodedCacheLoader(
                 os.path.join(cache_root, f"train_{data.image_size}"),
                 global_batch_size=global_bs, shuffle=True,

@@ -131,7 +131,6 @@ class Trainer:
                     raise ValueError(
                         f"tensor parallelism size {self.tp_size} must "
                         f"divide {what} (= {n})")
-        if self.tp_size > 1:
             from distributed_training_tpu.parallel.tensor_parallel import (
                 tp_state_shardings as shardings_fn,
             )

@@ -186,3 +186,25 @@ def test_uint8_batch_trains_end_to_end(tree, mesh):
                              jax.random.PRNGKey(1))
     np.testing.assert_allclose(
         float(m_norm_u8["loss"]), float(m_norm_f32["loss"]), rtol=1e-5)
+
+
+def test_multi_worker_stream_identical(tree):
+    """num_workers parallelizes ASSEMBLY only: the batch stream (order,
+    crops, flips, padding) is byte-identical to the inline path — all
+    randomness is drawn sequentially in the producer."""
+    _, _, _, cache = tree
+
+    def batches(workers):
+        ld = DecodedCacheLoader(
+            cache, global_batch_size=6, train=True, drop_last=False,
+            augment="pad_crop_flip", process_index=0, process_count=1,
+            num_workers=workers)
+        ld.set_epoch(3)
+        return list(ld)
+
+    base = batches(0)
+    multi = batches(3)
+    assert len(base) == len(multi) > 0
+    for a, b in zip(base, multi):
+        np.testing.assert_array_equal(a["image"], b["image"])
+        np.testing.assert_array_equal(a["label"], b["label"])
