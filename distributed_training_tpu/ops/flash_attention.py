@@ -10,9 +10,15 @@ for the hot ops").
 Layout: grid (batch·heads, q_blocks, k_blocks), k innermost — TPU grids run
 sequentially, so the (acc, m, l) scratch persists across the k sweep of one
 q block (the flash recurrence), initialized at k==0 and normalized into the
-output at the last k step. The backward pass is two more Pallas kernels (dq;
-dk/dv) over the same tiling, with probabilities recomputed from the saved
-logsumexp rather than stored — the standard flash-attention VJP.
+output at the last k step. The backward pass is ONE fused Pallas kernel
+(round 4): dq/dk/dv share the recomputed scores and probabilities; dk/dv
+accumulate in VMEM scratch across the q sweep while dq writes per-k-block
+partials that XLA sums outside (``_fused_bwd_kernel``). Probabilities are
+recomputed from the saved logsumexp rather than stored — the standard
+flash-attention VJP. (An interior-tile mask-skip specialization — branch
+per tile so fully-below-diagonal tiles skip the iota/compare/select —
+was tried and measured NO faster at T1024/4096/16384: the VPU cost there
+is the exp, not the mask; reverted to keep one code path.)
 
 Off-TPU (tests, CPU mesh) the kernels run in pallas interpret mode,
 bit-compatible with the compiled path. Block sizes default to the 128-lane
