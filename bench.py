@@ -413,7 +413,8 @@ def bench_lm(args) -> None:
         "transformer_lm", num_classes=50304, dtype=jnp.bfloat16,
         num_layers=12, num_heads=12, hidden_dim=768,
         max_len=args.seq_len, attn_impl=args.attn_impl,
-        logits_dtype=parse_logits_dtype(args.logits_dtype))
+        logits_dtype=parse_logits_dtype(args.logits_dtype),
+        head_bias=not args.no_head_bias)
     if args.lm_optimizer == "hybrid_adam":
         from distributed_training_tpu.ops.fused_adam import fused_adam
 
@@ -481,12 +482,14 @@ def bench_lm(args) -> None:
                           and not args.ce_chunk and not args.no_accuracy
                           and args.lm_optimizer == "adamw"
                           and args.logits_dtype == "fp32"
+                          and not args.no_head_bias
                           and steps_per_call == 1)
     result = {
         "metric": f"GPT-2-small train throughput (bf16 "
                   f"{'HybridAdam' if args.lm_optimizer == 'hybrid_adam' else 'AdamW'}, B"
                   f"{args.lm_batch} T{args.seq_len} {args.attn_impl}"
                   f"{', logits:bf16' if args.logits_dtype == 'bf16' else ''}"
+                  f"{', no-head-bias' if args.no_head_bias else ''}"
                   f"{', chunked CE' if args.ce_chunk else ''}"
                   f"{', no-acc-metric' if args.no_accuracy else ''}"
                   f"{', steps/call:' + str(steps_per_call) if steps_per_call > 1 else ''}, "
@@ -580,6 +583,9 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["fp32", "bf16"],
                     help="bf16: halve the [B,T,vocab] logits HBM traffic "
                          "(CE still reduces in fp32; see models/gpt.py)")
+    ap.add_argument("--no-head-bias", action="store_true", default=False,
+                    help="drop the lm_head bias (GPT-2 parity; its grad "
+                         "is a full HBM pass over the logits)")
     ap.add_argument("--no-accuracy", action="store_true", default=False,
                     help="skip the per-step train-accuracy argmax (a full "
                          "extra HBM pass over the logits; the reference "

@@ -150,7 +150,11 @@ def make_lm_head(m: "TransformerLM", name: str | None = None) -> nn.Dense:
     # (profiles/gpt_t1024_r4.json: the head fusions at 330-420 GB/s). The
     # CE still reduces in fp32 (the loss path upcasts in-register); only
     # the stored logits are rounded, a ~2^-8 relative perturbation.
-    return nn.Dense(m.vocab_size, dtype=m.logits_dtype, name=name)
+    # head_bias=False drops the bias the real GPT-2 head never had — its
+    # gradient is a sum over all B·T rows of dlogits, a full extra HBM
+    # pass over the [B, T, vocab] tensor (profiled 2.3 ms/step).
+    return nn.Dense(m.vocab_size, dtype=m.logits_dtype,
+                    use_bias=m.head_bias, name=name)
 
 
 def add_pos_embed(m: "TransformerLM", pos_tab, x, positions):
@@ -173,6 +177,7 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: Any = jnp.float32
     logits_dtype: Any = jnp.float32  # see make_lm_head
+    head_bias: bool = True           # see make_lm_head
     seq_axis: str | None = None
     dropout_rate: float = 0.0
     attn_impl: str = "exact"  # exact | flash (pallas kernel, unsharded path)
@@ -300,6 +305,7 @@ def make_transformer_lm(
     moe_expert_axis: str | None = None,
     remat: bool = False,
     logits_dtype: Any = jnp.float32,
+    head_bias: bool = True,
 ) -> TransformerLM:
     """Registry factory. ``num_classes`` doubles as vocab size; ``axis_name``
     (the registry's SyncBN slot) is unused — LM has no BatchNorm. Unknown
@@ -327,4 +333,5 @@ def make_transformer_lm(
         moe_expert_axis=moe_expert_axis,
         remat=remat,
         logits_dtype=logits_dtype,
+        head_bias=head_bias,
     )

@@ -137,7 +137,8 @@ def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
         raise ValueError(f"ce_chunk {chunk} must divide sequence length {t}")
     n = t // chunk
     w = head_params["kernel"].astype(logits_dtype)
-    bias = head_params["bias"].astype(logits_dtype)
+    bias = (head_params["bias"].astype(logits_dtype)
+            if "bias" in head_params else None)
     hs = jnp.swapaxes(hidden.reshape(b, n, chunk, d), 0, 1)  # [n, B, C, D]
     ts = jnp.swapaxes(targets.reshape(b, n, chunk), 0, 1)    # [n, B, C]
 
@@ -145,7 +146,9 @@ def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
     def body(carry, xs):
         ce_sum, acc_sum = carry
         hc, tc = xs
-        logits = hc.astype(logits_dtype) @ w + bias
+        logits = hc.astype(logits_dtype) @ w
+        if bias is not None:
+            logits = logits + bias
         ce = _fused_ce_rows(logits, tc).sum()
         acc = (jnp.sum((jnp.argmax(logits, -1) == tc).astype(jnp.float32))
                if accuracy_metric else jnp.float32(0))

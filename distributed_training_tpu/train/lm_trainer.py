@@ -210,6 +210,7 @@ class LMTrainer:
             max_len=lm.max_len,
             attn_impl=lm.attn_impl,
             logits_dtype=parse_logits_dtype(lm.logits_dtype),
+            head_bias=lm.head_bias,
             **moe_kwargs,
         )
         self.world_size = data_axis_size(self.mesh)

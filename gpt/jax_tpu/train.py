@@ -51,6 +51,10 @@ def add_argument() -> argparse.Namespace:
                         help="head/logits compute dtype; bf16 halves the "
                              "[B,T,vocab] HBM traffic (CE reduces in fp32 "
                              "either way)")
+    parser.add_argument("--no-head-bias", action="store_true", default=False,
+                        help="drop the lm_head bias (GPT-2's real head has "
+                             "none; its gradient costs a full HBM pass "
+                             "over the logits)")
     # MoE surface (DeepSpeed flag names, resnet/deepspeed parity) — here
     # they swap alternating decoder FFNs for expert-parallel MoE layers.
     parser.add_argument("--moe", action="store_true", default=False)
@@ -157,6 +161,7 @@ def build_config(args: argparse.Namespace):
             attn_impl=args.attn_impl,
             ce_chunk_size=args.ce_chunk_size,
             logits_dtype=args.logits_dtype,
+            head_bias=not args.no_head_bias,
             corpus_path=args.corpus,
         ),
     )

@@ -255,3 +255,27 @@ class TestLogitsDtype:
             logits_dtype=jnp.bfloat16)
         np.testing.assert_allclose(np.asarray(ce), np.asarray(want),
                                    rtol=1e-5)
+
+
+class TestHeadBias:
+    """head_bias=False (GPT-2's real head has none): the param disappears,
+    forward stays finite, and the chunked CE tolerates the missing bias."""
+
+    def test_no_bias_param_and_chunked_ce_matches(self):
+        model = _model(head_bias=False)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 8), jnp.int32))["params"]
+        assert "bias" not in params["lm_head"]
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, VOCAB, (2, 17)), jnp.int32)
+        batch = make_lm_batch(toks)
+        logits = model.apply({"params": params}, batch["tokens"])
+        from distributed_training_tpu.train.lm_step import _fused_softmax_ce
+
+        want = _fused_softmax_ce(logits, batch["targets"])
+        hidden = model.apply({"params": params}, batch["tokens"],
+                             return_hidden=True)
+        ce, _ = chunked_ce_and_accuracy(
+            hidden, params["lm_head"], batch["targets"], 8)
+        np.testing.assert_allclose(np.asarray(ce), np.asarray(want),
+                                   rtol=1e-5)
