@@ -131,3 +131,21 @@ def test_metrics_accuracy_off_drops_key_same_loss(tmp_path):
     m_off = off.train_epoch(0, train_off)
     assert "accuracy" in m_on and "accuracy" not in m_off
     assert m_off["loss"] == pytest.approx(m_on["loss"], rel=1e-6)
+
+
+def test_lm_trainer_circular_pipeline_zero1(tmp_path):
+    """Round-4 knobs through the PRODUCT surface: LMTrainer with the
+    circular schedule (virtual_stages=2), PP×ZeRO-1, bf16 logits, and no
+    head bias trains and evaluates finitely."""
+    import dataclasses
+
+    cfg = _cfg(MeshSpec(data=4, pipe=2), tmp_path, zero=1, epochs=1)
+    cfg = cfg.replace(lm=dataclasses.replace(
+        LM, num_layers=4, virtual_stages=2, logits_dtype="bf16",
+        head_bias=False))
+    trainer = LMTrainer(cfg)
+    assert trainer.train_step.pipelined.virtual_stages == 2
+    assert trainer.train_step.pipelined.bubble_fraction < 1 / 3
+    assert "bias" not in trainer.state.params["lm_head"]
+    result = trainer.fit()
+    assert np.isfinite(result["final_perplexity"])
