@@ -182,7 +182,9 @@ def test_committed_artifact_covers_all_strategies():
     strategies = report["strategies"]
     for expected in ("image dp (zero-0)", "image dp×fsdp zero-1",
                      "image dp zero-3", "lm dp×tp zero-1", "lm dp×pp (gpipe)",
-                     "lm dp×ep (moe)", "lm dp×sp (ring)", "lm dp×sp zero-1",
+                     "lm dp×pp zero-1", "lm dp×pp circular (v=2)",
+                     "lm dp×ep (moe)", "image vit dp×tp zero-1",
+                     "lm dp×sp (ring)", "lm dp×sp zero-1",
                      "lm dp×sp×tp", "lm dp×sp×ep"):
         assert expected in strategies, expected
         assert strategies[expected]["collectives"], expected
@@ -201,6 +203,21 @@ def test_committed_artifact_covers_all_strategies():
     assert "all-gather" in strategies["lm dp×sp zero-1"]["collectives"]
     assert "collective-permute" in strategies["lm dp×pp (gpipe)"][
         "collectives"]
+    # Round 4: PP×ZeRO-1 adds the opt-state all-gather beside the GPipe
+    # ppermute; the circular schedule keeps the SAME static ppermute count
+    # (the ring wraps v× — more trips, not more compiled collectives).
+    ppz = strategies["lm dp×pp zero-1"]["collectives"]
+    assert "all-gather" in ppz and "collective-permute" in ppz
+    assert "all-gather" not in strategies["lm dp×pp (gpipe)"]["collectives"]
+    circ = strategies["lm dp×pp circular (v=2)"]["collectives"]
+    assert circ["collective-permute"]["count"] == \
+        strategies["lm dp×pp (gpipe)"]["collectives"][
+            "collective-permute"]["count"]
+    # ViT×TP: row-parallel psums (> the one DP grad all-reduce) + zero-1
+    # gathers.
+    vit = strategies["image vit dp×tp zero-1"]["collectives"]
+    assert vit["all-reduce"]["count"] > 2
+    assert "all-gather" in vit
 
 
 def test_parser_handles_tuple_and_async_forms():

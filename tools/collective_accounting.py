@@ -127,16 +127,26 @@ def strategy_cases(devices):
            *lm_case(tp_mesh, step, _lm_state(model)))
 
     pp_mesh = create_mesh(MeshConfig(data=n // 2, pipe=2), devices=devices)
-    step = make_pp_lm_train_step(pp_mesh, model=model, num_microbatches=2,
-                                 donate=False)
-    pp_state = TrainState.create(
-        apply_fn=step.pipelined.apply_fn,
-        params=step.pipelined.init_params(jax.random.PRNGKey(0)),
-        tx=optax.adam(1e-3),
-        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
-    yield ("lm dp×pp (gpipe)",
-           dict(zip(pp_mesh.axis_names, pp_mesh.devices.shape)),
-           *lm_case(pp_mesh, step, pp_state))
+
+    def pp_case(name, pp_model, **kw):
+        step = make_pp_lm_train_step(pp_mesh, model=pp_model,
+                                     num_microbatches=2, donate=False, **kw)
+        st = TrainState.create(
+            apply_fn=step.pipelined.apply_fn,
+            params=step.pipelined.init_params(jax.random.PRNGKey(0)),
+            tx=optax.adam(1e-3),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+        return (name, dict(zip(pp_mesh.axis_names, pp_mesh.devices.shape)),
+                *lm_case(pp_mesh, step, st))
+
+    # PP×ZeRO-1 and the circular schedule (round 4): zero-1 adds the
+    # opt-state all-gather over data beside the GPipe ppermute; circular
+    # keeps the SAME static ppermute count (the ring wraps v× — more
+    # trips, not more collectives in the compiled program).
+    yield pp_case("lm dp×pp (gpipe)", model)
+    yield pp_case("lm dp×pp zero-1", model, zero_stage=1)
+    yield pp_case("lm dp×pp circular (v=2)", _lm_model(num_layers=4),
+                  virtual_stages=2)
 
     ep_mesh = create_mesh(MeshConfig(data=n // 2, expert=2), devices=devices)
     ep_model = _lm_model(moe_num_experts=4, moe_top_k=1,
@@ -145,6 +155,34 @@ def strategy_cases(devices):
     yield ("lm dp×ep (moe)",
            dict(zip(ep_mesh.axis_names, ep_mesh.devices.shape)),
            *lm_case(ep_mesh, step, _lm_state(ep_model)))
+
+    # ViT×TP (round 4): megatron placement of the image transformer — the
+    # per-block row-parallel psums appear exactly as in the LM TP case.
+    vit_model = get_model("vit_b16", num_classes=10, patch_size=4,
+                          hidden_size=32, num_layers=2, num_heads=2,
+                          mlp_dim=64)
+    vit_state = init_train_state(
+        vit_model, jax.random.PRNGKey(0), (n, 8, 8, 3), optax.adam(1e-3),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+    from distributed_training_tpu.parallel.tensor_parallel import (
+        tp_state_shardings,
+    )
+
+    vit_state = place_state(vit_state,
+                            tp_state_shardings(vit_state, tp_mesh,
+                                               zero_stage=1))
+    vit_step = make_train_step(tp_mesh, zero_stage=1, donate=False,
+                               tensor_parallel=True)
+    rngv = np.random.RandomState(0)
+    vit_batch = {
+        "image": rngv.rand(n, 8, 8, 3).astype(np.float32),
+        "label": rngv.randint(0, 10, n).astype(np.int32),
+    }
+    acct = step_collectives(vit_step, vit_state, vit_batch,
+                            jax.random.PRNGKey(1))
+    yield ("image vit dp×tp zero-1",
+           dict(zip(tp_mesh.axis_names, tp_mesh.devices.shape)),
+           acct, 4 * param_count(vit_state.params))
 
     sp_mesh = create_mesh(MeshConfig(data=n // 2, sequence=2),
                           devices=devices)
