@@ -252,10 +252,17 @@ class LMConfig:
     # the (per-shard) sequence length; not supported with the pipeline
     # executor.
     ce_chunk_size: int | None = None
+    # CE backward from saved bf16 softmax probs instead of re-reading the
+    # logits and re-running exp in both lm_head backward matmul fusions.
+    # Measured +2.2k tok/s under fp32 logits (117.2k → 119.4k, GPT-2-small
+    # B16 T1024), a small LOSS under bf16 logits (the backward reads are
+    # already bf16) — use with logits_dtype="fp32" only. Does not compose
+    # with ce_chunk_size (train/lm_step.py::_check_ce_options).
+    ce_save_probs: bool = False
     # Per-step train token accuracy: a bonus metric over the reference's
-    # loss-only logging. The argmax is a full extra HBM pass over the
-    # [B, T, vocab] logits (measured 4.4 ms / +3.8% tok/s on GPT-2-small
-    # T1024); turn it off for peak-throughput runs.
+    # loss-only logging. Derived from the CE's own row max since round 5
+    # (tie-inclusive top-1, no extra HBM pass) so it is nearly free; False
+    # drops the metric key for exact loss-only parity with the reference.
     metrics_accuracy: bool = True
     # Head/logits compute dtype: "fp32" (default; stable softmax) or
     # "bf16" — halves the [B, T, vocab] logits HBM round-trips (measured

@@ -99,13 +99,23 @@ def _fused_softmax_ce(logits, targets):
     return _fused_ce_rows(logits, targets).mean()
 
 
-def _fused_ce_rows(logits, targets):
+def _fused_ce_rows(logits, targets, with_correct: bool = False):
     """Per-row CE ([..., vocab] logits → [...] fp32), fusion-friendly.
 
     Max and gather read the logits in their STORED dtype (a gather's
     operand cannot fuse, so gathering from an fp32 cast would materialize
     the full cast tensor — the exact round-trip this form removes); only
     the sum-exp reduction sees the in-register fp32 upcast.
+
+    ``with_correct=True`` also returns per-row top-1 correctness derived
+    from values the CE already has in hand: the label is top-1 iff its
+    logit equals the row max (``lab >= m``; it cannot exceed it). This is
+    tie-inclusive top-1 — identical to ``argmax(logits) == target`` except
+    when the label logit exactly ties a different index's max, a
+    measure-zero event the metric can't resolve anyway — and it deletes
+    the separate argmax reduction, a full extra HBM pass over the
+    [B, T, vocab] tensor (measured 4.4 ms / +3.8% tok/s on the
+    GPT-2-small B16 T1024 step, BASELINE.md round 4).
     """
     m = lax.stop_gradient(
         jnp.max(logits, axis=-1, keepdims=True)).astype(jnp.float32)
@@ -113,7 +123,93 @@ def _fused_ce_rows(logits, targets):
         jnp.exp(logits.astype(jnp.float32) - m), axis=-1)) + m[..., 0]
     lab = jnp.take_along_axis(
         logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
-    return lse - lab
+    rows = lse - lab
+    if not with_correct:
+        return rows
+    return rows, (lab >= m[..., 0]).astype(jnp.float32)
+
+
+def _ce_rows_saved_probs(logits, targets, with_correct: bool = False):
+    """CE rows via a custom VJP that saves bf16 softmax probabilities.
+
+    The default backward rematerializes ``softmax(logits)`` into BOTH
+    lm_head backward matmul fusions: each re-reads the stored logits and
+    re-runs the exp on the VPU, which stalls the MXU pipeline (the dx
+    matmul measures 56% of bf16 peak, profiles/gpt_t1024_r4e.json).
+    Saving ``p = softmax(logits)`` once in bf16 at forward makes both
+    backward matmuls clean consumers: ``dlogits = (p − onehot)·g`` fuses
+    from a bf16 read with no transcendentals, and under fp32 logits the
+    backward reads halve. The trade is one extra forward pass over the
+    logits (read + exp + bf16 write). Loss/accuracy math is bit-identical
+    to :func:`_fused_ce_rows`; only the *gradient* sees bf16-rounded
+    probabilities (~2^-8 relative, the same rounding the measured
+    bf16-logits lever applies to the logits themselves).
+
+    Measured (B16 T1024 GPT-2-small, one v5e): fp32 logits 117.2k →
+    119.4k tok/s; bf16 logits 125.2k → 123.7k (the backward reads are
+    already bf16, so the extra forward pass isn't paid back) — use under
+    fp32 logits only.
+    """
+    rows, correct = _saved_probs_vjp(logits, targets)
+    return (rows, correct) if with_correct else rows
+
+
+@jax.custom_vjp
+def _saved_probs_vjp(lg, tg):
+    rows, correct, _ = _saved_probs_fwd(lg, tg)
+    return rows, correct
+
+
+def _saved_probs_vjp_fwd(lg, tg):
+    rows, correct, p = _saved_probs_fwd(lg, tg)
+    # The empty array carries lg's dtype to bwd (residual leaves must be
+    # arrays; a bare dtype object is not a valid pytree leaf here).
+    return (rows, correct), (p, tg, jnp.zeros((0,), lg.dtype))
+
+
+def _saved_probs_vjp_bwd(res, ct):
+    import numpy as np
+
+    p, tg, dt = res
+    g = ct[0][..., None]  # rows cotangent; correct has no gradient
+    onehot = (lax.broadcasted_iota(jnp.int32, p.shape, p.ndim - 1)
+              == tg[..., None])
+    dlg = jnp.where(onehot, p.astype(jnp.float32) - 1,
+                    p.astype(jnp.float32)) * g
+    return dlg.astype(dt.dtype), np.zeros(tg.shape, jax.dtypes.float0)
+
+
+_saved_probs_vjp.defvjp(_saved_probs_vjp_fwd, _saved_probs_vjp_bwd)
+
+
+def _saved_probs_fwd(lg, tg):
+    # A normalized-p residual written in its own pass measures FASTER
+    # (119.4k tok/s at the fp32-logits gate config) than the "free"
+    # alternative of emitting bf16 exp(logits − max) as a second output
+    # of the exp-sum reduce fusion (117.2k — no better than not saving
+    # probs at all): the extra fusion output deoptimizes the vocab
+    # reduction more than one extra elementwise pass costs.
+    m = lax.stop_gradient(
+        jnp.max(lg, axis=-1, keepdims=True)).astype(jnp.float32)
+    ex = jnp.exp(lg.astype(jnp.float32) - m)
+    s = jnp.sum(ex, axis=-1)
+    lse = jnp.log(s) + m[..., 0]
+    lab = jnp.take_along_axis(
+        lg, tg[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    rows = lse - lab
+    correct = (lab >= m[..., 0]).astype(jnp.float32)
+    p = (ex / s[..., None]).astype(jnp.bfloat16)
+    return rows, correct, p
+
+
+def _ce_rows_and_correct(logits, targets, accuracy_metric: bool,
+                         save_probs: bool):
+    """Dispatch between the remat CE backward (default) and the
+    saved-probs variant; returns ``(rows, correct-or-None)``."""
+    impl = _ce_rows_saved_probs if save_probs else _fused_ce_rows
+    if accuracy_metric:
+        return impl(logits, targets, with_correct=True)
+    return impl(logits, targets), None
 
 
 def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
@@ -149,10 +245,10 @@ def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
         logits = hc.astype(logits_dtype) @ w
         if bias is not None:
             logits = logits + bias
-        ce = _fused_ce_rows(logits, tc).sum()
-        acc = (jnp.sum((jnp.argmax(logits, -1) == tc).astype(jnp.float32))
-               if accuracy_metric else jnp.float32(0))
-        return (ce_sum + ce, acc_sum + acc), None
+        rows, correct = _ce_rows_and_correct(
+            logits, tc, accuracy_metric, save_probs=False)
+        acc = correct.sum() if accuracy_metric else jnp.float32(0)
+        return (ce_sum + rows.sum(), acc_sum + acc), None
 
     (ce_sum, acc_sum), _ = lax.scan(
         body, (jnp.float32(0), jnp.float32(0)), (hs, ts))
@@ -163,7 +259,8 @@ def chunked_ce_and_accuracy(hidden, head_params, targets, chunk: int,
 def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
                        positions=None, ce_chunk: int | None = None,
                        accuracy_metric: bool = True,
-                       logits_dtype=jnp.float32):
+                       logits_dtype=jnp.float32,
+                       ce_save_probs: bool = False):
     """Scaled-CE (+ MoE aux) value-and-grad shared by every LM step variant.
 
     Returns ``(grads, ce, aux, accuracy)`` — CE and the MoE load-balancing
@@ -172,9 +269,10 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
     ``CE + aux``. ``ce_chunk`` computes the CE through
     :func:`chunked_ce_and_accuracy` (the model returns hidden states and
     the head applies per chunk). ``accuracy_metric=False`` returns
-    ``accuracy=None`` and skips the argmax over the vocab — a full extra
-    HBM pass over the logits (measured 4.4 ms / +3.8% tok/s on the
-    GPT-2-small T1024 step); the reference's trainers log loss only.
+    ``accuracy=None`` and drops the metric key; since round 5 the metric
+    derives from the CE's own max (see :func:`_fused_ce_rows`) so keeping
+    it on is nearly free — the flag remains for exact parity with the
+    reference's loss-only trainers.
     """
     def sown_aux(mutated):
         return sum(jax.tree.leaves(dict(mutated).get("aux_loss", {})),
@@ -203,10 +301,10 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
             aux = sown_aux(mutated)
         else:  # PipelinedLM.apply_fn (no collections)
             logits, aux = out, jnp.float32(0)
-        ce = _fused_softmax_ce(logits, targets)
-        accuracy = (jnp.mean(
-            (jnp.argmax(logits, -1) == targets).astype(jnp.float32))
-            if accuracy_metric else None)
+        rows, correct = _ce_rows_and_correct(
+            logits, targets, accuracy_metric, ce_save_probs)
+        ce = rows.mean()
+        accuracy = correct.mean() if accuracy_metric else None
         return state.loss_scale.scale_loss(ce + aux), (ce, aux, accuracy)
 
     grads, (ce, aux, accuracy) = jax.grad(loss_fn, has_aux=True)(state.params)
@@ -242,7 +340,8 @@ def _lm_metrics(new_state: TrainState, ce, aux, accuracy, finite,
 def _lm_accum_grads(state: TrainState, batch, rng, accum: int,
                     mesh, ce_chunk: int | None, positions=None,
                     accuracy_metric: bool = True,
-                    logits_dtype=jnp.float32):
+                    logits_dtype=jnp.float32,
+                    ce_save_probs: bool = False):
     """Shared LM accumulation wrapper over ``accumulate_grads``: scan
     microbatches through fwd/bwd, average grads and metrics. ``mesh=None``
     runs shard-locally (the sequence step's partial-manual body);
@@ -254,7 +353,8 @@ def _lm_accum_grads(state: TrainState, batch, rng, accum: int,
         g, ce, aux, acc = _lm_loss_and_grads(
             state.replace(params=params), mbatch["tokens"],
             mbatch["targets"], r, positions=positions, ce_chunk=ce_chunk,
-            accuracy_metric=accuracy_metric, logits_dtype=logits_dtype)
+            accuracy_metric=accuracy_metric, logits_dtype=logits_dtype,
+            ce_save_probs=ce_save_probs)
         return g, carry, (ce, aux, acc)
 
     grads, _, (ces, auxs, accs) = accumulate_grads(
@@ -267,7 +367,8 @@ def _lm_accum_grads(state: TrainState, batch, rng, accum: int,
 def _lm_grads_body(gstate: TrainState, batch, rng,
                    ce_chunk: int | None = None, accum: int = 1,
                    accuracy_metric: bool = True,
-                   logits_dtype=jnp.float32):
+                   logits_dtype=jnp.float32,
+                   ce_save_probs: bool = False):
     """The manual (shard_map) half of the sequence-parallel step: compute
     the globally-averaged, unscaled gradient and the shard-averaged metric
     scalars. The optimizer commit deliberately happens OUTSIDE the manual
@@ -290,12 +391,13 @@ def _lm_grads_body(gstate: TrainState, batch, rng,
         grads, ce, aux, accuracy = _lm_accum_grads(
             gstate, {"tokens": tokens, "targets": targets}, shard_rng,
             accum, None, ce_chunk, positions=positions,
-            accuracy_metric=accuracy_metric, logits_dtype=logits_dtype)
+            accuracy_metric=accuracy_metric, logits_dtype=logits_dtype,
+            ce_save_probs=ce_save_probs)
     else:
         grads, ce, aux, accuracy = _lm_loss_and_grads(
             gstate, tokens, targets, shard_rng, positions=positions,
             ce_chunk=ce_chunk, accuracy_metric=accuracy_metric,
-            logits_dtype=logits_dtype)
+            logits_dtype=logits_dtype, ce_save_probs=ce_save_probs)
     grads = lax.pmean(grads, _GRAD_AXES)
     grads = gstate.loss_scale.unscale_grads(grads)
     ce = lax.pmean(ce, _GRAD_AXES)
@@ -310,7 +412,7 @@ def make_lm_train_step(
     donate: bool = True, ce_chunk: int | None = None,
     grad_accum_steps: int = 1, zero_stage: int = 0,
     accuracy_metric: bool = True, cpu_offload: bool = False,
-    logits_dtype=None,
+    logits_dtype=None, ce_save_probs: bool = False,
 ) -> Callable:
     """Build the (data × sequence)-parallel jitted LM train step.
 
@@ -372,6 +474,7 @@ def make_lm_train_step(
     if grad_accum_steps < 1:
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    _check_ce_options(ce_chunk, ce_save_probs)
 
     def state_shardings_fn(state: TrainState):
         return tp_state_shardings(state, mesh, zero_stage=zero_stage,
@@ -393,7 +496,8 @@ def make_lm_train_step(
             functools.partial(_lm_grads_body, ce_chunk=ce_chunk,
                               accum=grad_accum_steps,
                               accuracy_metric=accuracy_metric,
-                              logits_dtype=logits_dtype), mesh,
+                              logits_dtype=logits_dtype,
+                              ce_save_probs=ce_save_probs), mesh,
             in_specs=(jax.tree.map(lambda _: P(), gstate), batch_spec, P()),
             out_specs=(jax.tree.map(lambda _: P(), state.params), P()),
             axis_names=axis_names,
@@ -404,6 +508,21 @@ def make_lm_train_step(
 
     return _lazy_jit_step(mesh, state_shardings_fn, body,
                           batch_sh=batch_sh, max_len=max_len, donate=donate)
+
+
+def _check_ce_options(ce_chunk, ce_save_probs):
+    """The two CE levers solve opposite problems and do not compose:
+    ce_chunk remats per-chunk logits under ``jax.checkpoint`` for
+    long-context memory (which would discard saved probabilities and
+    silently fall back to the remat backward), while ce_save_probs spends
+    memory to delete the remat's exp from the short-T backward. Refuse
+    loudly rather than let the flag silently not engage."""
+    if ce_chunk and ce_save_probs:
+        raise ValueError(
+            "ce_save_probs does not compose with ce_chunk (the chunked CE "
+            "rematerializes each chunk's logits, discarding saved probs) — "
+            "use ce_chunk for long-context memory or ce_save_probs for "
+            "fp32-logits throughput, not both")
 
 
 def _lazy_jit_step(
@@ -522,6 +641,7 @@ def _make_gspmd_lm_step(
     accuracy_metric: bool = True,
     logits_dtype=jnp.float32,
     cpu_offload: bool = False,
+    ce_save_probs: bool = False,
 ) -> Callable:
     """Shared GSPMD LM step builder (the TP and PP steps differ only in how
     the train state is placed): batch over ``data``, lazy jit once a
@@ -534,6 +654,7 @@ def _make_gspmd_lm_step(
     if grad_accum_steps < 1:
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    _check_ce_options(ce_chunk, ce_save_probs)
     batch_sh = {"tokens": NamedSharding(mesh, P(AXIS_DATA, None)),
                 "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
 
@@ -547,12 +668,13 @@ def _make_gspmd_lm_step(
         if grad_accum_steps > 1:
             grads, ce, aux, accuracy = _lm_accum_grads(
                 state, batch, rng, grad_accum_steps, mesh, ce_chunk,
-                accuracy_metric=accuracy_metric, logits_dtype=logits_dtype)
+                accuracy_metric=accuracy_metric, logits_dtype=logits_dtype,
+                ce_save_probs=ce_save_probs)
         else:
             grads, ce, aux, accuracy = _lm_loss_and_grads(
                 state, batch["tokens"], batch["targets"], rng,
                 ce_chunk=ce_chunk, accuracy_metric=accuracy_metric,
-                logits_dtype=logits_dtype)
+                logits_dtype=logits_dtype, ce_save_probs=ce_save_probs)
         grads = state.loss_scale.unscale_grads(grads)
         new_state, finite = commit_gradients(state, grads)
         return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
@@ -565,6 +687,7 @@ def make_tp_lm_train_step(
     mesh: Mesh, *, model, zero_stage: int = 0, donate: bool = True,
     grad_accum_steps: int = 1, ce_chunk: int | None = None,
     accuracy_metric: bool = True, cpu_offload: bool = False,
+    ce_save_probs: bool = False,
 ) -> Callable:
     """Tensor-parallel (megatron-style) LM train step via GSPMD placement.
 
@@ -603,14 +726,14 @@ def make_tp_lm_train_step(
         grad_accum_steps=grad_accum_steps, ce_chunk=ce_chunk,
         accuracy_metric=accuracy_metric,
         logits_dtype=model_logits_dtype(model),
-        cpu_offload=cpu_offload)
+        cpu_offload=cpu_offload, ce_save_probs=ce_save_probs)
 
 
 def make_pp_lm_train_step(
     mesh: Mesh, *, model, num_microbatches: int, donate: bool = True,
     ce_chunk: int | None = None, accuracy_metric: bool = True,
     zero_stage: int = 0, virtual_stages: int = 1,
-    cpu_offload: bool = False,
+    cpu_offload: bool = False, ce_save_probs: bool = False,
 ) -> Callable:
     """Pipeline-parallel LM train step (GPipe or circular schedule over
     ``pipe``).
@@ -677,7 +800,7 @@ def make_pp_lm_train_step(
         mesh, state_shardings, donate=donate, ce_chunk=ce_chunk,
         accuracy_metric=accuracy_metric,
         logits_dtype=model_logits_dtype(model),
-        cpu_offload=cpu_offload)
+        cpu_offload=cpu_offload, ce_save_probs=ce_save_probs)
     step.pipelined = plm
     return step
 

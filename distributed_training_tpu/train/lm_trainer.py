@@ -235,7 +235,8 @@ class LMTrainer:
                 accuracy_metric=lm.metrics_accuracy,
                 zero_stage=cfg.zero.stage,
                 virtual_stages=lm.virtual_stages,
-                cpu_offload=cfg.zero.cpu_offload)
+                cpu_offload=cfg.zero.cpu_offload,
+                ce_save_probs=lm.ce_save_probs)
             plm = self.train_step.pipelined
             state = TrainState.create(
                 apply_fn=plm.apply_fn, params=plm.init_params(init_rng),
@@ -246,7 +247,8 @@ class LMTrainer:
                 self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size,
                 grad_accum_steps=self.grad_accum, zero_stage=cfg.zero.stage,
                 accuracy_metric=lm.metrics_accuracy,
-                cpu_offload=cfg.zero.cpu_offload)
+                cpu_offload=cfg.zero.cpu_offload,
+                ce_save_probs=lm.ce_save_probs)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -261,7 +263,8 @@ class LMTrainer:
                 grad_accum_steps=self.grad_accum,
                 ce_chunk=lm.ce_chunk_size,
                 accuracy_metric=lm.metrics_accuracy,
-                cpu_offload=cfg.zero.cpu_offload)
+                cpu_offload=cfg.zero.cpu_offload,
+                ce_save_probs=lm.ce_save_probs)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)

@@ -51,6 +51,10 @@ def add_argument() -> argparse.Namespace:
                         help="head/logits compute dtype; bf16 halves the "
                              "[B,T,vocab] HBM traffic (CE reduces in fp32 "
                              "either way)")
+    parser.add_argument("--ce-save-probs", action="store_true", default=False,
+                        help="CE backward from saved bf16 softmax probs "
+                             "(+2%% tok/s under fp32 logits; not with "
+                             "--ce-chunk-size or bf16 logits)")
     parser.add_argument("--no-head-bias", action="store_true", default=False,
                         help="drop the lm_head bias (GPT-2's real head has "
                              "none; its gradient costs a full HBM pass "
@@ -160,6 +164,7 @@ def build_config(args: argparse.Namespace):
             virtual_stages=args.virtual_stages,
             attn_impl=args.attn_impl,
             ce_chunk_size=args.ce_chunk_size,
+            ce_save_probs=args.ce_save_probs,
             logits_dtype=args.logits_dtype,
             head_bias=not args.no_head_bias,
             corpus_path=args.corpus,

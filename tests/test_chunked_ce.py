@@ -160,6 +160,22 @@ class TestTrainerWiring:
         result = LMTrainer(cfg, mesh=mesh).fit()
         assert np.isfinite(result["final_perplexity"])
 
+    def test_lm_trainer_save_probs_fit(self, mesh):
+        """ce_save_probs reaches the product surface (config → trainer →
+        step builder), not just the bench harness."""
+        from distributed_training_tpu.train.lm_trainer import LMTrainer
+
+        cfg = TrainConfig(
+            model="transformer_lm", num_epochs=1, log_interval=2,
+            data=DataConfig(batch_size=2, max_steps_per_epoch=3),
+            lm=LMConfig(seq_len=16, vocab_size=VOCAB, num_layers=1,
+                        num_heads=2, hidden_dim=16, max_len=32,
+                        ce_save_probs=True, train_sequences=64,
+                        eval_sequences=32),
+        )
+        result = LMTrainer(cfg, mesh=mesh).fit()
+        assert np.isfinite(result["final_perplexity"])
+
     def test_pipeline_composes_with_chunking(self, devices):
         """ce_chunk through the pipeline executor (round-3; the step-level
         equivalence is pinned by test_pp_ce_chunk_matches_full_logits) —
@@ -255,6 +271,100 @@ class TestLogitsDtype:
             logits_dtype=jnp.bfloat16)
         np.testing.assert_allclose(np.asarray(ce), np.asarray(want),
                                    rtol=1e-5)
+
+
+class TestCEVariants:
+    """Round-5 CE levers: accuracy derived from the CE max (deletes the
+    argmax HBM pass) and the saved-probs backward (deletes the exp
+    recompute from both head matmul fusions)."""
+
+    def _data(self, dtype=jnp.float32):
+        rng = np.random.RandomState(7)
+        logits = jnp.asarray(rng.randn(4, 9, VOCAB) * 4, dtype)
+        targets = jnp.asarray(rng.randint(0, VOCAB, (4, 9)), jnp.int32)
+        return logits, targets
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_accuracy_from_max_matches_argmax(self, dtype):
+        from distributed_training_tpu.train.lm_step import _fused_ce_rows
+
+        logits, targets = self._data(dtype)
+        _, correct = _fused_ce_rows(logits, targets, with_correct=True)
+        want = (jnp.argmax(logits, -1) == targets).astype(jnp.float32)
+        np.testing.assert_array_equal(np.asarray(correct), np.asarray(want))
+
+    def test_accuracy_tie_semantics(self):
+        """Ties count as correct (tie-inclusive top-1): when the label
+        logit exactly equals another index's max, argmax-first would call
+        it wrong, the max-equality form calls it right. Documented, not a
+        bug — continuous logits tie with measure zero."""
+        from distributed_training_tpu.train.lm_step import _fused_ce_rows
+
+        logits = jnp.zeros((1, 1, VOCAB)).at[0, 0, 3].set(5.0)
+        logits = logits.at[0, 0, 11].set(5.0)
+        targets = jnp.asarray([[11]], jnp.int32)
+        assert int(jnp.argmax(logits, -1)[0, 0]) == 3  # argmax says wrong
+        _, correct = _fused_ce_rows(logits, targets, with_correct=True)
+        assert float(correct[0, 0]) == 1.0
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_saved_probs_forward_bit_identical(self, dtype):
+        from distributed_training_tpu.train.lm_step import (
+            _ce_rows_saved_probs,
+            _fused_ce_rows,
+        )
+
+        logits, targets = self._data(dtype)
+        r1, c1 = _fused_ce_rows(logits, targets, with_correct=True)
+        r2, c2 = _ce_rows_saved_probs(logits, targets, with_correct=True)
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+
+    def test_saved_probs_grad_within_bf16_rounding(self):
+        from distributed_training_tpu.train.lm_step import (
+            _ce_rows_saved_probs,
+            _fused_ce_rows,
+        )
+
+        logits, targets = self._data()
+        g1 = jax.grad(lambda lg: _fused_ce_rows(lg, targets).mean())(logits)
+        g2 = jax.jit(jax.grad(
+            lambda lg: _ce_rows_saved_probs(lg, targets).mean()))(logits)
+        # p is rounded to bf16 (~2^-8 relative); the onehot term is exact.
+        scale = float(jnp.max(jnp.abs(g1)))
+        np.testing.assert_allclose(np.asarray(g2), np.asarray(g1),
+                                   atol=5e-3 * scale)
+
+    def test_saved_probs_refuses_ce_chunk(self, mesh):
+        """ce_chunk remats per-chunk logits, which would silently discard
+        the saved probs — the combination must refuse at construction."""
+        model = _model(seq_axis=None)
+        with pytest.raises(ValueError, match="ce_save_probs"):
+            make_tp_lm_train_step(mesh, model=model, ce_chunk=4,
+                                  ce_save_probs=True)
+
+    def test_saved_probs_step_metrics_match(self, mesh):
+        """Forward math is bit-identical, so step metrics must agree
+        exactly; only the gradient sees the bf16-rounded probs."""
+        model = _model(seq_axis=None)
+        tx = optax.adam(1e-3)
+        tokens = jnp.asarray(
+            np.random.RandomState(0).randint(0, VOCAB, (8, 17)), jnp.int32)
+        batch = make_lm_batch(tokens)
+        rng = jax.random.PRNGKey(5)
+
+        def run(save_probs):
+            step = make_tp_lm_train_step(
+                mesh, model=model, donate=False, ce_save_probs=save_probs)
+            state = _state(model, tx)
+            state = place_state(state, step.state_shardings(state))
+            _, m = step(state, batch, rng)
+            return m
+
+        ma, mb = run(False), run(True)
+        for k in ("loss", "accuracy", "perplexity"):
+            np.testing.assert_allclose(float(ma[k]), float(mb[k]),
+                                       rtol=1e-6)
 
 
 class TestHeadBias:
