@@ -270,10 +270,13 @@ class LMConfig:
     # reduces in fp32 (train/lm_step.py::_fused_ce_rows), only the stored
     # logits round to bf16.
     logits_dtype: str = "fp32"
-    # GPT-2's real lm_head has no bias; ours defaults to one (historical).
-    # False drops it — its gradient is a full extra HBM pass over the
-    # [B, T, vocab] logits (profiled 2.3 ms/step at GPT-2-small T1024).
-    head_bias: bool = True
+    # lm_head bias. Default OFF since round 5: GPT-2's real head has none,
+    # and its gradient is a full extra HBM pass over the [B, T, vocab]
+    # logits (profiled 2.3 ms/step at GPT-2-small T1024). True restores
+    # the pre-round-5 tree (needed to resume old checkpoints); the
+    # gpt/jax_tpu CLIs default to the same value so train → generate
+    # round-trips at bare defaults.
+    head_bias: bool = False
     corpus_path: str | None = None  # byte-level text file; None → synthetic
     train_sequences: int = 2048     # synthetic dataset size
     eval_sequences: int = 256
@@ -372,11 +375,12 @@ def effective_batch_sizes(cfg: TrainConfig, world: int,
     - ``global_batch_size`` set and an exact >1 multiple of batch_size ×
       world while accum was left at 1: accum is *derived* (DeepSpeed:
       ``accum = train_batch_size / (micro × world)``). The image steps
-      (GSPMD and shard_map local-BN) and the GSPMD/sequence LM steps all
-      accumulate; the one step that cannot is the pipeline LM strategy —
-      its microbatch scan IS the schedule — whose trainer passes
-      ``allow_derive=False`` to keep the whole global batch as one step
-      instead of failing on an unsupported accum.
+      (GSPMD and shard_map local-BN) and the GSPMD/sequence LM steps scan
+      accum microbatches through fwd/bwd; the pipeline LM strategy maps
+      accum onto its own schedule instead (DeepSpeed pipeline semantics:
+      accumulation IS microbatching — the trainer multiplies
+      ``num_microbatches`` by accum and drains them all before the one
+      update, see ``LMTrainer._pp_microbatches``).
     - otherwise ``global_batch_size`` wins as the effective batch (the
       reference's ds_config sets only ``train_batch_size: 96``,
       ``deepspeed_train.py:173``) and must divide by accum.

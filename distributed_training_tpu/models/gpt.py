@@ -177,7 +177,9 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: Any = jnp.float32
     logits_dtype: Any = jnp.float32  # see make_lm_head
-    head_bias: bool = True           # see make_lm_head
+    # Default OFF since round 5 (GPT-2 parity; see make_lm_head). True
+    # restores the pre-round-5 checkpoint tree.
+    head_bias: bool = False
     seq_axis: str | None = None
     dropout_rate: float = 0.0
     attn_impl: str = "exact"  # exact | flash (pallas kernel, unsharded path)
@@ -305,7 +307,7 @@ def make_transformer_lm(
     moe_expert_axis: str | None = None,
     remat: bool = False,
     logits_dtype: Any = jnp.float32,
-    head_bias: bool = True,
+    head_bias: bool = False,
 ) -> TransformerLM:
     """Registry factory. ``num_classes`` doubles as vocab size; ``axis_name``
     (the registry's SyncBN slot) is unused — LM has no BatchNorm. Unknown

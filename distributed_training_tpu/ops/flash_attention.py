@@ -108,6 +108,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
                 *, scale, causal, block_q, block_k, nk):
     ki = pl.program_id(2)
     qi = pl.program_id(1)
+    # exp2 mode: log2(e) folds into the score scale, the (m, l) recurrence
+    # runs in the log2 domain, and only the stored lse converts back to
+    # natural log — zero extra per-element VPU ops (see _USE_EXP2).
+    use2 = _USE_EXP2
+    eff = scale * _LOG2E if use2 else scale
+    exp_fn = jnp.exp2 if use2 else jnp.exp
 
     @pl.when(ki == 0)
     def _():
@@ -120,13 +126,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
     @pl.when(_live_block(qi, ki, causal=causal, block_q=block_q,
                          block_k=block_k))
     def _():
-        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=eff, causal=causal,
                            block_q=block_q, block_k=block_k)
         m_prev = m[:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
+        p = exp_fn(s - m_new)
+        corr = exp_fn(m_prev - m_new)
         l[:] = jnp.broadcast_to(
             l[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True), l.shape)
         # p in the value dtype (standard flash practice: p ∈ [0, 1], bf16
@@ -146,8 +152,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m, l,
         # dim be a 128-multiple OR span the full array dim; the sidecar's
         # minor dim is LSE_LANES (= the whole array dim), so the per-row
         # logsumexp is stored replicated across those lanes.
-        lse_ref[0] = jnp.broadcast_to(
-            m[:, :1] + jnp.log(jnp.maximum(lsum, 1e-30)), lse_ref.shape[1:])
+        logl = (jnp.log2 if use2 else jnp.log)(jnp.maximum(lsum, 1e-30))
+        lse_nat = (m[:, :1] + logl) / (_LOG2E if use2 else 1.0)
+        lse_ref[0] = jnp.broadcast_to(lse_nat, lse_ref.shape[1:])
 
 
 def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
@@ -188,6 +195,20 @@ def _flash_fwd(q, k, v, *, causal, block_q, block_k, interpret):
 
 # -- backward ----------------------------------------------------------------
 
+def _recomputed_probs(q_ref, k_ref, lse_ref, qi, ki, *, scale, causal,
+                      block_q, block_k):
+    """Softmax probabilities recomputed from the saved natural-log lse —
+    the shared backward step. In exp2 mode the scores carry log2(e) in
+    their scale and the stored lse converts with one per-ROW multiply
+    ([bq, 1], negligible vs the [bq, bk] exp)."""
+    use2 = _USE_EXP2
+    eff = scale * _LOG2E if use2 else scale
+    s = _masked_scores(q_ref, k_ref, qi, ki, scale=eff, causal=causal,
+                       block_q=block_q, block_k=block_k)
+    lse_row = lse_ref[0][:, :1] * _LOG2E if use2 else lse_ref[0][:, :1]
+    return (jnp.exp2 if use2 else jnp.exp)(s - lse_row)
+
+
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq,
                *, scale, causal, block_q, block_k, nk):
     ki = pl.program_id(2)
@@ -200,9 +221,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq,
     @pl.when(_live_block(qi, ki, causal=causal, block_q=block_q,
                          block_k=block_k))
     def _():
-        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = _recomputed_probs(q_ref, k_ref, lse_ref, qi, ki, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k)
         # Input-dtype matmuls, fp32 accumulation (see _masked_scores); ds
         # is cast back to the key dtype for the dq contraction — the
         # standard flash-backward precision recipe.
@@ -233,9 +254,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     @pl.when(_live_block(qi, ki, causal=causal, block_q=block_q,
                          block_k=block_k))
     def _():
-        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = _recomputed_probs(q_ref, k_ref, lse_ref, qi, ki, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k)
         do = do_ref[0]
         # dV += P^T dO — p in the output-grad dtype, fp32 accumulation.
         dv[:] += jax.lax.dot_general(
@@ -285,9 +306,9 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _():
-        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
-        p = jnp.exp(s - lse_ref[0][:, :1])
+        p = _recomputed_probs(q_ref, k_ref, lse_ref, qi, ki, scale=scale,
+                              causal=causal, block_q=block_q,
+                              block_k=block_k)
         do = do_ref[0]
         # dV += P^T dO — p in the output-grad dtype, fp32 accumulation.
         dv[:] += jax.lax.dot_general(
@@ -323,6 +344,15 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # A/B switch for tools/flash_kernel_bench.py --split-bwd; the model path
 # always runs the fused backward.
 _USE_SPLIT_BWD = False
+
+# A/B switch for tools/flash_kernel_bench.py --exp2: compute the softmax
+# exponentials as native 2^x with log2(e) FOLDED INTO the score scale (the
+# fwd recurrence then runs entirely in the log2 domain), zero extra VPU
+# ops. Probes whether Mosaic's exp lowering already uses the pow2 unit —
+# the VPU exp is the kernels' profiled cost (round-4 mask-skip
+# falsification).
+_USE_EXP2 = False
+_LOG2E = 1.4426950408889634
 
 
 def _bwd_prologue(res, g, block_q, block_k, g_lse):

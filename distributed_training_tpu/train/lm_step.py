@@ -474,7 +474,7 @@ def make_lm_train_step(
     if grad_accum_steps < 1:
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
-    _check_ce_options(ce_chunk, ce_save_probs)
+    _check_ce_options(ce_chunk, ce_save_probs, logits_dtype)
 
     def state_shardings_fn(state: TrainState):
         return tp_state_shardings(state, mesh, zero_stage=zero_stage,
@@ -510,19 +510,32 @@ def make_lm_train_step(
                           batch_sh=batch_sh, max_len=max_len, donate=donate)
 
 
-def _check_ce_options(ce_chunk, ce_save_probs):
+def _check_ce_options(ce_chunk, ce_save_probs, logits_dtype=jnp.float32):
     """The two CE levers solve opposite problems and do not compose:
     ce_chunk remats per-chunk logits under ``jax.checkpoint`` for
     long-context memory (which would discard saved probabilities and
     silently fall back to the remat backward), while ce_save_probs spends
     memory to delete the remat's exp from the short-T backward. Refuse
-    loudly rather than let the flag silently not engage."""
+    loudly rather than let the flag silently not engage.
+
+    ce_save_probs × bf16 logits *works* but is a measured perf loss
+    (123.7k vs 125.2k tok/s — the backward reads are already bf16, so
+    the extra forward pass isn't paid back): warn, don't refuse, so the
+    combination stays measurable."""
     if ce_chunk and ce_save_probs:
         raise ValueError(
             "ce_save_probs does not compose with ce_chunk (the chunked CE "
             "rematerializes each chunk's logits, discarding saved probs) — "
             "use ce_chunk for long-context memory or ce_save_probs for "
             "fp32-logits throughput, not both")
+    if ce_save_probs and jnp.dtype(logits_dtype) == jnp.dtype(jnp.bfloat16):
+        import warnings
+
+        warnings.warn(
+            "ce_save_probs under bf16 logits is a measured throughput "
+            "LOSS (123.7k vs 125.2k tok/s at GPT-2-small B16 T1024; "
+            "BASELINE.md round 5) — its win is fp32 logits only",
+            stacklevel=3)
 
 
 def _lazy_jit_step(
@@ -654,7 +667,7 @@ def _make_gspmd_lm_step(
     if grad_accum_steps < 1:
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
-    _check_ce_options(ce_chunk, ce_save_probs)
+    _check_ce_options(ce_chunk, ce_save_probs, logits_dtype)
     batch_sh = {"tokens": NamedSharding(mesh, P(AXIS_DATA, None)),
                 "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
 

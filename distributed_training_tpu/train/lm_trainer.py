@@ -132,8 +132,14 @@ class LMTrainer:
         if (cfg.moe.enabled or expert > 1) and self.strategy == "pipeline":
             raise NotImplementedError(
                 "MoE/expert parallelism composes with the tensor/dp and "
-                "sequence strategies (the pipeline executor's stacked "
-                "blocks assume a dense FFN)")
+                "sequence strategies, not the pipeline engine — the same "
+                "restriction DeepSpeed ships: its PipelineModule cannot "
+                "carry MoE layers (deepspeed.moe is routed through the "
+                "non-pipeline engine only; the reference's own MoE surface, "
+                "resnet/deepspeed/deepspeed_train.py:61-106, drives plain "
+                "DP training). Architecturally: the stacked-stage scan "
+                "requires congruent per-layer param trees, which the "
+                "alternating dense/MoE layout (moe_every) breaks")
         if expert > 1 and not cfg.moe.enabled:
             raise ValueError(
                 f"expert mesh axis sized {expert} with MoE disabled would "
@@ -214,15 +220,32 @@ class LMTrainer:
             **moe_kwargs,
         )
         self.world_size = data_axis_size(self.mesh)
-        accum_ok = self.strategy in ("tensor/dp", "sequence")
         self.train_gbs, self.eval_gbs, self.grad_accum = effective_batch_sizes(
-            cfg, self.world_size, allow_derive=accum_ok)
-        if self.grad_accum > 1 and not accum_ok:
-            raise NotImplementedError(
-                "gradient accumulation composes with the tensor/dp and "
-                f"sequence strategies (the {self.strategy} step has its own "
-                "microbatching story); got "
-                f"gradient_accumulation_steps={self.grad_accum}")
+            cfg, self.world_size)
+        # DeepSpeed's pipeline engine EQUATES gradient accumulation with
+        # microbatching (`gradient_accumulation_steps` is its microbatch
+        # count; the ds_config surface at
+        # resnet/deepspeed/deepspeed_train.py:172-173 feeds both knobs from
+        # the same batch triple): accum multiplies the microbatch count,
+        # each microbatch keeps its shape (batch_size/num_microbatches),
+        # and the schedule drains accum× more ticks before the single
+        # optimizer update — same effective batch, better bubble fraction.
+        self._pp_microbatches = cfg.lm.num_microbatches * (
+            self.grad_accum if self.strategy == "pipeline" else 1)
+        if (self.strategy == "pipeline"
+                and cfg.data.batch_size % self._pp_microbatches):
+            # The shared PipelinedLM apply_fn serves BOTH the train step
+            # (which sees batch_size × accum rows and drains num_micro ×
+            # accum microbatches) and eval (micro-sized batches through the
+            # same schedule): batch_size itself must divide by the scaled
+            # count, or eval's spmd_pipeline would crash after a full
+            # training epoch.
+            raise ValueError(
+                f"with the pipeline strategy, gradient_accumulation_steps "
+                f"multiplies the microbatch count (DeepSpeed pipeline "
+                f"semantics): num_microbatches × accum = "
+                f"{self._pp_microbatches} must divide the per-shard "
+                f"batch_size (= {cfg.data.batch_size})")
         self.tx = make_optimizer(cfg.optimizer, cfg.scheduler, self.world_size)
         loss_scale = LossScaleState.create(cfg.precision)
 
@@ -230,7 +253,7 @@ class LMTrainer:
         if self.strategy == "pipeline":
             self.train_step = make_pp_lm_train_step(
                 self.mesh, model=self.model,
-                num_microbatches=lm.num_microbatches,
+                num_microbatches=self._pp_microbatches,
                 ce_chunk=lm.ce_chunk_size,
                 accuracy_metric=lm.metrics_accuracy,
                 zero_stage=cfg.zero.stage,

@@ -26,6 +26,16 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--max-len", type=int, default=2048)
     parser.add_argument("--dtype", type=str, default="fp32",
                         choices=["bf16", "fp16", "fp32"])
+    parser.add_argument("--head-bias", action=argparse.BooleanOptionalAction,
+                        default=False,
+                        help="must mirror training (train.py defaults to no "
+                             "head bias since round 5); a mismatched flag "
+                             "fails the checkpoint tree restore")
+    parser.add_argument("--logits-dtype", type=str, default="bf16",
+                        choices=["fp32", "bf16"],
+                        help="head compute dtype (train.py's default is "
+                             "bf16; params are unaffected, so this only "
+                             "needs to match for bit-identical logits)")
     # MoE model flags (must match training, or the checkpoint tree won't
     # restore — the decode path runs MoE FFNs position-wise like training).
     parser.add_argument("--moe", action="store_true", default=False)
@@ -91,6 +101,8 @@ def main() -> int:
             moe_min_capacity=args.min_capacity,
             moe_mlp_type=args.mlp_type,
         )
+    from distributed_training_tpu.train.lm_step import parse_logits_dtype
+
     model = get_model(
         "transformer_lm",
         num_classes=args.vocab_size,
@@ -99,6 +111,8 @@ def main() -> int:
         num_heads=args.num_heads,
         hidden_dim=args.hidden_dim,
         max_len=args.max_len,
+        head_bias=args.head_bias,
+        logits_dtype=parse_logits_dtype(args.logits_dtype),
         **moe_kwargs,
     )
 
@@ -118,7 +132,20 @@ def main() -> int:
         latest = ckpt_lib.latest_epoch(args.checkpoint)
         epoch = -1 if latest is None else latest
     if epoch >= 0:
-        state, _, _ = ckpt_lib.restore_checkpoint(args.checkpoint, epoch, state)
+        try:
+            state, _, _ = ckpt_lib.restore_checkpoint(
+                args.checkpoint, epoch, state)
+        except Exception as e:
+            # The most common tree mismatch after round 5 is the head-bias
+            # default flip: pre-round-5 checkpoints carry an lm_head bias
+            # the new bias-less template lacks. Name the flag instead of
+            # leaving the user to decode a pytree-structure error.
+            raise SystemExit(
+                f"checkpoint restore failed — model flags must mirror the "
+                f"training run. Most likely: this build defaults to NO "
+                f"lm_head bias (round 5); pass --head-bias for checkpoints "
+                f"trained before that (or check --num-layers/--hidden-dim/"
+                f"--moe flags). Original error: {e}") from e
         print(f"[generate] restored epoch {epoch} from {args.checkpoint}")
     else:
         print("[generate] no checkpoint found; sampling from random init")

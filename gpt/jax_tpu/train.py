@@ -46,19 +46,24 @@ def add_argument() -> argparse.Namespace:
                         help="chunked cross-entropy: tokens per lm_head+CE "
                              "chunk (never materializes [B,T,vocab] logits; "
                              "for long-context × large-vocab runs)")
-    parser.add_argument("--logits-dtype", type=str, default="fp32",
+    parser.add_argument("--logits-dtype", type=str, default="bf16",
                         choices=["fp32", "bf16"],
-                        help="head/logits compute dtype; bf16 halves the "
-                             "[B,T,vocab] HBM traffic (CE reduces in fp32 "
-                             "either way)")
+                        help="head/logits compute dtype. Default bf16 "
+                             "(round 5): halves the [B,T,vocab] HBM "
+                             "traffic, CE still reduces in fp32, and 3- "
+                             "and 8-epoch chip A/Bs track fp32 step-for-"
+                             "step (final ppl 1.0784 vs 1.0785, "
+                             "BASELINE.md); fp32 remains selectable")
     parser.add_argument("--ce-save-probs", action="store_true", default=False,
-                        help="CE backward from saved bf16 softmax probs "
-                             "(+2%% tok/s under fp32 logits; not with "
-                             "--ce-chunk-size or bf16 logits)")
-    parser.add_argument("--no-head-bias", action="store_true", default=False,
-                        help="drop the lm_head bias (GPT-2's real head has "
-                             "none; its gradient costs a full HBM pass "
-                             "over the logits)")
+                        help="CE backward from saved bf16 softmax probs: "
+                             "+2%% tok/s under --logits-dtype fp32 (its "
+                             "niche); refused with --ce-chunk-size, warns "
+                             "under bf16 logits (measured slower there)")
+    parser.add_argument("--head-bias", action=argparse.BooleanOptionalAction,
+                        default=False,
+                        help="lm_head bias. Default off (round 5): GPT-2's "
+                             "real head has none, and its gradient costs a "
+                             "full HBM pass over the [B,T,vocab] logits")
     # MoE surface (DeepSpeed flag names, resnet/deepspeed parity) — here
     # they swap alternating decoder FFNs for expert-parallel MoE layers.
     parser.add_argument("--moe", action="store_true", default=False)
@@ -166,7 +171,7 @@ def build_config(args: argparse.Namespace):
             ce_chunk_size=args.ce_chunk_size,
             ce_save_probs=args.ce_save_probs,
             logits_dtype=args.logits_dtype,
-            head_bias=not args.no_head_bias,
+            head_bias=args.head_bias,
             corpus_path=args.corpus,
         ),
     )

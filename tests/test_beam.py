@@ -26,9 +26,11 @@ VOCAB = 7
 
 @pytest.fixture(scope="module")
 def lm():
+    # head_bias=True: the beam tests force token orderings by adding a
+    # large lm_head bias (the model default is bias-less since round 5).
     model = get_model(
         "transformer_lm", num_classes=VOCAB, num_layers=2, num_heads=2,
-        hidden_dim=32, max_len=64)
+        hidden_dim=32, max_len=64, head_bias=True)
     tokens = jnp.zeros((2, 8), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
     return model, params

@@ -25,9 +25,12 @@ VOCAB = 61  # deliberately not a power of two
 
 @pytest.fixture(scope="module")
 def lm():
+    # head_bias=True: several tests force an argmax by construction by
+    # adding a large lm_head bias (the model default is bias-less since
+    # round 5, GPT-2 parity).
     model = get_model(
         "transformer_lm", num_classes=VOCAB, num_layers=2, num_heads=2,
-        hidden_dim=32, max_len=64)
+        hidden_dim=32, max_len=64, head_bias=True)
     tokens = jnp.zeros((2, 16), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), tokens)["params"]
     return model, params

@@ -158,6 +158,112 @@ TRAIN_CKPT_WORKER = textwrap.dedent("""
 """)
 
 
+TP_WORKER = textwrap.dedent("""
+    import os, sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_training_tpu.runtime.distributed import initialize_distributed
+    initialize_distributed()
+
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    from distributed_training_tpu import checkpoint as ckpt_lib
+    from distributed_training_tpu.config import PrecisionConfig
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.parallel.sharding import place_state
+    from distributed_training_tpu.runtime.coordinator import Coordinator
+    from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+    from distributed_training_tpu.train.lm_step import (
+        make_lm_batch, make_tp_lm_train_step)
+    from distributed_training_tpu.train.precision import LossScaleState
+    from distributed_training_tpu.train.train_state import init_train_state
+
+    ckpt_dir = os.environ["CKPT_DIR"]
+    coord = Coordinator()
+    assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+    # Permute the device order so the MODEL axis pairs device i (process 0)
+    # with device i+4 (process 1): every megatron row-parallel psum then
+    # crosses the process boundary — the DCN-like path a single-process
+    # virtual mesh can never exercise.
+    devs = jax.devices()
+    order = [devs[(i // 2) + 4 * (i % 2)] for i in range(8)]
+    mesh = create_mesh(MeshConfig(data=4, model=2), devices=order)
+    ax = dict(zip(mesh.axis_names, range(len(mesh.axis_names))))
+    pairs = np.moveaxis(mesh.devices, ax["model"], -1).reshape(-1, 2)
+    pidx = np.vectorize(lambda d: d.process_index)(pairs)
+    assert (pidx[:, 0] != pidx[:, 1]).all(), (
+        "model axis must cross the process boundary")
+
+    model = get_model(
+        "transformer_lm", num_classes=32, seq_axis=None,
+        num_layers=2, num_heads=2, hidden_dim=16, max_len=64)
+    tx = optax.adam(1e-3)
+    state = init_train_state(
+        model, jax.random.PRNGKey(0), (2, 8), tx,
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+        input_dtype=jnp.int32)
+    step = make_tp_lm_train_step(mesh, model=model, zero_stage=1,
+                                 donate=False)
+    shardings = step.state_shardings(state)
+    state = place_state(state, shardings)
+
+    def global_batch(seed):
+        toks = np.random.RandomState(seed).randint(
+            0, 32, (8, 17)).astype(np.int32)
+        host = make_lm_batch(toks)
+        # Both processes hold the full deterministic array; each device
+        # materializes only its addressable shard.
+        return {
+            k: jax.make_array_from_callback(
+                v.shape, step.batch_shardings[k],
+                lambda idx, v=v: v[idx])
+            for k, v in host.items()
+        }
+
+    losses = []
+    for i in range(3):
+        state, metrics = step(state, global_batch(i), jax.random.PRNGKey(i))
+        losses.append(round(float(metrics["loss"]), 6))
+    ckpt_lib.save_checkpoint(ckpt_dir, 0, state, epoch_step=3)
+    coord.barrier("saved")
+
+    drifted, _ = step(state, global_batch(9), jax.random.PRNGKey(9))
+    template = place_state(init_train_state(
+        model, jax.random.PRNGKey(1), (2, 8), tx,
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+        input_dtype=jnp.int32), shardings)
+    restored, next_epoch, estep = ckpt_lib.restore_checkpoint(
+        ckpt_dir, 0, template)
+    assert next_epoch == 1 and estep == 3, (next_epoch, estep)
+
+    # TP-sharded leaves span BOTH processes, so device_get cannot fetch
+    # them; compare under jit with a replicated scalar result instead.
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+    repl = NamedSharding(mesh, Pspec())
+
+    def trees_equal(t1, t2):
+        f = jax.jit(
+            lambda a, b: jnp.stack([
+                jnp.all(u == v)
+                for u, v in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+            ]).all(),
+            out_shardings=repl)
+        return bool(f(t1, t2))
+
+    assert trees_equal(restored.params, state.params), \\
+        "restore is not step-accurate"
+    assert not trees_equal(restored.params, drifted.params), \\
+        "restore returned the post-save drifted params"
+
+    cont, metrics = step(restored, global_batch(3), jax.random.PRNGKey(3))
+    print(f"OK rank={coord.process_index} losses={losses} "
+          f"cont={float(metrics['loss']):.6f}", flush=True)
+""")
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -235,3 +341,60 @@ def test_two_process_train_and_checkpoint(tmp_path):
     l0 = [l for l in lines if "rank=0" in l][0]
     l1 = [l for l in lines if "rank=1" in l][0]
     assert l0.split("losses=")[1] == l1.split("losses=")[1]
+
+
+@pytest.mark.slow
+def test_two_process_tensor_parallel(tmp_path):
+    """A NON-data axis crosses the process boundary (round 5, VERDICT item
+    4): the TP worker permutes the device order so every megatron model-axis
+    psum spans the two processes, runs 3 ZeRO-1 train steps on a
+    deterministic global batch, does the coordinated orbax save +
+    step-accurate restore, and continues training. The observed losses must
+    match a single-process 8-device run of the identical program — the
+    cross-process collectives change the transport, not the math."""
+    lines = _run_two_process(
+        TP_WORKER, extra_env={"CKPT_DIR": str(tmp_path / "ckpt")})
+    l0 = [l for l in lines if "rank=0" in l][0]
+    l1 = [l for l in lines if "rank=1" in l][0]
+    assert l0.split("losses=")[1] == l1.split("losses=")[1]
+
+    # Single-process oracle: same mesh shape, same params, same batches on
+    # the pytest process's own 8 virtual devices.
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from distributed_training_tpu.config import PrecisionConfig
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.parallel.sharding import place_state
+    from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh
+    from distributed_training_tpu.train.lm_step import (
+        make_lm_batch,
+        make_tp_lm_train_step,
+    )
+    from distributed_training_tpu.train.precision import LossScaleState
+    from distributed_training_tpu.train.train_state import init_train_state
+
+    mesh = create_mesh(MeshConfig(data=4, model=2))
+    model = get_model(
+        "transformer_lm", num_classes=32, seq_axis=None,
+        num_layers=2, num_heads=2, hidden_dim=16, max_len=64)
+    state = init_train_state(
+        model, jax.random.PRNGKey(0), (2, 8), optax.adam(1e-3),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+        input_dtype=jnp.int32)
+    step = make_tp_lm_train_step(mesh, model=model, zero_stage=1,
+                                 donate=False)
+    state = place_state(state, step.state_shardings(state))
+    want = []
+    for i in range(3):
+        toks = np.random.RandomState(i).randint(0, 32, (8, 17)).astype(
+            np.int32)
+        batch = jax.device_put(
+            {k: jnp.asarray(v) for k, v in make_lm_batch(toks).items()},
+            step.batch_shardings)
+        state, metrics = step(state, batch, jax.random.PRNGKey(i))
+        want.append(round(float(metrics["loss"]), 6))
+    got = eval(l0.split("losses=")[1].split(" cont=")[0])
+    np.testing.assert_allclose(got, want, rtol=1e-5)

@@ -35,6 +35,12 @@ SHAPES = {
     "gpt": ("B16 H12 T1024 D64", 192, 1024, 64),
     "t4096": ("B4 H8 T4096 D64", 32, 4096, 64),
     "t16k": ("B2 H12 T16384 D64", 24, 16384, 64),
+    # Iso-FLOP head-dim scaling probes (bh·d constant): if per-FLOP time is
+    # flat from d=64 to d=128, the MXU's 128-wide contraction is NOT the
+    # limiting resource at d=64 (the matmuls hide under the VPU softmax);
+    # if d=128 is ~2x faster per FLOP, head-packing would pay.
+    "gpt_d128": ("B16 H6 T1024 D128 (iso-FLOP probe)", 96, 1024, 128),
+    "gpt_d32": ("B16 H24 T1024 D32 (iso-FLOP probe)", 384, 1024, 32),
 }
 
 
@@ -131,12 +137,20 @@ def main():
     ap.add_argument("--split-bwd", action="store_true",
                     help="A/B: run the pre-round-4 two-kernel backward "
                          "instead of the fused one")
+    ap.add_argument("--exp2", action="store_true",
+                    help="A/B: softmax exponentials as native 2^x with "
+                         "log2(e) folded into the score scale (probes "
+                         "whether Mosaic's exp already uses the pow2 unit)")
     args = ap.parse_args()
 
     if args.split_bwd:
         import distributed_training_tpu.ops.flash_attention as fa
         fa._USE_SPLIT_BWD = True
         print("backward: SPLIT (two-kernel)", file=sys.stderr)
+    if args.exp2:
+        import distributed_training_tpu.ops.flash_attention as fa
+        fa._USE_EXP2 = True
+        print("softmax exp: exp2 (log2-domain recurrence)", file=sys.stderr)
 
     kwargs = {}
     if args.blocks:

@@ -133,7 +133,8 @@ def capture(args) -> None:
             "transformer_lm", num_classes=50304, dtype=jnp.bfloat16,
             num_layers=12, num_heads=12, hidden_dim=768,
             max_len=args.seq_len, attn_impl=args.attn_impl,
-            logits_dtype=parse_logits_dtype(args.logits_dtype))
+            logits_dtype=parse_logits_dtype(args.logits_dtype),
+            head_bias=args.head_bias)
         tx = optax.adamw(3e-4)
         state = init_train_state(
             model, jax.random.PRNGKey(0), (1, 8), tx,
@@ -245,7 +246,11 @@ def main():
     ap.add_argument("--attn-impl", default="flash")
     ap.add_argument("--ce-chunk", type=int, default=None)
     ap.add_argument("--no-accuracy", action="store_true", default=False)
-    ap.add_argument("--logits-dtype", default="fp32",
+    ap.add_argument("--head-bias", action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="lm_head bias (default off, matching the round-5 "
+                         "bench/CLI default)")
+    ap.add_argument("--logits-dtype", default="bf16",
                     choices=["fp32", "bf16"])
     ap.add_argument("--warmup", type=int, default=4)
     ap.add_argument("--trace-steps", type=int, default=3)
