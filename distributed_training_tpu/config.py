@@ -146,6 +146,10 @@ class MoEConfig:
 
     enabled: bool = False
     ep_world_size: int = 1
+    # Swap every ``every``-th decoder FFN for MoE (GShard's alternating
+    # convention at the default 2). ``every=1`` makes EVERY layer MoE —
+    # the homogeneous layout the pipeline strategy can stack (round 5).
+    every: int = 2
     # One count for every MoE layer, or a per-layer list (DeepSpeed's
     # `--num-experts 64 64 128` nargs surface, deepspeed_train.py:71-75);
     # list length must be 1 or the number of MoE layers

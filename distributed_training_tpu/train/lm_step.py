@@ -791,7 +791,7 @@ def make_pp_lm_train_step(
     check_cpu_offload(cpu_offload, zero_stage)
     plm = PipelinedLM(model, mesh, num_microbatches=num_microbatches,
                       virtual_stages=virtual_stages)
-    tp = plm.tp_size > 1
+    tp = plm.tp_size > 1 or plm.moe  # rule-table specs (model AND expert)
     _, opt_axes = zero_stage_axes(mesh, zero_stage)
     opt_mem = "pinned_host" if cpu_offload else None
 

@@ -129,17 +129,26 @@ class LMTrainer:
                 "--moe-param-group (expert optimizer state is partitioned "
                 "per expert group, DeepSpeed's split_params_into_"
                 "different_moe_groups_for_optimizer semantics)")
-        if (cfg.moe.enabled or expert > 1) and self.strategy == "pipeline":
-            raise NotImplementedError(
-                "MoE/expert parallelism composes with the tensor/dp and "
-                "sequence strategies, not the pipeline engine — the same "
-                "restriction DeepSpeed ships: its PipelineModule cannot "
-                "carry MoE layers (deepspeed.moe is routed through the "
-                "non-pipeline engine only; the reference's own MoE surface, "
-                "resnet/deepspeed/deepspeed_train.py:61-106, drives plain "
-                "DP training). Architecturally: the stacked-stage scan "
-                "requires congruent per-layer param trees, which the "
-                "alternating dense/MoE layout (moe_every) breaks")
+        # Gated on moe.enabled (not the expert axis): an expert axis with
+        # MoE off has its own accurate diagnosis below ("enable --moe or
+        # drop the expert axis") — steering that user to --moe-every 1
+        # would not fix anything.
+        if cfg.moe.enabled and self.strategy == "pipeline":
+            homogeneous = (cfg.moe.every == 1
+                           and len(set(cfg.moe.num_experts)) == 1)
+            if not homogeneous:
+                raise NotImplementedError(
+                    "the pipeline engine carries MoE only in the "
+                    "HOMOGENEOUS layout (--moe-every 1, one expert count: "
+                    "the stacked-stage scan requires congruent per-layer "
+                    "param trees, which the alternating/per-layer layouts "
+                    "break). That already exceeds the parity bar — "
+                    "DeepSpeed's PipelineModule cannot carry MoE layers at "
+                    "all (deepspeed.moe routes through the non-pipeline "
+                    "engine only; the reference's MoE surface, "
+                    "resnet/deepspeed/deepspeed_train.py:61-106, drives "
+                    "plain DP training). Use tensor/dp or sequence for "
+                    "alternating/per-layer MoE")
         if expert > 1 and not cfg.moe.enabled:
             raise ValueError(
                 f"expert mesh axis sized {expert} with MoE disabled would "
@@ -196,6 +205,7 @@ class LMTrainer:
         if cfg.moe.enabled:
             moe_kwargs = dict(
                 moe_num_experts=tuple(int(n) for n in cfg.moe.num_experts),
+                moe_every=cfg.moe.every,
                 moe_top_k=cfg.moe.top_k,
                 moe_capacity_factor=cfg.moe.capacity_factor,
                 moe_min_capacity=cfg.moe.min_capacity,

@@ -70,6 +70,11 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--ep-world-size", type=int, default=1,
                         help="expert mesh axis size")
     parser.add_argument("--num-experts", type=int, nargs="+", default=[8])
+    parser.add_argument("--moe-every", type=int, default=2,
+                        help="swap every Nth decoder FFN for MoE (GShard "
+                             "alternating at 2); 1 = every layer — the "
+                             "homogeneous layout the pipeline strategy "
+                             "(--pp) can carry")
     parser.add_argument("--top-k", type=int, default=1)
     parser.add_argument("--min-capacity", type=int, default=0)
     parser.add_argument("--noisy-gate-policy", type=str, default=None,
@@ -128,6 +133,7 @@ def build_config(args: argparse.Namespace):
             enabled=args.moe,
             ep_world_size=args.ep_world_size,
             num_experts=tuple(args.num_experts),
+            every=args.moe_every,
             top_k=args.top_k,
             min_capacity=args.min_capacity,
             noisy_gate_policy=args.noisy_gate_policy,
