@@ -156,6 +156,25 @@ def strategy_cases(devices):
            dict(zip(ep_mesh.axis_names, ep_mesh.devices.shape)),
            *lm_case(ep_mesh, step, _lm_state(ep_model)))
 
+    # PP×EP (round 5): homogeneous MoE stages — the pipeline ppermutes
+    # plus the expert-axis dispatch/combine collectives GSPMD inserts
+    # inside each stage, plus the ZeRO-1 opt-state traffic over data.
+    ppe_mesh = create_mesh(MeshConfig(data=n // 4, pipe=2, expert=2),
+                           devices=devices)
+    ppe_model = _lm_model(moe_num_experts=4, moe_every=1, moe_top_k=1,
+                          moe_expert_axis="expert")
+    ppe_step = make_pp_lm_train_step(ppe_mesh, model=ppe_model,
+                                     num_microbatches=2, donate=False,
+                                     zero_stage=1)
+    ppe_state = TrainState.create(
+        apply_fn=ppe_step.pipelined.apply_fn,
+        params=ppe_step.pipelined.init_params(jax.random.PRNGKey(0)),
+        tx=optax.adam(1e-3),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+    yield ("lm dp×pp×ep zero-1 (moe stages)",
+           dict(zip(ppe_mesh.axis_names, ppe_mesh.devices.shape)),
+           *lm_case(ppe_mesh, ppe_step, ppe_state))
+
     # ViT×TP (round 4): megatron placement of the image transformer — the
     # per-block row-parallel psums appear exactly as in the LM TP case.
     vit_model = get_model("vit_b16", num_classes=10, patch_size=4,
