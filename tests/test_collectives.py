@@ -185,7 +185,8 @@ def test_committed_artifact_covers_all_strategies():
                      "lm dp×pp zero-1", "lm dp×pp circular (v=2)",
                      "lm dp×ep (moe)", "image vit dp×tp zero-1",
                      "lm dp×sp (ring)", "lm dp×sp zero-1",
-                     "lm dp×sp×tp", "lm dp×sp×ep"):
+                     "lm dp×sp×tp", "lm dp×sp×ep",
+                     "lm dp×pp×ep zero-1 (moe stages)"):
         assert expected in strategies, expected
         assert strategies[expected]["collectives"], expected
         assert strategies[expected]["grad_bytes_fp32"] > 0
@@ -198,6 +199,12 @@ def test_committed_artifact_covers_all_strategies():
     assert "all-gather" in strategies["image dp zero-3"]["collectives"]
     sp = strategies["lm dp×sp (ring)"]["collectives"]
     assert sp["collective-permute"]["count"] >= 4
+    # PP×EP (round 5): the pipeline's ppermutes AND the ZeRO-1 opt-state
+    # all-gather must both appear — an artifact regenerated from a builder
+    # that dropped either composition half fails here.
+    ppe = strategies["lm dp×pp×ep zero-1 (moe stages)"]["collectives"]
+    assert ppe["collective-permute"]["count"] >= 2
+    assert "all-gather" in ppe
     assert sp["all-reduce"]["count"] == 1
     assert "all-gather" not in sp
     assert "all-gather" in strategies["lm dp×sp zero-1"]["collectives"]

@@ -128,16 +128,17 @@ def strategy_cases(devices):
 
     pp_mesh = create_mesh(MeshConfig(data=n // 2, pipe=2), devices=devices)
 
-    def pp_case(name, pp_model, **kw):
-        step = make_pp_lm_train_step(pp_mesh, model=pp_model,
+    def pp_case(name, pp_model, mesh=None, **kw):
+        mesh = pp_mesh if mesh is None else mesh
+        step = make_pp_lm_train_step(mesh, model=pp_model,
                                      num_microbatches=2, donate=False, **kw)
         st = TrainState.create(
             apply_fn=step.pipelined.apply_fn,
             params=step.pipelined.init_params(jax.random.PRNGKey(0)),
             tx=optax.adam(1e-3),
             loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
-        return (name, dict(zip(pp_mesh.axis_names, pp_mesh.devices.shape)),
-                *lm_case(pp_mesh, step, st))
+        return (name, dict(zip(mesh.axis_names, mesh.devices.shape)),
+                *lm_case(mesh, step, st))
 
     # PP×ZeRO-1 and the circular schedule (round 4): zero-1 adds the
     # opt-state all-gather over data beside the GPipe ppermute; circular
@@ -163,17 +164,8 @@ def strategy_cases(devices):
                            devices=devices)
     ppe_model = _lm_model(moe_num_experts=4, moe_every=1, moe_top_k=1,
                           moe_expert_axis="expert")
-    ppe_step = make_pp_lm_train_step(ppe_mesh, model=ppe_model,
-                                     num_microbatches=2, donate=False,
-                                     zero_stage=1)
-    ppe_state = TrainState.create(
-        apply_fn=ppe_step.pipelined.apply_fn,
-        params=ppe_step.pipelined.init_params(jax.random.PRNGKey(0)),
-        tx=optax.adam(1e-3),
-        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
-    yield ("lm dp×pp×ep zero-1 (moe stages)",
-           dict(zip(ppe_mesh.axis_names, ppe_mesh.devices.shape)),
-           *lm_case(ppe_mesh, ppe_step, ppe_state))
+    yield pp_case("lm dp×pp×ep zero-1 (moe stages)", ppe_model,
+                  mesh=ppe_mesh, zero_stage=1)
 
     # ViT×TP (round 4): megatron placement of the image transformer — the
     # per-block row-parallel psums appear exactly as in the LM TP case.
