@@ -21,7 +21,13 @@ The TPU-native formulation is a single SPMD program:
   the stages in reverse tick order);
 - embeddings, final LayerNorm, and the LM head run outside the pipeline as
   ordinary GSPMD-sharded ops, so PP composes freely with the ``data`` axis
-  (and, via the TP rule table, with ``model``).
+  (and, via the TP rule table, with ``model``);
+- a ``seq_axis`` model composes too (round 5): the sequence axis joins the
+  manual set and each tick's attention rotates K/V around the ring INSIDE
+  the stage — activations hop over ``pipe`` between ticks while K/V blocks
+  hop over ``sequence`` within one, so long contexts and deep stacks shard
+  simultaneously; homogeneous MoE stages (``moe_every=1``) likewise carry
+  their expert FFNs with the aux loss collected through the tick scan.
 
 The pipeline bubble is the usual GPipe ``(S-1)/(M+S-1)`` fraction; raise
 ``num_microbatches`` to amortize it, or ``virtual_stages`` (the
@@ -281,11 +287,22 @@ class PipelinedLM:
             moe_layer_experts,
         )
 
-        if model.seq_axis is not None:
-            raise ValueError("pipelined LM uses full attention per stage; "
-                             "build the model with seq_axis=None")
         self.model = model
         self.mesh = mesh
+        mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+        # SP×PP (round 5): a seq_axis model composes — each pipeline tick
+        # runs ring attention over the (manual) sequence axis inside the
+        # stage, so a microbatch's K/V blocks rotate over ``sequence``
+        # while its activations hop over ``pipe``. The axis must exist on
+        # the mesh (an unbound ring axis raises deep inside the kernel
+        # with no actionable message).
+        self.seq_size = mesh_shape.get(model.seq_axis, 1) \
+            if model.seq_axis else 1
+        if model.seq_axis is not None and self.seq_size <= 1:
+            raise ValueError(
+                f"model.seq_axis={model.seq_axis!r} needs that mesh axis "
+                f"sized > 1 (got mesh {mesh_shape}); build the model with "
+                "seq_axis=None for the plain pipeline")
         self.num_microbatches = num_microbatches
         self.virtual_stages = virtual_stages
         # MoE stages (round 5): the stacked-layer scan requires CONGRUENT
@@ -324,18 +341,17 @@ class PipelinedLM:
             num_heads=model.num_heads,
             mlp_dim=model.mlp_ratio * model.hidden_dim,
             dtype=model.dtype,
-            seq_axis=None,
+            seq_axis=model.seq_axis,
             dropout_rate=model.dropout_rate,
             attn_impl=model.attn_impl,
             name=None,
             **moe_kwargs)
-        shape = dict(zip(mesh.axis_names, mesh.devices.shape))
-        self.pipe_size = shape.get(AXIS_PIPE, 1)
+        self.pipe_size = mesh_shape.get(AXIS_PIPE, 1)
         # TP composition: a model axis > 1 shards each stage's weights by
         # the megatron rule table; the pipeline shard_map is partial-manual
         # over (pipe, data) so GSPMD inserts the model-axis psums inside
         # each stage's compute.
-        self.tp_size = shape.get("model", 1)
+        self.tp_size = mesh_shape.get("model", 1)
         if virtual_stages < 1:
             raise ValueError(f"virtual_stages must be >= 1, got "
                              f"{virtual_stages}")
@@ -479,9 +495,13 @@ class PipelinedLM:
         # scan/ppermute schedule is explicit, while the model-axis (TP)
         # sharding of the stage weights stays automatic — GSPMD inserts the
         # megatron psums inside each stage_fn call. Without a model axis,
-        # full-manual is identical and keeps old-jax compatibility.
+        # full-manual is identical and keeps old-jax compatibility. With a
+        # seq_axis model the sequence axis is ALSO manual (the ring
+        # rotates K/V over it inside each stage) and x shards on dim 1.
+        seq = m.seq_axis if self.seq_size > 1 else None
+        x_spec = P(AXIS_DATA, seq, None)
         in_specs = [jax.tree.map(lambda _: P(AXIS_PIPE), params["blocks"]),
-                    P(AXIS_DATA, None, None)]
+                    x_spec]
         args = [params["blocks"], x]
         if dropout_rng is not None:
             in_specs.append(P())
@@ -494,30 +514,36 @@ class PipelinedLM:
                 # different batch rows but would otherwise draw the same
                 # local-shape masks from the replicated key).
                 rng = jax.random.fold_in(rng, lax.axis_index(AXIS_DATA))
+                if seq is not None:
+                    # ...and across sequence shards (different positions).
+                    rng = jax.random.fold_in(rng, lax.axis_index(seq))
             out = spmd_pipeline(
                 self._make_stage_fn(train), blocks, x,
                 num_microbatches=self.num_microbatches, rng=rng,
                 virtual_stages=self.virtual_stages, with_aux=self.moe)
             if self.moe:
                 y, aux = out
-                # Shard-local aux covers this data shard's rows; the mean
-                # over data matches the plain model's full-batch value
-                # (equal shard sizes by construction).
-                return y, lax.pmean(aux, AXIS_DATA)
+                # Shard-local aux covers this data(/sequence) shard's
+                # tokens; the mean over those axes matches the plain
+                # model's full-batch value (equal shard sizes by
+                # construction).
+                axes = (AXIS_DATA,) + ((seq,) if seq else ())
+                return y, lax.pmean(aux, axes)
             return out
 
         # Partial-manual also for MoE stages (expert stays automatic, so
         # GSPMD inserts the dispatch/combine collectives and honors the
         # expert-dim sharding constraints inside the stage, exactly as the
-        # model axis composes for TP).
-        partial_manual = self.tp_size > 1 or self.moe
-        out_specs = ((P(AXIS_DATA, None, None), P())
-                     if self.moe else P(AXIS_DATA, None, None))
+        # model axis composes for TP) and for SP×PP (sequence is manual —
+        # the ring's ppermutes — alongside pipe/data).
+        partial_manual = self.tp_size > 1 or self.moe or seq is not None
+        out_specs = (x_spec, P()) if self.moe else x_spec
+        manual_axes = (AXIS_PIPE, AXIS_DATA) + ((seq,) if seq else ())
         pipeline = shard_map(
             run, self.mesh,
             in_specs=tuple(in_specs),
             out_specs=out_specs,
-            axis_names=(AXIS_PIPE, AXIS_DATA) if partial_manual else None,
+            axis_names=manual_axes if partial_manual else None,
         )
         out = pipeline(*args)
         x, aux = out if self.moe else (out, None)

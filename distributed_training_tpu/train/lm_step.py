@@ -655,9 +655,11 @@ def _make_gspmd_lm_step(
     logits_dtype=jnp.float32,
     cpu_offload: bool = False,
     ce_save_probs: bool = False,
+    batch_spec: P | None = None,
 ) -> Callable:
     """Shared GSPMD LM step builder (the TP and PP steps differ only in how
-    the train state is placed): batch over ``data``, lazy jit once a
+    the train state is placed): batch over ``data`` (or ``batch_spec`` —
+    the SP×PP step shards tokens over data × sequence), lazy jit once a
     concrete state's pytree is known, placements from ``state_shardings_fn``.
 
     ``grad_accum_steps > 1`` scans microbatches through fwd/bwd inside the
@@ -668,8 +670,9 @@ def _make_gspmd_lm_step(
         raise ValueError(
             f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
     _check_ce_options(ce_chunk, ce_save_probs, logits_dtype)
-    batch_sh = {"tokens": NamedSharding(mesh, P(AXIS_DATA, None)),
-                "targets": NamedSharding(mesh, P(AXIS_DATA, None))}
+    spec = P(AXIS_DATA, None) if batch_spec is None else batch_spec
+    batch_sh = {"tokens": NamedSharding(mesh, spec),
+                "targets": NamedSharding(mesh, spec)}
 
     def body(state: TrainState, batch, rng):
         if cpu_offload:
@@ -755,7 +758,9 @@ def make_pp_lm_train_step(
     forward runs the ``lax.scan`` + ``lax.ppermute`` schedule from
     ``parallel/pipeline.py`` and the backward pipeline falls out of
     autodiff (ppermute's transpose is the reverse hop). Embeddings and the
-    LM head are plain GSPMD ops sharded over ``data``, so DP composes.
+    LM head are plain GSPMD ops sharded over ``data``, so DP composes. A
+    ``seq_axis`` model selects SP×PP (round 5): the batch shards over
+    ``data × sequence`` and ring attention runs inside each stage.
     ``virtual_stages > 1`` selects the interleaved/circular schedule
     (bubble ``(S-1)/(v·M+S-1)`` instead of GPipe's ``(S-1)/(M+S-1)``).
 
@@ -813,7 +818,9 @@ def make_pp_lm_train_step(
         mesh, state_shardings, donate=donate, ce_chunk=ce_chunk,
         accuracy_metric=accuracy_metric,
         logits_dtype=model_logits_dtype(model),
-        cpu_offload=cpu_offload, ce_save_probs=ce_save_probs)
+        cpu_offload=cpu_offload, ce_save_probs=ce_save_probs,
+        batch_spec=(P(AXIS_DATA, model.seq_axis)
+                    if model.seq_axis else None))
     step.pipelined = plm
     return step
 

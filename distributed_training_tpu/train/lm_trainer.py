@@ -7,20 +7,21 @@ product surface, not just library steps).
 
 The parallel strategy follows from the mesh, not from a flag:
 
+- ``pipe > 1``      → GPipe pipeline parallelism
+  (``make_pp_lm_train_step``: stacked blocks sharded over ``pipe``; with
+  ``sequence > 1`` too, ring attention runs INSIDE each tick — SP×PP,
+  round 5);
 - ``sequence > 1``  → ring-attention sequence parallelism
   (``make_lm_train_step``: shard_map, K/V blocks hop the ICI ring);
-- ``pipe > 1``      → GPipe pipeline parallelism
-  (``make_pp_lm_train_step``: stacked blocks sharded over ``pipe``);
 - otherwise         → the GSPMD step (``make_tp_lm_train_step``), which is
   megatron TP when ``model > 1`` and plain DP when ``model == 1``, with
   ZeRO stages composing on the free dims.
 
-``model > 1`` composes with EITHER explicit strategy (TP×SP, PP×TP): the
-sequence/pipeline shard_maps are partial-manual — their own axes are
-manual while ``model`` stays automatic, so megatron shardings propagate
-inside the shards and GSPMD inserts the row-parallel psums there. The one
-remaining exclusion is ``sequence`` with ``pipe`` (two explicit schedules
-over one activation stream), rejected loudly.
+``model > 1`` composes with EITHER explicit strategy (TP×SP, PP×TP), and
+``expert > 1`` with tensor/dp, sequence, and (homogeneous MoE) pipeline:
+the explicit shard_maps are partial-manual — their own axes are manual
+while ``model``/``expert`` stay automatic, so megatron/expert shardings
+propagate inside the shards and GSPMD inserts the collectives there.
 """
 
 from __future__ import annotations
@@ -82,12 +83,13 @@ class LMTrainer:
         seq = shape.get(AXIS_SEQUENCE, 1)
         pipe = shape.get(AXIS_PIPE, 1)
         model_par = shape.get(AXIS_MODEL, 1)
-        if seq > 1 and pipe > 1:
-            raise NotImplementedError(
-                "sequence and pipe axes do not compose in this engine; use "
-                "(sequence [×model]) | (pipe [×model]) | (model [+zero])")
-        self.strategy = ("sequence" if seq > 1 else
-                         "pipeline" if pipe > 1 else
+        # pipe>1 selects the pipeline engine; a sequence axis composes
+        # WITH it since round 5 (each pipeline tick runs ring attention
+        # over the manual sequence axis inside the stage), so seq>1 alone
+        # selects the plain ring strategy and seq×pipe goes through the
+        # pipeline with a seq_axis model.
+        self.strategy = ("pipeline" if pipe > 1 else
+                         "sequence" if seq > 1 else
                          "tensor/dp")
         # model_par composes with EITHER explicit strategy: the sequence and
         # pipeline shard_maps are partial-manual (their own axes manual,
@@ -164,8 +166,12 @@ class LMTrainer:
                 raise ValueError(
                     f"ce_chunk_size must be >= 1, got {lm.ce_chunk_size}")
             # Token datasets yield seq_len+1 tokens so the shifted loss
-            # length is exactly seq_len (seq_len/sp per sequence shard).
-            t_loss = lm.seq_len // seq
+            # length is exactly seq_len — seq_len/sp per shard for the
+            # ring strategy's shard-local chunked CE, but the FULL seq_len
+            # for the pipeline path (its chunked CE runs under GSPMD over
+            # the global time axis, even with a sequence mesh axis).
+            t_loss = (lm.seq_len // seq
+                      if self.strategy == "sequence" else lm.seq_len)
             if t_loss % lm.ce_chunk_size:
                 raise ValueError(
                     f"ce_chunk_size {lm.ce_chunk_size} must divide the "

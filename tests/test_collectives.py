@@ -186,7 +186,8 @@ def test_committed_artifact_covers_all_strategies():
                      "lm dp×ep (moe)", "image vit dp×tp zero-1",
                      "lm dp×sp (ring)", "lm dp×sp zero-1",
                      "lm dp×sp×tp", "lm dp×sp×ep",
-                     "lm dp×pp×ep zero-1 (moe stages)"):
+                     "lm dp×pp×ep zero-1 (moe stages)",
+                     "lm dp×pp×sp zero-1 (ring-in-stage)"):
         assert expected in strategies, expected
         assert strategies[expected]["collectives"], expected
         assert strategies[expected]["grad_bytes_fp32"] > 0
@@ -205,6 +206,13 @@ def test_committed_artifact_covers_all_strategies():
     ppe = strategies["lm dp×pp×ep zero-1 (moe stages)"]["collectives"]
     assert ppe["collective-permute"]["count"] >= 2
     assert "all-gather" in ppe
+    # SP×PP: MORE ppermutes than the plain pipeline (pipe hops + the
+    # ring's per-tick K/V rotation) — a K/V all-gather materialization
+    # regression would collapse the count back.
+    spp = strategies["lm dp×pp×sp zero-1 (ring-in-stage)"]["collectives"]
+    gpipe = strategies["lm dp×pp (gpipe)"]["collectives"]
+    assert (spp["collective-permute"]["count"]
+            > gpipe["collective-permute"]["count"])
     assert sp["all-reduce"]["count"] == 1
     assert "all-gather" not in sp
     assert "all-gather" in strategies["lm dp×sp zero-1"]["collectives"]

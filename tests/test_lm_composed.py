@@ -249,11 +249,17 @@ class TestLMTrainerComposition:
         assert result["steps"] == 4
         assert np.isfinite(result["final_perplexity"])
 
-    def test_sequence_pipe_still_rejected(self):
+    def test_lm_trainer_runs_sequence_pipe(self):
+        """seq×pipe composes since round 5 (was the engine's last refusal):
+        the pipeline strategy drives a seq_axis model with ring attention
+        inside each tick."""
         from distributed_training_tpu.train.lm_trainer import LMTrainer
 
-        with pytest.raises(NotImplementedError, match="sequence and pipe"):
-            LMTrainer(self._cfg(sequence=2, pipe=2))
+        trainer = LMTrainer(self._cfg(sequence=2, pipe=2))
+        assert trainer.strategy == "pipeline"
+        result = trainer.fit()
+        assert result["steps"] == 4
+        assert np.isfinite(result["final_perplexity"])
 
 
 class TestSequenceExpertComposition:
@@ -378,3 +384,74 @@ class TestSequenceGradAccum:
         result = trainer.fit()
         assert result["steps"] == 4
         assert np.isfinite(result["final_perplexity"])
+
+
+class TestSequencePipeComposition:
+    """SP×PP (round 5): ring attention over the manual sequence axis
+    INSIDE each pipeline tick — two explicit schedules over one
+    activation stream, previously the engine's last composition refusal.
+    The oracle property: identical params + batch ⇒ the composed step
+    matches the plain (seq_axis=None) pipeline step, whose own
+    equivalence to the single-device model is already pinned."""
+
+    def test_sp_pp_step_matches_plain_pp(self):
+        from distributed_training_tpu.train.train_state import TrainState
+
+        toks = _tokens(b=8, t=17)
+        batch = make_lm_batch(toks)
+        rng = jax.random.PRNGKey(7)
+
+        def run(seq_axis, mesh):
+            model = get_model(
+                "transformer_lm", num_classes=VOCAB, seq_axis=seq_axis,
+                num_layers=2, num_heads=2, hidden_dim=32, max_len=128)
+            step = make_pp_lm_train_step(mesh, model=model,
+                                         num_microbatches=2, donate=False)
+            plm = step.pipelined
+            state = TrainState.create(
+                apply_fn=plm.apply_fn,
+                params=plm.init_params(jax.random.PRNGKey(0)),
+                tx=optax.sgd(0.1),
+                loss_scale=LossScaleState.create(
+                    PrecisionConfig(dtype="fp32")))
+            state = jax.device_put(state, step.state_shardings(state))
+            gbatch = jax.device_put(
+                {k: jnp.asarray(v) for k, v in batch.items()},
+                step.batch_shardings)
+            new_state, m = step(state, gbatch, rng)
+            return jax.device_get(new_state.params), m
+
+        pp = create_mesh(MeshConfig(data=4, pipe=2))
+        spp = create_mesh(MeshConfig(data=2, pipe=2, sequence=2))
+        ref_params, ref_m = run(None, pp)
+        got_params, got_m = run("sequence", spp)
+        np.testing.assert_allclose(float(got_m["loss"]),
+                                   float(ref_m["loss"]), rtol=1e-6)
+        _assert_tree_close(got_params, ref_params, atol=1e-6, rtol=1e-5)
+
+    def test_sp_pp_zero1_circular(self):
+        """The deeper product: sequence × pipe × circular schedule ×
+        ZeRO-1 runs one finite step."""
+        from distributed_training_tpu.train.train_state import TrainState
+
+        mesh = create_mesh(MeshConfig(data=2, pipe=2, sequence=2))
+        model = get_model(
+            "transformer_lm", num_classes=VOCAB, seq_axis="sequence",
+            num_layers=4, num_heads=2, hidden_dim=32, max_len=128)
+        step = make_pp_lm_train_step(mesh, model=model, num_microbatches=2,
+                                     donate=False, zero_stage=1,
+                                     virtual_stages=2)
+        plm = step.pipelined
+        state = TrainState.create(
+            apply_fn=plm.apply_fn,
+            params=plm.init_params(jax.random.PRNGKey(0)),
+            tx=optax.adam(1e-3),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+        state = jax.device_put(state, step.state_shardings(state))
+        batch = jax.device_put(
+            {k: jnp.asarray(v) for k, v in
+             make_lm_batch(_tokens(b=8, t=17)).items()},
+            step.batch_shardings)
+        _, m = step(state, batch, jax.random.PRNGKey(1))
+        assert np.isfinite(float(m["loss"]))
+        assert float(m["grads_finite"]) == 1.0

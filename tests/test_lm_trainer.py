@@ -106,14 +106,20 @@ def test_lm_trainer_checkpoint_resume(tmp_path):
 
 
 def test_lm_trainer_rejects_bad_meshes(tmp_path):
-    # sequence×model and pipe×model now COMPOSE (round 2, partial-manual
-    # shard_map; tests/test_lm_composed.py); the remaining exclusion is
-    # sequence×pipe — two explicit schedules over one activation stream.
-    with pytest.raises(NotImplementedError, match="sequence and pipe"):
-        LMTrainer(_cfg(MeshSpec(data=2, sequence=2, pipe=2), tmp_path))
+    # sequence×model and pipe×model compose since round 2, sequence×pipe
+    # since round 5 (ring attention inside the pipeline stage) — the
+    # remaining mesh errors are divisibility ones.
     with pytest.raises(ValueError, match="num_heads"):
         cfg = _cfg(MeshSpec(data=1, model=8), tmp_path)
         LMTrainer(cfg)
+
+
+def test_lm_trainer_sequence_pipe_composes(tmp_path):
+    """seq×pipe (round 5): the pipeline engine drives a seq_axis model —
+    ring attention over the manual sequence axis inside each tick."""
+    cfg = _cfg(MeshSpec(data=2, sequence=2, pipe=2), tmp_path)
+    result = LMTrainer(cfg).fit()
+    assert np.isfinite(result["final_perplexity"])
 
 
 def test_metrics_accuracy_off_drops_key_same_loss(tmp_path):

@@ -167,6 +167,15 @@ def strategy_cases(devices):
     yield pp_case("lm dp×pp×ep zero-1 (moe stages)", ppe_model,
                   mesh=ppe_mesh, zero_stage=1)
 
+    # SP×PP (round 5): the pipeline's hop ppermutes PLUS the ring's K/V
+    # ppermutes inside each tick — a GSPMD regression that materialized
+    # K/V all-gathers instead of the ring would show here.
+    spp_mesh = create_mesh(MeshConfig(data=n // 4, pipe=2, sequence=2),
+                           devices=devices)
+    spp_model = _lm_model(seq_axis="sequence")
+    yield pp_case("lm dp×pp×sp zero-1 (ring-in-stage)", spp_model,
+                  mesh=spp_mesh, zero_stage=1)
+
     # ViT×TP (round 4): megatron placement of the image transformer — the
     # per-block row-parallel psums appear exactly as in the LM TP case.
     vit_model = get_model("vit_b16", num_classes=10, patch_size=4,
