@@ -248,7 +248,8 @@ class LMConfig:
     # times — bubble (S-1)/(v·M+S-1) vs GPipe's (S-1)/(M+S-1). 1 = GPipe.
     # Pipeline strategy only; num_layers must divide by pipe × v.
     virtual_stages: int = 1
-    attn_impl: str = "exact"  # exact | flash (Pallas kernel; not w/ sequence)
+    attn_impl: str = "exact"  # exact | flash (Pallas kernel; under a
+    # sequence axis the kernel computes each ring hop — ring+flash)
     # Chunked cross-entropy: apply the lm_head + CE over time chunks of
     # this many tokens so the [B, T, vocab] logits never materialize
     # (B8·T16k·V50k fp32 = 26 GB — the memory wall for long-context ×

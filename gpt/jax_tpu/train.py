@@ -41,7 +41,8 @@ def add_argument() -> argparse.Namespace:
                         help="byte-level text file; default synthetic tokens")
     parser.add_argument("--attn-impl", type=str, default="exact",
                         choices=["exact", "flash"],
-                        help="flash = Pallas blockwise kernel (not with --sp)")
+                        help="flash = Pallas blockwise kernel; under --sp it "
+                             "becomes the per-hop ring compute")
     parser.add_argument("--ce-chunk-size", type=int, default=None,
                         help="chunked cross-entropy: tokens per lm_head+CE "
                              "chunk (never materializes [B,T,vocab] logits; "
