@@ -429,6 +429,46 @@ class TestSequencePipeComposition:
                                    float(ref_m["loss"]), rtol=1e-6)
         _assert_tree_close(got_params, ref_params, atol=1e-6, rtol=1e-5)
 
+    def test_pp_sp_tp_one_program_matches_plain_pp(self):
+        """Every explicit axis at once (pipe × sequence × model in one
+        compiled SPMD program; data=1 — ZeRO would be a no-op sharding
+        here and is deliberately left out of the claim): the loss matches
+        the plain PP oracle. A dropped psum on any of the three axes
+        would break the equality."""
+        from distributed_training_tpu.train.train_state import TrainState
+
+        toks = _tokens(b=8, t=17)
+        batch = make_lm_batch(toks)
+        rng = jax.random.PRNGKey(7)
+
+        def run(seq_axis, mesh):
+            model = get_model(
+                "transformer_lm", num_classes=VOCAB, seq_axis=seq_axis,
+                num_layers=2, num_heads=2, hidden_dim=32, max_len=128)
+            step = make_pp_lm_train_step(mesh, model=model,
+                                         num_microbatches=2, donate=False)
+            plm = step.pipelined
+            state = TrainState.create(
+                apply_fn=plm.apply_fn,
+                params=plm.init_params(jax.random.PRNGKey(0)),
+                tx=optax.sgd(0.1),
+                loss_scale=LossScaleState.create(
+                    PrecisionConfig(dtype="fp32")))
+            state = jax.device_put(state, step.state_shardings(state))
+            gbatch = jax.device_put(
+                {k: jnp.asarray(v) for k, v in batch.items()},
+                step.batch_shardings)
+            _, m = step(state, gbatch, rng)
+            return m
+
+        ref = run(None, create_mesh(MeshConfig(data=4, pipe=2)))
+        deep = run("sequence",
+                   create_mesh(MeshConfig(data=1, pipe=2, sequence=2,
+                                          model=2)))
+        np.testing.assert_allclose(float(deep["loss"]), float(ref["loss"]),
+                                   rtol=1e-5)
+        assert float(deep["grads_finite"]) == 1.0
+
     def test_sp_pp_zero1_circular(self):
         """The deeper product: sequence × pipe × circular schedule ×
         ZeRO-1 runs one finite step."""
