@@ -1,10 +1,14 @@
-"""Benchmark: ResNet-50 training throughput in images/sec/chip.
+"""Benchmark: the two headline training-throughput metrics.
 
-The north-star metric from BASELINE.json: ResNet-50/ImageNet-1k
-images/sec/chip on TPU (target ≥6000 on v4-8; this environment exposes one
-v5e chip via the axon tunnel). Prints ONE JSON line:
+A bare ``python bench.py`` emits BOTH legs, one JSON line each — the image
+leg (ResNet-50 synthetic-ImageNet images/sec/chip, the BASELINE.json
+north-star: target ≥6000 on v4-8; this environment exposes one v5e chip via
+the axon tunnel) followed by the LM leg (GPT-2-small tokens/sec). Per-leg
+flags isolate one leg: ``--image``, ``--lm``, ``--data-only``,
+``--data-concurrent``, ``--check``.
 
     {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "tokens/sec", "vs_baseline": N}
 
 Measures the steady-state jitted train step (fwd + bwd + Adam update, bf16
 compute) on device-resident synthetic ImageNet batches — the same compute
@@ -408,7 +412,10 @@ def bench_lm(args) -> None:
         args.steps = min(args.steps, 4)
         args.warmup = min(args.warmup, 2)
 
-    mesh = create_mesh(MeshConfig(data=-1))
+    if args.tp < 1 or jax.device_count() % args.tp:
+        raise SystemExit(f"--tp {args.tp} must be >= 1 and divide the "
+                         f"device count (= {jax.device_count()})")
+    mesh = create_mesh(MeshConfig(data=-1, model=args.tp))
     model = get_model(
         "transformer_lm", num_classes=50304, dtype=jnp.bfloat16,
         num_layers=12, num_heads=12, hidden_dim=768,
@@ -428,7 +435,8 @@ def bench_lm(args) -> None:
     step = make_tp_lm_train_step(mesh, model=model, donate=True,
                                  ce_chunk=args.ce_chunk,
                                  accuracy_metric=not args.no_accuracy,
-                                 ce_save_probs=args.ce_save_probs)
+                                 ce_save_probs=args.ce_save_probs,
+                                 tp_overlap=args.tp_overlap)
     toks = np.random.RandomState(0).randint(
         0, 50304, (args.lm_batch, args.seq_len + 1)).astype(np.int32)
     batch = jax.device_put(
@@ -485,6 +493,7 @@ def bench_lm(args) -> None:
                           and args.logits_dtype == "bf16"
                           and not args.head_bias
                           and not args.ce_save_probs
+                          and args.tp == 1 and not args.tp_overlap
                           and steps_per_call == 1)
     result = {
         "metric": f"GPT-2-small train throughput (bf16 "
@@ -495,6 +504,8 @@ def bench_lm(args) -> None:
                   f"{', chunked CE' if args.ce_chunk else ''}"
                   f"{', ce-probs' if args.ce_save_probs else ''}"
                   f"{', no-acc-metric' if args.no_accuracy else ''}"
+                  f"{', tp:' + str(args.tp) if args.tp > 1 else ''}"
+                  f"{', tp-overlap' if args.tp_overlap else ''}"
                   f"{', steps/call:' + str(steps_per_call) if steps_per_call > 1 else ''}, "
                   f"{jax.device_count()} {platform} chip(s))",
         "value": round(tok_s, 1),
@@ -575,8 +586,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--data-only loader batch (kept at the round-1 "
                          "value so host numbers stay comparable)")
     ap.add_argument("--lm", action="store_true", default=False,
-                    help="bench the GPT-2-small LM step (tokens/sec) "
-                         "instead of the image step")
+                    help="bench ONLY the GPT-2-small LM step (tokens/sec); "
+                         "a bare run emits the image leg then the LM leg")
+    ap.add_argument("--image", action="store_true", default=False,
+                    help="bench ONLY the image step (a bare run emits both "
+                         "legs)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="LM leg: tensor-parallel (model axis) size; the "
+                         "remaining devices form the data axis")
+    ap.add_argument("--tp-overlap", action="store_true", default=False,
+                    help="LM leg: ring-overlapped tensor parallelism "
+                         "(latency-hiding collective matmul; ppermute "
+                         "rings instead of monolithic TP collectives)")
     ap.add_argument("--lm-batch", type=int, default=16)
     ap.add_argument("--seq-len", type=int, default=1024)
     ap.add_argument("--attn-impl", default="flash",
@@ -631,7 +652,17 @@ def main():
     if args.lm:
         bench_lm(args)
         return
-    bench_image(args)
+    if args.image:
+        bench_image(args)
+        return
+    # Bare run: BOTH headline legs, one JSON line each (image, then LM), so
+    # a single `python bench.py` witnesses the full metric surface. Each
+    # leg gets its own copy — the benches mutate their args (CPU-fallback
+    # clamps, steps-per-call rounding).
+    import copy
+
+    bench_image(copy.deepcopy(args))
+    bench_lm(copy.deepcopy(args))
 
 
 def bench_image(args):

@@ -254,8 +254,9 @@ class LMConfig:
     # this many tokens so the [B, T, vocab] logits never materialize
     # (B8·T16k·V50k fp32 = 26 GB — the memory wall for long-context ×
     # large-vocab training). None = whole-sequence logits. Must divide
-    # the (per-shard) sequence length; not supported with the pipeline
-    # executor.
+    # the (per-shard) sequence length; composes with the pipeline
+    # executor since round 3 (pinned by
+    # test_pipeline_composes_with_chunking).
     ce_chunk_size: int | None = None
     # CE backward from saved bf16 softmax probs instead of re-reading the
     # logits and re-running exp in both lm_head backward matmul fusions.
@@ -269,12 +270,15 @@ class LMConfig:
     # (tie-inclusive top-1, no extra HBM pass) so it is nearly free; False
     # drops the metric key for exact loss-only parity with the reference.
     metrics_accuracy: bool = True
-    # Head/logits compute dtype: "fp32" (default; stable softmax) or
-    # "bf16" — halves the [B, T, vocab] logits HBM round-trips (measured
-    # +7% tok/s on GPT-2-small T1024, BASELINE.md round 4); the CE still
-    # reduces in fp32 (train/lm_step.py::_fused_ce_rows), only the stored
-    # logits round to bf16.
-    logits_dtype: str = "fp32"
+    # Head/logits compute dtype: "bf16" (default since round 6, matching
+    # the train.py/bench.py/generate.py CLI defaults — ADVICE r5 flagged
+    # the divergence) or "fp32". bf16 halves the [B, T, vocab] logits HBM
+    # round-trips (measured +7% tok/s on GPT-2-small T1024, BASELINE.md
+    # round 4; 8-epoch chip A/B tracks fp32 to the 4th decimal, round 5);
+    # the CE still reduces in fp32 (train/lm_step.py::_fused_ce_rows),
+    # only the stored logits round to bf16. tests/test_config.py pins
+    # config default == CLI default.
+    logits_dtype: str = "bf16"
     # lm_head bias. Default OFF since round 5: GPT-2's real head has none,
     # and its gradient is a full extra HBM pass over the [B, T, vocab]
     # logits (profiled 2.3 ms/step at GPT-2-small T1024). True restores
@@ -305,6 +309,13 @@ class TrainConfig:
     # activation memory for ~30% extra backward FLOPs. Unlocks configs
     # that otherwise OOM (e.g. ViT-B/16 batch 512/chip on v5e).
     remat: bool = False
+    # Ring-overlapped tensor parallelism (mesh.model > 1 only): decompose
+    # the megatron layer collectives into per-shard ppermute rings fused
+    # with the partial matmuls, hiding the TP communication behind compute
+    # (parallel/collective_matmul.py). Applies to the transformer LM and
+    # ViT TP paths; no-op at model == 1. Default off — the declarative
+    # GSPMD schedule remains the baseline.
+    tp_overlap: bool = False
     seed: int = 0
     log_interval: int = 100    # steps between host-side loss fetches
     target_acc: float | None = None  # colossal_train.py:43-46, wired here

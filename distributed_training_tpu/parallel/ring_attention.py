@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from distributed_training_tpu.utils.compat import axis_size as _axis_size
+
 
 def _online_block_update(o, m, l, s, v):
     """One flash-attention accumulation step.
@@ -92,7 +94,7 @@ def ring_attention(
         p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
         return jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
 
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     # Accumulate in fp32 regardless of compute dtype: the recurrence
     # subtracts running maxima and sums many exps — bf16 drifts.
@@ -147,7 +149,7 @@ def _ring_attention_flash(q, k, v, *, axis_name: str, causal: bool):
         flash_attention_lse,
     )
 
-    axis_size = lax.axis_size(axis_name)
+    axis_size = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     o = jnp.zeros(q.shape, jnp.float32)
     lse_acc = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)

@@ -77,9 +77,26 @@ LM_TP_RULES: tuple[tuple[str, P], ...] = (
 )
 
 
-def tp_spec_for_path(path: str) -> P:
+# Vocab/class-parallel params that the ring-overlapped schedule keeps
+# replicated over the model axis: the overlap layout shards ACTIVATIONS on
+# the time dim through the stack, and the (position-wise) head consumes the
+# local time shard directly — there is no vocab-sharded softmax-CE psum to
+# overlap, so these weights stay whole. ZeRO still shards their optimizer
+# state over the data axes (``zero_leaf_sharding`` with base P()).
+_OVERLAP_REPLICATED = (
+    r"lm_head/(?:kernel|bias)$",
+    r"tok_embed/embedding$",
+    r"(?:^|/)head/(?:kernel|bias)$",
+)
+
+
+def tp_spec_for_path(path: str, overlap: bool = False) -> P:
     """TP PartitionSpec for one ``a/b/c`` leaf path (replicated if no rule
-    matches)."""
+    matches). ``overlap=True`` selects the ring-overlapped schedule's
+    placement: identical to the rule table except that vocab/class-parallel
+    params stay replicated (see ``_OVERLAP_REPLICATED``)."""
+    if overlap and any(re.search(p, path) for p in _OVERLAP_REPLICATED):
+        return P()
     for pat, spec in LM_TP_RULES:
         if re.search(pat, path):
             return spec
@@ -92,6 +109,7 @@ def tp_tree_shardings(
     *,
     extra_axes: tuple[str, ...] = (),
     memory_kind: str | None = None,
+    overlap: bool = False,
 ) -> Any:
     """NamedShardings for every leaf of ``tree`` by the TP rule table.
 
@@ -108,7 +126,7 @@ def tp_tree_shardings(
     kw = {"memory_kind": memory_kind} if memory_kind else {}
 
     def leaf_sharding(path, leaf):
-        spec = tp_spec_for_path(path_str(path))
+        spec = tp_spec_for_path(path_str(path), overlap=overlap)
         if extra_axes:
             return zero_leaf_sharding(leaf, mesh, extra_axes, base=spec,
                                       memory_kind=memory_kind)
@@ -118,7 +136,7 @@ def tp_tree_shardings(
 
 
 def tp_state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0,
-                       cpu_offload: bool = False):
+                       cpu_offload: bool = False, overlap: bool = False):
     """Shardings for a full TrainState under TP (+ optional ZeRO stages).
 
     Mirrors :func:`distributed_training_tpu.parallel.sharding.state_shardings`
@@ -136,10 +154,11 @@ def tp_state_shardings(state: Any, mesh: Mesh, zero_stage: int = 0,
     check_cpu_offload(cpu_offload, zero_stage)
     param_axes, opt_axes = zero_stage_axes(mesh, zero_stage)
 
-    params_sh = tp_tree_shardings(state.params, mesh, extra_axes=param_axes)
+    params_sh = tp_tree_shardings(state.params, mesh, extra_axes=param_axes,
+                                  overlap=overlap)
     opt_sh = tp_tree_shardings(
         state.opt_state, mesh, extra_axes=opt_axes,
-        memory_kind="pinned_host" if cpu_offload else None)
+        memory_kind="pinned_host" if cpu_offload else None, overlap=overlap)
     repl = NamedSharding(mesh, P())
     batch_stats_sh = jax.tree.map(lambda _: repl, state.batch_stats)
     scale_sh = jax.tree.map(lambda _: repl, state.loss_scale)

@@ -33,10 +33,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from distributed_training_tpu.runtime.mesh import AXIS_DATA, AXIS_SEQUENCE
+from distributed_training_tpu.runtime.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQUENCE,
+)
 from distributed_training_tpu.train.precision import commit_gradients
 from distributed_training_tpu.train.train_state import TrainState
-from distributed_training_tpu.utils.compat import shard_map
+from distributed_training_tpu.utils.compat import axis_size, shard_map
 
 _GRAD_AXES = (AXIS_DATA, AXIS_SEQUENCE)
 
@@ -111,11 +115,14 @@ def _fused_ce_rows(logits, targets, with_correct: bool = False):
     from values the CE already has in hand: the label is top-1 iff its
     logit equals the row max (``lab >= m``; it cannot exceed it). This is
     tie-inclusive top-1 — identical to ``argmax(logits) == target`` except
-    when the label logit exactly ties a different index's max, a
-    measure-zero event the metric can't resolve anyway — and it deletes
-    the separate argmax reduction, a full extra HBM pass over the
-    [B, T, vocab] tensor (measured 4.4 ms / +3.8% tok/s on the
-    GPT-2-small B16 T1024 step, BASELINE.md round 4).
+    when the label logit exactly ties a different index's max. Under fp32
+    logits such ties are measure-zero (continuously distributed values
+    collide with probability ~0); under bf16 logits — the default since
+    round 6 — the 8-bit mantissa makes collisions merely RARE, not
+    impossible, so the metric can overcount top-1 by the (tiny) tie rate.
+    Either way it deletes the separate argmax reduction, a full extra HBM
+    pass over the [B, T, vocab] tensor (measured 4.4 ms / +3.8% tok/s on
+    the GPT-2-small B16 T1024 step, BASELINE.md round 4).
     """
     m = lax.stop_gradient(
         jnp.max(logits, axis=-1, keepdims=True)).astype(jnp.float32)
@@ -368,42 +375,82 @@ def _lm_grads_body(gstate: TrainState, batch, rng,
                    ce_chunk: int | None = None, accum: int = 1,
                    accuracy_metric: bool = True,
                    logits_dtype=jnp.float32,
-                   ce_save_probs: bool = False):
+                   ce_save_probs: bool = False,
+                   tp_overlap: bool = False):
     """The manual (shard_map) half of the sequence-parallel step: compute
     the globally-averaged, unscaled gradient and the shard-averaged metric
     scalars. The optimizer commit deliberately happens OUTSIDE the manual
     region (see :func:`make_lm_train_step`) so ZeRO placements of the
     optimizer state stay in GSPMD-land; ``gstate`` is the train state with
-    ``opt_state`` stripped — the body must not touch it."""
+    ``opt_state`` stripped — the body must not touch it.
+
+    ``tp_overlap=True`` runs the forward/backward under the ring-overlapped
+    megatron schedule (``parallel/collective_matmul.py``): params enter as
+    model-axis shards, the decoder stack's activations are time-sharded over
+    ``model``, and the per-layer collectives are ppermute rings. The loss is
+    computed on this rank's time chunk (targets sliced below), so metrics
+    and replicated-leaf grads additionally reduce over ``model``.
+    """
+    import contextlib
+
     tokens = batch["tokens"]
     targets = batch["targets"]
     positions = _global_positions(tokens.shape[1])
     # Decorrelate dropout across shards; no-op when the model has none.
-    shard_rng = jax.random.fold_in(
-        rng, lax.axis_index(AXIS_SEQUENCE) * lax.axis_size(AXIS_DATA)
-        + lax.axis_index(AXIS_DATA))
+    fold = (lax.axis_index(AXIS_SEQUENCE) * axis_size(AXIS_DATA)
+            + lax.axis_index(AXIS_DATA))
+    if tp_overlap:
+        import flax.linen as nn
 
-    if accum > 1:
-        # Long-context accumulation: the local batch dim is the EFFECTIVE
-        # micro×accum slice; the shared scan runs shard-locally
-        # (mesh=None), then one collective + one update. Equal-sized
-        # microbatches ⇒ mean of micro-means is the full mean.
-        grads, ce, aux, accuracy = _lm_accum_grads(
-            gstate, {"tokens": tokens, "targets": targets}, shard_rng,
-            accum, None, ce_chunk, positions=positions,
-            accuracy_metric=accuracy_metric, logits_dtype=logits_dtype,
-            ce_save_probs=ce_save_probs)
+        from distributed_training_tpu.parallel.collective_matmul import (
+            seq_overlap_interceptor,
+        )
+
+        tp = axis_size(AXIS_MODEL)
+        fold = fold * tp + lax.axis_index(AXIS_MODEL)
+        # The stack's logits come out time-sharded over model (the overlap
+        # layout never re-gathers them); slice the targets to match. The
+        # loss/accuracy means then cover this rank's chunk only — the
+        # model-axis pmeans below complete them.
+        t_loc = targets.shape[1] // tp
+        targets = lax.dynamic_slice_in_dim(
+            targets, lax.axis_index(AXIS_MODEL) * t_loc, t_loc, axis=1)
+        ctx = nn.intercept_methods(seq_overlap_interceptor(AXIS_MODEL))
     else:
-        grads, ce, aux, accuracy = _lm_loss_and_grads(
-            gstate, tokens, targets, shard_rng, positions=positions,
-            ce_chunk=ce_chunk, accuracy_metric=accuracy_metric,
-            logits_dtype=logits_dtype, ce_save_probs=ce_save_probs)
+        ctx = contextlib.nullcontext()
+    shard_rng = jax.random.fold_in(rng, fold)
+
+    with ctx:
+        if accum > 1:
+            # Long-context accumulation: the local batch dim is the
+            # EFFECTIVE micro×accum slice; the shared scan runs
+            # shard-locally (mesh=None), then one collective + one update.
+            # Equal-sized microbatches ⇒ mean of micro-means is the full
+            # mean.
+            grads, ce, aux, accuracy = _lm_accum_grads(
+                gstate, {"tokens": tokens, "targets": targets}, shard_rng,
+                accum, None, ce_chunk, positions=positions,
+                accuracy_metric=accuracy_metric, logits_dtype=logits_dtype,
+                ce_save_probs=ce_save_probs)
+        else:
+            grads, ce, aux, accuracy = _lm_loss_and_grads(
+                gstate, tokens, targets, shard_rng, positions=positions,
+                ce_chunk=ce_chunk, accuracy_metric=accuracy_metric,
+                logits_dtype=logits_dtype, ce_save_probs=ce_save_probs)
+    metric_axes = _GRAD_AXES
+    if tp_overlap:
+        from distributed_training_tpu.parallel.collective_matmul import (
+            overlap_finalize_grads,
+        )
+
+        grads = overlap_finalize_grads(grads)
+        metric_axes = _GRAD_AXES + (AXIS_MODEL,)
     grads = lax.pmean(grads, _GRAD_AXES)
     grads = gstate.loss_scale.unscale_grads(grads)
-    ce = lax.pmean(ce, _GRAD_AXES)
-    aux = lax.pmean(aux, _GRAD_AXES)
+    ce = lax.pmean(ce, metric_axes)
+    aux = lax.pmean(aux, metric_axes)
     if accuracy is not None:
-        accuracy = lax.pmean(accuracy, _GRAD_AXES)
+        accuracy = lax.pmean(accuracy, metric_axes)
     return grads, (ce, aux, accuracy)
 
 
@@ -413,6 +460,7 @@ def make_lm_train_step(
     grad_accum_steps: int = 1, zero_stage: int = 0,
     accuracy_metric: bool = True, cpu_offload: bool = False,
     logits_dtype=None, ce_save_probs: bool = False,
+    tp_overlap: bool = False,
 ) -> Callable:
     """Build the (data × sequence)-parallel jitted LM train step.
 
@@ -448,13 +496,53 @@ def make_lm_train_step(
     inside each sequence shard, GSPMD inserts the row-parallel psums over
     ``model`` while the ring hops K/V blocks over ``sequence`` (TP shards
     heads, SP shards positions; the two are orthogonal dims of attention).
+
+    ``tp_overlap=True`` selects the ring-overlapped megatron schedule
+    instead: the shard_map goes FULL-manual (model included), params enter
+    as rule-table shards, and the per-layer TP collectives become
+    ``collective_matmul`` ppermute rings overlapped with the partial
+    matmuls (see ``parallel/collective_matmul.py``). Composes with ZeRO
+    stages (the commit still runs in GSPMD-land), gradient accumulation,
+    and a sequence axis (the K/V ring over ``sequence`` and the matmul
+    rings over ``model`` rotate orthogonally). MoE models are refused —
+    expert dispatch needs the GSPMD expert axis the manual region unbinds.
     """
+    from distributed_training_tpu.parallel.collective_matmul import (
+        overlap_param_specs,
+    )
     from distributed_training_tpu.parallel.tensor_parallel import (
         tp_state_shardings,
     )
 
     if (model is None) == (max_len is None):
         raise ValueError("pass exactly one of model= or max_len=")
+    mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp_size = mesh_shape.get(AXIS_MODEL, 1)
+    sp_size = mesh_shape.get(AXIS_SEQUENCE, 1)
+    if tp_overlap:
+        if model is None:
+            raise ValueError(
+                "tp_overlap needs model= (the overlap schedule derives its "
+                "head/mlp shard shapes from the model config)")
+        experts = model.moe_num_experts
+        moe_on = (any(int(e) > 0 for e in experts)
+                  if isinstance(experts, (tuple, list))
+                  else int(experts) > 0)
+        if moe_on:
+            raise NotImplementedError(
+                "tp_overlap does not compose with MoE models: expert "
+                "dispatch relies on GSPMD's expert axis, which the "
+                "full-manual overlap region unbinds — run MoE with the "
+                "declarative TP schedule (tp_overlap=False)")
+        if mesh_shape.get("expert", 1) > 1:
+            raise NotImplementedError(
+                "tp_overlap does not compose with an expert mesh axis")
+        for what, dim in (("num_heads", model.num_heads),
+                          ("mlp dim", model.hidden_dim * model.mlp_ratio)):
+            if dim % tp_size:
+                raise ValueError(
+                    f"tp_overlap: tensor-parallel size {tp_size} must "
+                    f"divide {what} (= {dim})")
     if logits_dtype is None:
         if model is None and ce_chunk:
             # The chunked CE re-applies the head OUTSIDE the model, so it
@@ -469,7 +557,10 @@ def make_lm_train_step(
     if model is not None:
         max_len = model.max_len
     batch_spec = SP_BATCH_SPEC
-    axis_names = _sp_axis_names(mesh)
+    # Overlap runs FULL-manual (the model-axis collectives are hand-written
+    # rings, and full-manual works on every jax with shard_map at all);
+    # otherwise partial-manual keeps `model`/`expert` automatic for GSPMD.
+    axis_names = None if tp_overlap else _sp_axis_names(mesh)
 
     if grad_accum_steps < 1:
         raise ValueError(
@@ -478,7 +569,8 @@ def make_lm_train_step(
 
     def state_shardings_fn(state: TrainState):
         return tp_state_shardings(state, mesh, zero_stage=zero_stage,
-                                  cpu_offload=cpu_offload)
+                                  cpu_offload=cpu_offload,
+                                  overlap=tp_overlap)
 
     batch_sh = {k: NamedSharding(mesh, s) for k, s in batch_spec.items()}
 
@@ -492,22 +584,41 @@ def make_lm_train_step(
             # the on-device copy only feeds the GSPMD commit below.
             state = fetch_offloaded_opt_state(state)
         gstate = state.replace(opt_state=None)
+        gstate_specs = jax.tree.map(lambda _: P(), gstate)
+        grads_specs = jax.tree.map(lambda _: P(), state.params)
+        if tp_overlap:
+            gstate_specs = gstate_specs.replace(
+                params=overlap_param_specs(state.params))
+            grads_specs = overlap_param_specs(state.params)
         sharded = shard_map(
             functools.partial(_lm_grads_body, ce_chunk=ce_chunk,
                               accum=grad_accum_steps,
                               accuracy_metric=accuracy_metric,
                               logits_dtype=logits_dtype,
-                              ce_save_probs=ce_save_probs), mesh,
-            in_specs=(jax.tree.map(lambda _: P(), gstate), batch_spec, P()),
-            out_specs=(jax.tree.map(lambda _: P(), state.params), P()),
+                              ce_save_probs=ce_save_probs,
+                              tp_overlap=tp_overlap), mesh,
+            in_specs=(gstate_specs, batch_spec, P()),
+            out_specs=(grads_specs, P()),
             axis_names=axis_names,
         )
         grads, (ce, aux, accuracy) = sharded(gstate, batch, rng)
         new_state, finite = commit_gradients(state, grads)
         return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
 
+    def extra_check(batch):
+        if not tp_overlap:
+            return
+        t_shard = batch["tokens"].shape[1] // sp_size
+        if t_shard % tp_size:
+            raise ValueError(
+                f"tp_overlap: the per-sequence-shard length (= {t_shard}) "
+                f"must divide by the model-axis size {tp_size} (the overlap "
+                f"schedule time-shards activations over `model`); pick a "
+                f"divisible seq_len or disable tp_overlap")
+
     return _lazy_jit_step(mesh, state_shardings_fn, body,
-                          batch_sh=batch_sh, max_len=max_len, donate=donate)
+                          batch_sh=batch_sh, max_len=max_len, donate=donate,
+                          extra_check=extra_check)
 
 
 def _check_ce_options(ce_chunk, ce_save_probs, logits_dtype=jnp.float32):
@@ -546,11 +657,14 @@ def _lazy_jit_step(
     batch_sh: dict,
     max_len: int | None,
     donate: bool,
+    extra_check: Callable | None = None,
 ) -> Callable:
     """Shared step scaffold for every LM step builder: global-length guard,
     lazy jit with explicit in/out placements once a concrete state's pytree
     is known, and the ``.state_shardings`` / ``.batch_shardings``
-    attributes for placing host-built states and batches."""
+    attributes for placing host-built states and batches. ``extra_check``
+    runs on every (eager) batch beside the length guard — e.g. the
+    tp_overlap time-divisibility refusal."""
     jitted = None  # built lazily: shardings need a concrete state's pytree
 
     def ensure_jitted(state: TrainState):
@@ -569,6 +683,8 @@ def _lazy_jit_step(
             raise ValueError(
                 f"global sequence length {batch['tokens'].shape[1]} exceeds "
                 f"the positional table max_len={max_len}")
+        if extra_check is not None:
+            extra_check(batch)
 
     def step(state: TrainState, batch, rng):
         check_len(batch)
@@ -589,6 +705,7 @@ def _lazy_jit_step(
 
 def make_lm_eval_fn(
     mesh: Mesh, *, model, ce_chunk: int | None = None,
+    tp_overlap: bool = False,
 ) -> Callable:
     """Sharded eval forward for the sequence strategy: ``eval_fn(params,
     batch) -> mean token CE`` over a (data × sequence)-sharded batch.
@@ -601,8 +718,14 @@ def make_lm_eval_fn(
     alternative unsharded twin would need the full [T, T] attention on one
     device. ``ce_chunk`` composes exactly as in training (the logits tensor
     never materializes).
+
+    ``tp_overlap=True`` (the overlap trainer's SP×TP eval) goes
+    FULL-manual with params replicated over ``model``: each model rank
+    duplicates the eval forward — eval is a tiny fraction of a run, and
+    this keeps the ring-attention eval working on jax versions without
+    partial-manual shard_map.
     """
-    axis_names = _sp_axis_names(mesh)
+    axis_names = None if tp_overlap else _sp_axis_names(mesh)
     batch_spec = SP_BATCH_SPEC
 
     def body(params, batch):
@@ -703,7 +826,7 @@ def make_tp_lm_train_step(
     mesh: Mesh, *, model, zero_stage: int = 0, donate: bool = True,
     grad_accum_steps: int = 1, ce_chunk: int | None = None,
     accuracy_metric: bool = True, cpu_offload: bool = False,
-    ce_save_probs: bool = False,
+    ce_save_probs: bool = False, tp_overlap: bool = False,
 ) -> Callable:
     """Tensor-parallel (megatron-style) LM train step via GSPMD placement.
 
@@ -723,6 +846,16 @@ def make_tp_lm_train_step(
     path, but the GSPMD step runs under plain ``jit``, where no ring axis is
     bound).
 
+    ``tp_overlap=True`` swaps the declarative schedule for the
+    ring-overlapped collective matmul (``parallel/collective_matmul.py``):
+    the step is rebuilt on the shard_map scaffold of
+    :func:`make_lm_train_step` with the model axis manual, so the per-layer
+    all-gather/reduce-scatter become ppermute rings overlapped with the
+    partial matmuls. Same params, same optimizer state, same ZeRO
+    composition; only vocab/class-parallel params (lm_head, tok_embed)
+    stay replicated over ``model`` (their softmax-CE psum is not part of
+    the overlapped layer schedule).
+
     Returns ``step(state, batch, rng) -> (state, metrics)`` plus a
     ``.state_shardings(state)`` attribute for placing a host-built state.
     """
@@ -734,6 +867,12 @@ def make_tp_lm_train_step(
         raise ValueError(
             "TP step runs under plain jit; build the model with "
             "seq_axis=None (ring attention needs the shard_map step)")
+    if tp_overlap:
+        return make_lm_train_step(
+            mesh, model=model, donate=donate, ce_chunk=ce_chunk,
+            grad_accum_steps=grad_accum_steps, zero_stage=zero_stage,
+            accuracy_metric=accuracy_metric, cpu_offload=cpu_offload,
+            ce_save_probs=ce_save_probs, tp_overlap=True)
     return _make_gspmd_lm_step(
         mesh,
         lambda state: tp_state_shardings(state, mesh, zero_stage=zero_stage,

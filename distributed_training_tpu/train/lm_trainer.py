@@ -71,6 +71,35 @@ from distributed_training_tpu.utils.metrics_io import MetricsWriter
 from distributed_training_tpu.utils.profiling import WallClock, trace
 
 
+def restore_lm_checkpoint(directory: str, epoch: int, state, layout=None):
+    """``checkpoint.restore_checkpoint`` with actionable LM diagnostics.
+
+    The most common pytree-structure mismatch after round 5 is the
+    head-bias default flip: pre-round-5 checkpoints carry an ``lm_head``
+    bias the new bias-less template lacks, and orbax surfaces that as a raw
+    tree-structure error. Name the flag (mirroring
+    ``gpt/jax_tpu/generate.py``'s handler) instead of leaving the user to
+    decode the pytree diff.
+    """
+    try:
+        return ckpt_lib.restore_checkpoint(
+            directory, epoch, state, layout=layout)
+    except FileNotFoundError:
+        raise  # missing checkpoint: not a model-tree problem
+    except Exception as e:
+        if isinstance(e, ValueError) and "PERMUTED" in str(e):
+            raise  # the layout guard's own refusal is already actionable
+        raise ValueError(
+            f"checkpoint restore failed — if the original error below is a "
+            f"tree-structure mismatch, the configured model must mirror "
+            f"the training run's. Most likely: this build defaults to NO "
+            f"lm_head bias (round 5); set lm.head_bias=True (--head-bias "
+            f"on the CLI) to resume checkpoints trained before that, or "
+            f"check num_layers/hidden_dim/vocab/MoE flags. (An I/O or "
+            f"deserialization error instead means the checkpoint itself is "
+            f"damaged.) Original error: {e}") from e
+
+
 class LMTrainer:
     """Epoch-loop engine for :class:`TransformerLM` on a device mesh."""
 
@@ -96,6 +125,16 @@ class LMTrainer:
         # ``model`` automatic), so megatron TP shardings propagate inside
         # the shards and GSPMD inserts the row-parallel psums there.
         self.tp_size = model_par
+        if cfg.tp_overlap and self.strategy == "pipeline":
+            raise NotImplementedError(
+                "tp_overlap does not compose with the pipeline strategy "
+                "(the stacked-stage scan keeps `model` automatic for "
+                "GSPMD); use the tensor/dp or sequence strategy")
+        if cfg.tp_overlap and cfg.moe.enabled:
+            raise NotImplementedError(
+                "tp_overlap does not compose with MoE (expert dispatch "
+                "relies on GSPMD's expert axis, which the full-manual "
+                "overlap region unbinds)")
         if self.strategy == "pipeline" and cfg.zero.stage >= 3:
             # Stages 1/2 compose since round 4 (make_pp_lm_train_step
             # shards the optimizer state over data on dims the pipe/TP
@@ -170,8 +209,13 @@ class LMTrainer:
             # ring strategy's shard-local chunked CE, but the FULL seq_len
             # for the pipeline path (its chunked CE runs under GSPMD over
             # the global time axis, even with a sequence mesh axis).
+            # tp_overlap additionally time-shards the loss over the model
+            # axis (both the ring and tensor/dp strategies route through
+            # the full-manual overlap body).
             t_loss = (lm.seq_len // seq
                       if self.strategy == "sequence" else lm.seq_len)
+            if cfg.tp_overlap and self.strategy != "pipeline":
+                t_loss //= model_par
             if t_loss % lm.ce_chunk_size:
                 raise ValueError(
                     f"ce_chunk_size {lm.ce_chunk_size} must divide the "
@@ -199,13 +243,23 @@ class LMTrainer:
             # The megatron rule table shards heads / mlp columns / vocab over
             # the model axis; device_put fails opaquely on non-divisible
             # dims, so check here where the message can name the knob.
-            for what, n in (("num_heads", lm.num_heads),
-                            ("vocab_size", lm.vocab_size),
-                            ("mlp dim", lm.hidden_dim * lm.mlp_ratio)):
+            # tp_overlap keeps vocab params replicated (no vocab constraint)
+            # but time-shards activations over `model` instead.
+            checks = [("num_heads", lm.num_heads),
+                      ("mlp dim", lm.hidden_dim * lm.mlp_ratio)]
+            if not cfg.tp_overlap:
+                checks.append(("vocab_size", lm.vocab_size))
+            for what, n in checks:
                 if n % model_par:
                     raise ValueError(
                         f"tensor parallelism size {model_par} must divide "
                         f"{what} (= {n})")
+            if cfg.tp_overlap and (lm.seq_len // seq) % model_par:
+                raise ValueError(
+                    f"tp_overlap time-shards activations over the model "
+                    f"axis: the per-sequence-shard length "
+                    f"(= {lm.seq_len // seq}) must divide by the "
+                    f"tensor-parallel size {model_par}")
         policy = Policy.from_config(cfg.precision)
         moe_kwargs = {}
         if cfg.moe.enabled:
@@ -287,7 +341,8 @@ class LMTrainer:
                 grad_accum_steps=self.grad_accum, zero_stage=cfg.zero.stage,
                 accuracy_metric=lm.metrics_accuracy,
                 cpu_offload=cfg.zero.cpu_offload,
-                ce_save_probs=lm.ce_save_probs)
+                ce_save_probs=lm.ce_save_probs,
+                tp_overlap=cfg.tp_overlap and model_par > 1)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -303,7 +358,8 @@ class LMTrainer:
                 ce_chunk=lm.ce_chunk_size,
                 accuracy_metric=lm.metrics_accuracy,
                 cpu_offload=cfg.zero.cpu_offload,
-                ce_save_probs=lm.ce_save_probs)
+                ce_save_probs=lm.ce_save_probs,
+                tp_overlap=cfg.tp_overlap and model_par > 1)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -322,7 +378,8 @@ class LMTrainer:
             from distributed_training_tpu.train.lm_step import make_lm_eval_fn
 
             self._eval_fn = make_lm_eval_fn(
-                self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size)
+                self.mesh, model=self.model, ce_chunk=lm.ce_chunk_size,
+                tp_overlap=cfg.tp_overlap and self.tp_size > 1)
         else:
             eval_apply = self.state.apply_fn
 
@@ -363,7 +420,8 @@ class LMTrainer:
         self._global_step = 0
         self._epoch_step = 0
         strategy_label = self.strategy + (
-            "×tp" if self.tp_size > 1 and self.strategy != "tensor/dp" else "")
+            "×tp" if self.tp_size > 1 and self.strategy != "tensor/dp" else ""
+        ) + ("(tp-overlap)" if cfg.tp_overlap and self.tp_size > 1 else "")
         self.coord.print(
             f"[lm_trainer] params={param_count(state.params):,} "
             f"mesh={shape} strategy={strategy_label} "
@@ -522,7 +580,7 @@ class LMTrainer:
         start_step = 0
         resume = ckpt_lib.resolve_resume(cfg.checkpoint)
         if resume >= 0:
-            self.state, start_epoch, start_step = ckpt_lib.restore_checkpoint(
+            self.state, start_epoch, start_step = restore_lm_checkpoint(
                 cfg.checkpoint.directory, resume, self.state,
                 layout=self._ckpt_layout())
             self.state = place_state(self.state, self.shardings)

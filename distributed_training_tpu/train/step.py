@@ -267,6 +267,7 @@ def make_train_step(
     input_affine: tuple | None = None,
     cpu_offload: bool = False,
     tensor_parallel: bool = False,
+    tp_overlap: bool = False,
 ) -> Callable:
     """Build the GSPMD jitted train step for a mesh + ZeRO stage.
 
@@ -279,9 +280,26 @@ def make_train_step(
     fwd/bwd and applies ONE optimizer update on the averaged gradient —
     DeepSpeed's ``gradient_accumulation_steps`` semantics, but as a single
     XLA program instead of engine-level micro-steps.
+
+    ``tp_overlap=True`` (requires ``tensor_parallel``) swaps the
+    declarative megatron schedule for the ring-overlapped collective
+    matmul: the step becomes a full-manual shard_map whose row-parallel
+    reductions are ppermute rings fused with the chunk matmuls
+    (``parallel/collective_matmul.py``, replicated-activation layout — the
+    one layout whose token count needn't divide by the TP size, which ViT's
+    patches+cls rarely does).
     """
     if grad_accum_steps < 1:
         raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+    if tp_overlap:
+        if not tensor_parallel:
+            raise ValueError("tp_overlap requires tensor_parallel=True "
+                             "(it reschedules the megatron collectives)")
+        return _make_overlap_tp_train_step(
+            mesh, zero_stage=zero_stage, donate=donate,
+            grad_accum_steps=grad_accum_steps,
+            label_smoothing=label_smoothing, input_affine=input_affine,
+            cpu_offload=cpu_offload)
     cache: dict[Any, Callable] = {}
 
     def ensure_jitted(state: TrainState, batch):
@@ -324,6 +342,143 @@ def make_train_step(
         return ensure_jitted(state, batch)(state, batch, rng)
 
     # AOT hook for collective accounting (utils/hlo.py).
+    step.lower = lambda state, batch, rng: ensure_jitted(state, batch).lower(
+        state, batch, rng)
+    return step
+
+
+def _overlap_tp_grads_body(gstate: TrainState, batch, rng, *,
+                           accum_steps: int, label_smoothing: float,
+                           input_affine):
+    """Full-manual grads body for the ring-overlapped image TP step.
+
+    Runs the model under :func:`~distributed_training_tpu.parallel.
+    collective_matmul.replicated_overlap_interceptor`: activations stay
+    replicated over ``model`` (ViT's patches+cls token needn't divide by
+    the TP size) and each row-parallel psum becomes a cols-mode
+    matmul-reduce-scatter ring + ppermute all-gather. The rng folds per
+    data/fsdp rank (decorrelated dropout across replicas, as the LM body
+    does) but stays IDENTICAL across model ranks on purpose: the rings'
+    partial-sum algebra assumes the replicated activations match, which
+    diverged per-rank masks would desync.
+    """
+    import flax.linen as nn
+
+    from distributed_training_tpu.parallel.collective_matmul import (
+        overlap_finalize_grads,
+        replicated_overlap_interceptor,
+    )
+    from distributed_training_tpu.runtime.mesh import AXIS_FSDP, AXIS_MODEL
+    from distributed_training_tpu.utils.compat import axis_size
+
+    rng = jax.random.fold_in(
+        rng, jax.lax.axis_index(AXIS_DATA) * axis_size(AXIS_FSDP)
+        + jax.lax.axis_index(AXIS_FSDP))
+    with nn.intercept_methods(replicated_overlap_interceptor(AXIS_MODEL)):
+        if accum_steps > 1:
+            grads, loss, accuracy, _ = _accum_grads_and_stats(
+                gstate, batch, rng, accum_steps, None, label_smoothing,
+                input_affine)
+        else:
+            def loss_fn(params):
+                loss, logits, new_bs = _forward_and_loss(
+                    gstate, params, batch, rng, train=True,
+                    label_smoothing=label_smoothing,
+                    input_affine=input_affine)
+                return gstate.loss_scale.scale_loss(loss), (loss, logits)
+
+            grads, (loss, logits) = jax.grad(
+                loss_fn, has_aux=True)(gstate.params)
+            accuracy = jnp.mean(
+                (jnp.argmax(logits, -1) == batch["label"]).astype(
+                    jnp.float32))
+
+    # Per-leaf completion: the one shared copy of the /tp-vs-pmean
+    # gradient algebra (see collective_matmul.overlap_finalize_grads).
+    grads = overlap_finalize_grads(grads)
+    data_axes = (AXIS_DATA, AXIS_FSDP)
+    grads = jax.lax.pmean(grads, data_axes)
+    grads = gstate.loss_scale.unscale_grads(grads)
+    loss = jax.lax.pmean(loss, data_axes + (AXIS_MODEL,))
+    accuracy = jax.lax.pmean(accuracy, data_axes + (AXIS_MODEL,))
+    return grads, (loss, accuracy)
+
+
+def _make_overlap_tp_train_step(
+    mesh: Mesh, *, zero_stage: int, donate: bool, grad_accum_steps: int,
+    label_smoothing: float, input_affine: tuple | None, cpu_offload: bool,
+) -> Callable:
+    """Ring-overlapped TP image step (see :func:`make_train_step`).
+
+    Mirrors the LM overlap scaffold: the full-manual shard_map computes
+    grads + metrics only (params enter as rule-table shards; the optimizer
+    state never enters the manual region), and ``commit_gradients`` runs
+    under plain GSPMD where the ZeRO placements propagate.
+    """
+    from distributed_training_tpu.parallel.collective_matmul import (
+        overlap_param_specs as param_specs,
+    )
+    from distributed_training_tpu.parallel.tensor_parallel import (
+        tp_state_shardings,
+    )
+
+    cache: dict[Any, Callable] = {}
+
+    def ensure_jitted(state: TrainState, batch):
+        treedef = jax.tree.structure((state, batch))
+        fn = cache.get(treedef)
+        if fn is not None:
+            return fn
+        if jax.tree.leaves(state.batch_stats):
+            raise NotImplementedError(
+                "tp_overlap image step supports BatchNorm-free models only "
+                "(ViT); BN statistics under a manual model axis are not "
+                "wired — use the declarative TP schedule")
+        sshard = tp_state_shardings(state, mesh, zero_stage,
+                                    cpu_offload=cpu_offload, overlap=True)
+        bshard = {
+            "image": batch_sharding(mesh, batch["image"].ndim),
+            "label": batch_sharding(mesh, batch["label"].ndim),
+        }
+        bspec = {k: v.spec for k, v in bshard.items()}
+
+        def stepfn(state: TrainState, batch, rng):
+            if cpu_offload:
+                state = fetch_offloaded_opt_state(state)
+            gstate = state.replace(opt_state=None)
+            gspecs = jax.tree.map(lambda _: P(), gstate).replace(
+                params=param_specs(state.params))
+            sharded = shard_map(
+                functools.partial(
+                    _overlap_tp_grads_body, accum_steps=grad_accum_steps,
+                    label_smoothing=label_smoothing,
+                    input_affine=input_affine),
+                mesh,
+                in_specs=(gspecs, bspec, P()),
+                out_specs=(param_specs(state.params), P()),
+            )
+            grads, (loss, accuracy) = sharded(gstate, batch, rng)
+            new_state, finite = commit_gradients(state, grads)
+            metrics = {
+                "loss": loss.astype(jnp.float32),
+                "accuracy": accuracy,
+                "loss_scale": new_state.loss_scale.scale,
+                "grads_finite": finite.astype(jnp.float32),
+            }
+            return new_state, metrics
+
+        fn = jax.jit(
+            stepfn,
+            in_shardings=(sshard, bshard, replicated(mesh)),
+            out_shardings=(sshard, replicated(mesh)),
+            donate_argnums=(0,) if donate else (),
+        )
+        cache[treedef] = fn
+        return fn
+
+    def step(state: TrainState, batch, rng):
+        return ensure_jitted(state, batch)(state, batch, rng)
+
     step.lower = lambda state, batch, rng: ensure_jitted(state, batch).lower(
         state, batch, rng)
     return step

@@ -124,16 +124,27 @@ class Trainer:
                     f"table); {cfg.model!r} would replicate over it")
             # device_put fails opaquely on non-divisible dims; check here
             # where the message can name the knob (mirrors lm_trainer).
-            for what, n in (("num_heads", self.model.num_heads),
-                            ("mlp_dim", self.model.mlp_dim),
-                            ("num_classes", cfg.data.num_classes)):
+            # tp_overlap keeps the class head replicated (no num_classes
+            # constraint) but ring-scatters the row-parallel outputs over
+            # the hidden dim, which must divide instead.
+            checks = [("num_heads", self.model.num_heads),
+                      ("mlp_dim", self.model.mlp_dim)]
+            checks.append(("hidden_size", self.model.hidden_size)
+                          if cfg.tp_overlap
+                          else ("num_classes", cfg.data.num_classes))
+            for what, n in checks:
                 if n % self.tp_size:
                     raise ValueError(
                         f"tensor parallelism size {self.tp_size} must "
                         f"divide {what} (= {n})")
+            import functools
+
             from distributed_training_tpu.parallel.tensor_parallel import (
-                tp_state_shardings as shardings_fn,
+                tp_state_shardings,
             )
+
+            shardings_fn = functools.partial(tp_state_shardings,
+                                             overlap=cfg.tp_overlap)
         else:
             shardings_fn = state_shardings
         self.shardings = shardings_fn(state, self.mesh, cfg.zero.stage,
@@ -168,7 +179,8 @@ class Trainer:
                 label_smoothing=cfg.label_smoothing,
                 input_affine=input_affine,
                 cpu_offload=cfg.zero.cpu_offload,
-                tensor_parallel=self.tp_size > 1)
+                tensor_parallel=self.tp_size > 1,
+                tp_overlap=cfg.tp_overlap and self.tp_size > 1)
         else:
             if cfg.zero.stage != 0:
                 raise NotImplementedError(

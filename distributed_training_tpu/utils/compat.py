@@ -19,6 +19,19 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 
 
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis, across jax versions.
+
+    ``lax.axis_size`` only exists on newer jax; the classic spelling —
+    ``psum`` of the constant 1 over the axis, which constant-folds to the
+    (static) axis size — works everywhere a collective would.
+    """
+    lax = jax.lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def shard_map(fn, mesh, in_specs, out_specs, axis_names=None):
     """``shard_map`` without replication checking, across jax versions.
 

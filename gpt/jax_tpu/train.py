@@ -88,6 +88,13 @@ def add_argument() -> argparse.Namespace:
                         help="ZeRO stage (composes with --tp / pure DP)")
     parser.add_argument("--tp", type=int, default=1,
                         help="tensor-parallel (model axis) size")
+    parser.add_argument("--tp-overlap", action="store_true", default=False,
+                        help="ring-overlapped tensor parallelism: decompose "
+                             "the megatron layer collectives into ppermute "
+                             "rings fused with the partial matmuls "
+                             "(latency-hiding collective matmul; needs "
+                             "--tp > 1 to do anything, and seq_len/--sp "
+                             "divisible by --tp)")
     parser.add_argument("--pp", type=int, default=1,
                         help="pipeline-parallel (pipe axis) size")
     parser.add_argument("--sp", type=int, default=1,
@@ -143,6 +150,7 @@ def build_config(args: argparse.Namespace):
         num_epochs=args.epochs,
         gradient_accumulation_steps=args.gradient_accumulation_steps,
         remat=args.remat,
+        tp_overlap=args.tp_overlap,
         seed=args.seed,
         log_interval=args.log_interval,
         wall_clock_breakdown=args.wall_clock_breakdown,

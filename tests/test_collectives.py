@@ -101,8 +101,12 @@ def test_zero3_gathers_params_on_use():
 
 def test_ring_permutes_and_fused_grad_allreduce():
     """The sequence strategy's only collectives: K/V ppermutes in the ring
-    loop (2 per attention layer, fwd + transposed bwd) and ONE fused
-    all-reduce for the grad pmean."""
+    loop (2 per attention layer, fwd + transposed bwd) and the grad-pmean
+    all-reduce whose payload covers the fp32 gradients without ballooning.
+    (On TPU the combiner fuses the per-leaf reduces into ONE bucket — the
+    committed artifact pins count == 1; this backend's combiner may leave
+    them per-leaf, so the live assertion pins the payload, not the static
+    op count.)"""
     mesh = create_mesh(MeshConfig(data=4, sequence=2), devices=jax.devices())
     model = get_model("transformer_lm", num_classes=VOCAB,
                       seq_axis="sequence", num_layers=2, num_heads=2,
@@ -113,8 +117,10 @@ def test_ring_permutes_and_fused_grad_allreduce():
     acct = step_collectives(step, state, _lm_batch(step),
                             jax.random.PRNGKey(1))
     assert acct["collective-permute"]["count"] >= 2 * model.num_layers
-    assert acct["all-reduce"]["count"] == 1
-    assert acct["all-reduce"]["bytes"] >= 4 * param_count(state.params)
+    grad_bytes = 4 * param_count(state.params)
+    assert acct["all-reduce"]["bytes"] >= grad_bytes
+    assert acct["all-reduce"]["bytes"] < 2 * grad_bytes  # not ballooning
+    assert "all-gather" not in acct
 
 
 def test_sp_zero1_adds_param_allgather():
@@ -149,6 +155,36 @@ def test_tp_emits_per_block_psums():
     acct = step_collectives(step, state, _lm_batch(step),
                             jax.random.PRNGKey(1))
     assert acct["all-reduce"]["count"] >= 2 * model.num_layers
+
+
+def test_tp_overlap_swaps_psums_for_permute_chains():
+    """The ring-overlapped TP schedule's wire signature: the per-block
+    megatron collectives become collective-permute chains (≥ 4 rings per
+    block: qkv/out/fc1/fc2, forward + ring-overlapped backward), the
+    monolithic layer all-reduces shrink to the gradient pmean +
+    replicated-leaf completions, and NO reduce-scatter or extra all-gather
+    materializes in their place."""
+    mesh = create_mesh(MeshConfig(data=4, model=2), devices=jax.devices())
+    model = get_model("transformer_lm", num_classes=VOCAB, seq_axis=None,
+                      num_layers=2, num_heads=2, hidden_dim=16, max_len=64)
+
+    def acct_for(overlap):
+        step = make_tp_lm_train_step(mesh, model=model, donate=False,
+                                     tp_overlap=overlap)
+        state = _lm_state(model)
+        state = place_state(state, step.state_shardings(state))
+        return step_collectives(step, state, _lm_batch(step),
+                                jax.random.PRNGKey(1))
+
+    plain, overlap = acct_for(False), acct_for(True)
+    assert "collective-permute" not in plain
+    assert overlap["collective-permute"]["count"] >= 4 * model.num_layers
+    # The [B, T, D]-sized per-block psums are gone — only the grad pmean
+    # and the replicated-leaf completions remain as all-reduce payload.
+    assert overlap["all-reduce"]["bytes"] < plain["all-reduce"]["bytes"]
+    assert "reduce-scatter" not in overlap
+    assert (overlap.get("all-gather", {}).get("bytes", 0)
+            <= plain.get("all-gather", {}).get("bytes", 0))
 
 
 def test_pp_stage_hops_are_permutes():
@@ -187,7 +223,9 @@ def test_committed_artifact_covers_all_strategies():
                      "lm dp×sp (ring)", "lm dp×sp zero-1",
                      "lm dp×sp×tp", "lm dp×sp×ep",
                      "lm dp×pp×ep zero-1 (moe stages)",
-                     "lm dp×pp×sp zero-1 (ring-in-stage)"):
+                     "lm dp×pp×sp zero-1 (ring-in-stage)",
+                     "lm dp×tp overlap", "lm dp×sp×tp overlap",
+                     "image vit dp×tp overlap"):
         assert expected in strategies, expected
         assert strategies[expected]["collectives"], expected
         assert strategies[expected]["grad_bytes_fp32"] > 0
@@ -233,6 +271,22 @@ def test_committed_artifact_covers_all_strategies():
     vit = strategies["image vit dp×tp zero-1"]["collectives"]
     assert vit["all-reduce"]["count"] > 2
     assert "all-gather" in vit
+    # Ring-overlapped TP rows (round 6): collective-permute chains stand in
+    # for the monolithic layer collectives — at least one ring per
+    # projection per block per direction — with no reduce-scatter anywhere;
+    # the SP×TP composition adds the K/V ring's ppermutes on top of the
+    # matmul rings.
+    for row in ("lm dp×tp overlap", "lm dp×sp×tp overlap",
+                "image vit dp×tp overlap"):
+        ov = strategies[row]["collectives"]
+        assert ov["collective-permute"]["count"] >= 8, row
+        assert "reduce-scatter" not in ov, row
+    assert (strategies["lm dp×sp×tp overlap"]["collectives"]
+            ["collective-permute"]["count"]
+            > strategies["lm dp×tp overlap"]["collectives"]
+            ["collective-permute"]["count"])
+    assert "all-gather" not in strategies["image vit dp×tp overlap"][
+        "collectives"]
 
 
 def test_parser_handles_tuple_and_async_forms():

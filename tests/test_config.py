@@ -143,3 +143,32 @@ def test_warmup_lr_schedule_shape():
     assert float(sched(500)) == pytest.approx(5e-4)
     assert float(sched(1000)) == pytest.approx(1e-3)
     assert float(sched(5000)) == pytest.approx(1e-3)  # constant after warmup
+
+
+def test_logits_dtype_config_default_matches_clis(monkeypatch):
+    """ADVICE r5: LMConfig.logits_dtype defaulted to fp32 while every CLI
+    (gpt/jax_tpu/train.py, generate.py, bench.py) defaulted to bf16 — a
+    bare LMTrainer(TrainConfig(...)) run silently trained a different head
+    dtype than a bare CLI run. Pin config default == CLI default."""
+    import importlib.util
+    import os
+    import sys
+
+    from distributed_training_tpu.config import LMConfig
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+
+    def parser_default(relpath, attr="logits_dtype"):
+        spec = importlib.util.spec_from_file_location(
+            "cli_under_test_" + os.path.basename(relpath).replace(".", "_"),
+            os.path.join(root, relpath))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        monkeypatch.setattr(sys, "argv", [relpath])
+        if hasattr(mod, "build_parser"):
+            return getattr(mod.build_parser().parse_args([]), attr)
+        return getattr(mod.add_argument(), attr)
+
+    assert LMConfig().logits_dtype == "bf16"
+    assert parser_default("gpt/jax_tpu/train.py") == LMConfig().logits_dtype
+    assert parser_default("bench.py") == LMConfig().logits_dtype

@@ -155,3 +155,37 @@ def test_lm_trainer_circular_pipeline_zero1(tmp_path):
     assert "bias" not in trainer.state.params["lm_head"]
     result = trainer.fit()
     assert np.isfinite(result["final_perplexity"])
+
+
+def test_restore_head_bias_mismatch_names_the_knob(tmp_path):
+    """Resuming a pre-round-5 checkpoint (lm_head WITH bias) into today's
+    bias-less template must surface "set lm.head_bias=True", not a raw
+    pytree-structure error (mirrors gpt/jax_tpu/generate.py's handler)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import pytest
+
+    from distributed_training_tpu import checkpoint as ckpt_lib
+    from distributed_training_tpu.config import PrecisionConfig
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.train.lm_trainer import restore_lm_checkpoint
+    from distributed_training_tpu.train.precision import LossScaleState
+    from distributed_training_tpu.train.train_state import init_train_state
+
+    def state_for(head_bias):
+        model = get_model("transformer_lm", num_classes=16, num_layers=1,
+                          num_heads=2, hidden_dim=8, max_len=16,
+                          head_bias=head_bias)
+        return init_train_state(
+            model, jax.random.PRNGKey(0), (1, 8), optax.sgd(0.1),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+            input_dtype=jnp.int32)
+
+    ckpt_lib.save_checkpoint(str(tmp_path), 0, state_for(head_bias=True))
+    with pytest.raises(ValueError, match="head_bias"):
+        restore_lm_checkpoint(str(tmp_path), 0, state_for(head_bias=False))
+    # The matching tree still restores through the guarded path.
+    restored, _, _ = restore_lm_checkpoint(
+        str(tmp_path), 0, state_for(head_bias=True))
+    assert "bias" in restored.params["lm_head"]

@@ -72,14 +72,22 @@ def _lm_model(**kw):
     return get_model("transformer_lm", **base)
 
 
-def strategy_cases(devices):
+def strategy_cases(devices, only: str | None = None):
     """Yield (name, mesh_shape_note, collective accounting, grad_bytes).
 
     Each case mirrors one line of ``__graft_entry__.dryrun_multichip`` —
     the same factories, placements, and tiny shapes — accounted through
     the same ``utils/hlo.step_collectives`` path the tests assert against.
+
+    ``only`` (substring) skips non-matching cases BEFORE building them —
+    for regenerating a subset of rows into an existing artifact
+    (``--merge``), e.g. on a jax whose shard_map lacks the partial-manual
+    mode some compositions need.
     """
     n = len(devices)
+
+    def want(name: str) -> bool:
+        return only is None or only in name
     tokens = np.random.RandomState(0).randint(
         0, VOCAB, (n, 17)).astype(np.int32)
     host_batch = make_lm_batch(tokens)
@@ -105,6 +113,8 @@ def strategy_cases(devices):
             ("image dp (zero-0)", dict(data=-1), 0),
             ("image dp×fsdp zero-1", dict(data=-1, fsdp=2), 1),
             ("image dp zero-3", dict(data=-1), 3)):
+        if not want(name):
+            continue
         mesh = create_mesh(MeshConfig(**cfgkw), devices=devices)
         state = init_train_state(
             image_model, jax.random.PRNGKey(0), (n, 8, 8, 3), image_tx,
@@ -120,11 +130,23 @@ def strategy_cases(devices):
     # LM strategies.
     tp_mesh = create_mesh(MeshConfig(data=n // 2, model=2), devices=devices)
     model = _lm_model()
-    step = make_tp_lm_train_step(tp_mesh, model=model, zero_stage=1,
-                                 donate=False)
-    yield ("lm dp×tp zero-1",
-           dict(zip(tp_mesh.axis_names, tp_mesh.devices.shape)),
-           *lm_case(tp_mesh, step, _lm_state(model)))
+    if want("lm dp×tp zero-1"):
+        step = make_tp_lm_train_step(tp_mesh, model=model, zero_stage=1,
+                                     donate=False)
+        yield ("lm dp×tp zero-1",
+               dict(zip(tp_mesh.axis_names, tp_mesh.devices.shape)),
+               *lm_case(tp_mesh, step, _lm_state(model)))
+
+    # Ring-overlapped TP (latency-hiding collective matmul): the SAME
+    # model/state/placement, rescheduled — the per-block psums become
+    # collective-permute chains (tests/test_collectives.py pins the swap).
+    # Stage 0 keeps the signature clean of ZeRO's own all-gather.
+    if want("lm dp×tp overlap"):
+        step = make_tp_lm_train_step(tp_mesh, model=model, zero_stage=0,
+                                     donate=False, tp_overlap=True)
+        yield ("lm dp×tp overlap",
+               dict(zip(tp_mesh.axis_names, tp_mesh.devices.shape)),
+               *lm_case(tp_mesh, step, _lm_state(model)))
 
     pp_mesh = create_mesh(MeshConfig(data=n // 2, pipe=2), devices=devices)
 
@@ -144,70 +166,91 @@ def strategy_cases(devices):
     # opt-state all-gather over data beside the GPipe ppermute; circular
     # keeps the SAME static ppermute count (the ring wraps v× — more
     # trips, not more collectives in the compiled program).
-    yield pp_case("lm dp×pp (gpipe)", model)
-    yield pp_case("lm dp×pp zero-1", model, zero_stage=1)
-    yield pp_case("lm dp×pp circular (v=2)", _lm_model(num_layers=4),
-                  virtual_stages=2)
+    if want("lm dp×pp (gpipe)"):
+        yield pp_case("lm dp×pp (gpipe)", model)
+    if want("lm dp×pp zero-1"):
+        yield pp_case("lm dp×pp zero-1", model, zero_stage=1)
+    if want("lm dp×pp circular (v=2)"):
+        yield pp_case("lm dp×pp circular (v=2)", _lm_model(num_layers=4),
+                      virtual_stages=2)
 
-    ep_mesh = create_mesh(MeshConfig(data=n // 2, expert=2), devices=devices)
-    ep_model = _lm_model(moe_num_experts=4, moe_top_k=1,
-                         moe_expert_axis="expert")
-    step = make_tp_lm_train_step(ep_mesh, model=ep_model, donate=False)
-    yield ("lm dp×ep (moe)",
-           dict(zip(ep_mesh.axis_names, ep_mesh.devices.shape)),
-           *lm_case(ep_mesh, step, _lm_state(ep_model)))
+    if want("lm dp×ep (moe)"):
+        ep_mesh = create_mesh(MeshConfig(data=n // 2, expert=2),
+                              devices=devices)
+        ep_model = _lm_model(moe_num_experts=4, moe_top_k=1,
+                             moe_expert_axis="expert")
+        step = make_tp_lm_train_step(ep_mesh, model=ep_model, donate=False)
+        yield ("lm dp×ep (moe)",
+               dict(zip(ep_mesh.axis_names, ep_mesh.devices.shape)),
+               *lm_case(ep_mesh, step, _lm_state(ep_model)))
 
     # PP×EP (round 5): homogeneous MoE stages — the pipeline ppermutes
     # plus the expert-axis dispatch/combine collectives GSPMD inserts
     # inside each stage, plus the ZeRO-1 opt-state traffic over data.
-    ppe_mesh = create_mesh(MeshConfig(data=n // 4, pipe=2, expert=2),
-                           devices=devices)
-    ppe_model = _lm_model(moe_num_experts=4, moe_every=1, moe_top_k=1,
-                          moe_expert_axis="expert")
-    yield pp_case("lm dp×pp×ep zero-1 (moe stages)", ppe_model,
-                  mesh=ppe_mesh, zero_stage=1)
+    if want("lm dp×pp×ep zero-1 (moe stages)"):
+        ppe_mesh = create_mesh(MeshConfig(data=n // 4, pipe=2, expert=2),
+                               devices=devices)
+        ppe_model = _lm_model(moe_num_experts=4, moe_every=1, moe_top_k=1,
+                              moe_expert_axis="expert")
+        yield pp_case("lm dp×pp×ep zero-1 (moe stages)", ppe_model,
+                      mesh=ppe_mesh, zero_stage=1)
 
     # SP×PP (round 5): the pipeline's hop ppermutes PLUS the ring's K/V
     # ppermutes inside each tick — a GSPMD regression that materialized
     # K/V all-gathers instead of the ring would show here.
-    spp_mesh = create_mesh(MeshConfig(data=n // 4, pipe=2, sequence=2),
-                           devices=devices)
-    spp_model = _lm_model(seq_axis="sequence")
-    yield pp_case("lm dp×pp×sp zero-1 (ring-in-stage)", spp_model,
-                  mesh=spp_mesh, zero_stage=1)
+    if want("lm dp×pp×sp zero-1 (ring-in-stage)"):
+        spp_mesh = create_mesh(MeshConfig(data=n // 4, pipe=2, sequence=2),
+                               devices=devices)
+        spp_model = _lm_model(seq_axis="sequence")
+        yield pp_case("lm dp×pp×sp zero-1 (ring-in-stage)", spp_model,
+                      mesh=spp_mesh, zero_stage=1)
 
     # ViT×TP (round 4): megatron placement of the image transformer — the
     # per-block row-parallel psums appear exactly as in the LM TP case.
-    vit_model = get_model("vit_b16", num_classes=10, patch_size=4,
-                          hidden_size=32, num_layers=2, num_heads=2,
-                          mlp_dim=64)
-    vit_state = init_train_state(
-        vit_model, jax.random.PRNGKey(0), (n, 8, 8, 3), optax.adam(1e-3),
-        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+    # The overlap row reschedules the same placement through the
+    # replicated-activation collective matmul (cols-mode ring
+    # reduce-scatter + ppermute gather per row-parallel projection).
     from distributed_training_tpu.parallel.tensor_parallel import (
         tp_state_shardings,
     )
 
-    vit_state = place_state(vit_state,
-                            tp_state_shardings(vit_state, tp_mesh,
-                                               zero_stage=1))
-    vit_step = make_train_step(tp_mesh, zero_stage=1, donate=False,
-                               tensor_parallel=True)
     rngv = np.random.RandomState(0)
     vit_batch = {
         "image": rngv.rand(n, 8, 8, 3).astype(np.float32),
         "label": rngv.randint(0, 10, n).astype(np.int32),
     }
-    acct = step_collectives(vit_step, vit_state, vit_batch,
-                            jax.random.PRNGKey(1))
-    yield ("image vit dp×tp zero-1",
-           dict(zip(tp_mesh.axis_names, tp_mesh.devices.shape)),
-           acct, 4 * param_count(vit_state.params))
+
+    def vit_case(name, zero_stage, overlap):
+        vit_model = get_model("vit_b16", num_classes=10, patch_size=4,
+                              hidden_size=32, num_layers=2, num_heads=2,
+                              mlp_dim=64)
+        vit_state = init_train_state(
+            vit_model, jax.random.PRNGKey(0), (n, 8, 8, 3),
+            optax.adam(1e-3),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+        vit_state = place_state(
+            vit_state, tp_state_shardings(vit_state, tp_mesh,
+                                          zero_stage=zero_stage,
+                                          overlap=overlap))
+        vit_step = make_train_step(tp_mesh, zero_stage=zero_stage,
+                                   donate=False, tensor_parallel=True,
+                                   tp_overlap=overlap)
+        acct = step_collectives(vit_step, vit_state, vit_batch,
+                                jax.random.PRNGKey(1))
+        return (name, dict(zip(tp_mesh.axis_names, tp_mesh.devices.shape)),
+                acct, 4 * param_count(vit_state.params))
+
+    if want("image vit dp×tp zero-1"):
+        yield vit_case("image vit dp×tp zero-1", 1, False)
+    if want("image vit dp×tp overlap"):
+        yield vit_case("image vit dp×tp overlap", 0, True)
 
     sp_mesh = create_mesh(MeshConfig(data=n // 2, sequence=2),
                           devices=devices)
     sp_model = _lm_model(seq_axis="sequence")
     for name, stage in (("lm dp×sp (ring)", 0), ("lm dp×sp zero-1", 1)):
+        if not want(name):
+            continue
         step = make_lm_train_step(sp_mesh, model=sp_model, donate=False,
                                   zero_stage=stage)
         yield (name, dict(zip(sp_mesh.axis_names, sp_mesh.devices.shape)),
@@ -215,26 +258,52 @@ def strategy_cases(devices):
 
     sptp_mesh = create_mesh(MeshConfig(data=n // 4, sequence=2, model=2),
                             devices=devices)
-    step = make_lm_train_step(sptp_mesh, model=sp_model, donate=False)
-    yield ("lm dp×sp×tp",
-           dict(zip(sptp_mesh.axis_names, sptp_mesh.devices.shape)),
-           *lm_case(sptp_mesh, step, _lm_state(sp_model)))
+    if want("lm dp×sp×tp"):
+        step = make_lm_train_step(sptp_mesh, model=sp_model, donate=False)
+        yield ("lm dp×sp×tp",
+               dict(zip(sptp_mesh.axis_names, sptp_mesh.devices.shape)),
+               *lm_case(sptp_mesh, step, _lm_state(sp_model)))
 
-    spe_mesh = create_mesh(MeshConfig(data=n // 4, sequence=2, expert=2),
-                           devices=devices)
-    spe_model = _lm_model(seq_axis="sequence", moe_num_experts=4,
-                          moe_top_k=1, moe_expert_axis="expert")
-    step = make_lm_train_step(spe_mesh, model=spe_model, donate=False)
-    yield ("lm dp×sp×ep",
-           dict(zip(spe_mesh.axis_names, spe_mesh.devices.shape)),
-           *lm_case(spe_mesh, step, _lm_state(spe_model)))
+    # SP×TP overlap: the K/V ring over `sequence` AND the collective-matmul
+    # rings over `model` rotate orthogonally in one full-manual region.
+    if want("lm dp×sp×tp overlap"):
+        step = make_lm_train_step(sptp_mesh, model=sp_model, donate=False,
+                                  tp_overlap=True)
+        yield ("lm dp×sp×tp overlap",
+               dict(zip(sptp_mesh.axis_names, sptp_mesh.devices.shape)),
+               *lm_case(sptp_mesh, step, _lm_state(sp_model)))
+
+    if want("lm dp×sp×ep"):
+        spe_mesh = create_mesh(MeshConfig(data=n // 4, sequence=2, expert=2),
+                               devices=devices)
+        spe_model = _lm_model(seq_axis="sequence", moe_num_experts=4,
+                              moe_top_k=1, moe_expert_axis="expert")
+        step = make_lm_train_step(spe_mesh, model=spe_model, donate=False)
+        yield ("lm dp×sp×ep",
+               dict(zip(spe_mesh.axis_names, spe_mesh.devices.shape)),
+               *lm_case(spe_mesh, step, _lm_state(spe_model)))
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default="profiles/collectives_8dev")
     ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--only", default=None,
+                    help="rebuild only strategies whose name contains this "
+                         "substring (skips the others before building)")
+    ap.add_argument("--merge", action="store_true", default=False,
+                    help="start from the existing artifact and update only "
+                         "the regenerated rows (e.g. --only overlap on a "
+                         "jax whose shard_map lacks the partial-manual "
+                         "mode the SP×TP / PP×TP rows need)")
     args = ap.parse_args()
+    if args.only and not args.merge:
+        # --only writes to the SAME committed artifact by default; without
+        # --merge it would silently drop every non-matching row and break
+        # test_committed_artifact_covers_all_strategies.
+        print("--only implies --merge (a partial regeneration must not "
+              "drop the other committed rows)", file=sys.stderr)
+        args.merge = True
 
     devices = jax.devices()[:args.devices]
     assert len(devices) == args.devices, (
@@ -257,9 +326,19 @@ def main():
                   "dispatch contracts the data-sharded token dim, so the "
                   "partitioner emits a reduction, trading the GPU-style "
                   "a2a for MXU-shaped matmul + psum",
+                  "tp-overlap rows: the ring-overlapped collective matmul "
+                  "replaces the monolithic TP collectives with "
+                  "collective-permute chains (one static ppermute per ring "
+                  "loop body); the remaining all-reduces are the gradient "
+                  "pmean and the replicated-leaf completions",
               ],
               "strategies": {}}
-    for name, mesh_shape, acct, grad_bytes in strategy_cases(devices):
+    path = args.out + ".json"
+    if args.merge and os.path.exists(path):
+        with open(path) as fh:
+            report["strategies"] = json.load(fh)["strategies"]
+    for name, mesh_shape, acct, grad_bytes in strategy_cases(
+            devices, only=args.only):
         report["strategies"][name] = {
             "mesh": {k: v for k, v in mesh_shape.items() if v > 1},
             "grad_bytes_fp32": grad_bytes,
@@ -267,7 +346,6 @@ def main():
         }
         print(f"{name:28s} {acct}")
 
-    path = args.out + ".json"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w") as fh:
         json.dump(report, fh, indent=1, sort_keys=True)
