@@ -7,8 +7,14 @@ the axon tunnel) followed by the LM leg (GPT-2-small tokens/sec). Per-leg
 flags isolate one leg: ``--image``, ``--lm``, ``--data-only``,
 ``--data-concurrent``, ``--check``.
 
-    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
-    {"metric": ..., "value": N, "unit": "tokens/sec", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N,
+     "mfu": N, "model_flops_per_sec": N,
+     "step_time_p50_ms": N, "step_time_p95_ms": N}
+    {"metric": ..., "value": N, "unit": "tokens/sec", "vs_baseline": N, ...}
+
+The observability fields (round 6) are additive — BENCH_*.json consumers
+keep working; ``mfu`` is null when the chip's peak FLOPs are unknown
+(CPU fallback) unless ``$OBS_PEAK_FLOPS`` supplies one.
 
 Measures the steady-state jitted train step (fwd + bwd + Adam update, bf16
 compute) on device-resident synthetic ImageNet batches — the same compute
@@ -33,6 +39,52 @@ import numpy as np
 import optax
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 6000.0
+
+
+def observability_fields(step_flops: float | None, per_step_ms: list,
+                         n_devices: int, total_steps: int,
+                         total_seconds: float) -> dict:
+    """The additive observability fields both legs emit (round 6):
+    ``mfu`` + ``model_flops_per_sec`` from the analytic step FLOPs
+    (``observability/flops.py``; mfu is null when the chip's peak is
+    unknown — CPU fallback — unless $OBS_PEAK_FLOPS overrides), and
+    step-time p50/p95 over the per-sync-window averages (the sync fetches
+    are the honest execution barriers — see the barrier comment in
+    bench_image — so between-sync step times are window means, not
+    dispatch times)."""
+    from distributed_training_tpu.observability import (
+        device_peak_flops,
+        percentile,
+    )
+    from distributed_training_tpu.observability.flops import mfu as _mfu
+
+    out: dict = {"mfu": None}
+    if per_step_ms:
+        out["step_time_p50_ms"] = round(percentile(per_step_ms, 50), 3)
+        out["step_time_p95_ms"] = round(percentile(per_step_ms, 95), 3)
+    if step_flops and total_seconds > 0:
+        fps = step_flops * total_steps / total_seconds
+        out["model_flops_per_sec"] = round(fps, 1)
+        u = _mfu(fps, n_devices, device_peak_flops())
+        if u is not None:
+            out["mfu"] = round(u, 4)
+    return out
+
+
+class _WindowTimer:
+    """Per-sync-window step times: ``mark(k)`` after every host fetch
+    records the window's mean per-step ms over the k steps it covered."""
+
+    def __init__(self):
+        self._last = time.perf_counter()
+        self.per_step_ms: list[float] = []
+
+    def mark(self, steps_in_window: int) -> None:
+        now = time.perf_counter()
+        if steps_in_window > 0:
+            self.per_step_ms.append(
+                (now - self._last) / steps_in_window * 1e3)
+        self._last = now
 
 
 _PROBED_PLATFORM: list[str] = []
@@ -114,7 +166,9 @@ def build(model_name: str, batch_size: int, image_size: int, num_classes: int,
     step = make_train_step(mesh, zero_stage=zero_stage, donate=True,
                            grad_accum_steps=grad_accum,
                            cpu_offload=cpu_offload)
-    return mesh, state, step
+    # The model instance rides along so the MFU accounting reads dims off
+    # the architecture actually benched (observability.forward_flops).
+    return mesh, state, step, model
 
 
 def bench_data_only(args) -> None:
@@ -308,7 +362,7 @@ def bench_data_concurrent(args) -> None:
 
         n_chips = jax.device_count()
         batch = args.batch_size * n_chips
-        mesh, state, step = build(
+        mesh, state, step, _ = build(
             args.model, batch, args.image_size, 8,
             grad_accum=1)
         from distributed_training_tpu.parallel.sharding import batch_sharding
@@ -475,13 +529,28 @@ def bench_lm(args) -> None:
     if args.warmup:
         float(m["loss"])
     t0 = time.perf_counter()
+    wt = _WindowTimer()
+    win = 0
     for i in range(args.steps):
         state, m = step(state, batch, key)
+        win += steps_per_call
         if args.sync_interval > 0 and (i + 1) % args.sync_interval == 0:
             float(m["loss"])
+            wt.mark(win)
+            win = 0
     float(m["loss"])
+    wt.mark(win)
     dt = time.perf_counter() - t0
     tok_s = (args.lm_batch * args.seq_len * args.steps * steps_per_call) / dt
+    from distributed_training_tpu.observability import (
+        forward_flops,
+        train_step_flops,
+    )
+
+    # Dims read off the model instance built above — a hand-copied set
+    # here would silently drift if the bench config ever changes.
+    step_flops = train_step_flops(forward_flops(
+        model, seq_len=args.seq_len, batch=args.lm_batch))
     # vs_baseline compares against round 1's 94.6k tok/s, which was
     # measured at exactly B16 T1024 flash on TPU — any other config (or
     # the CPU fallback's clamped shapes) is incomparable.
@@ -512,6 +581,9 @@ def bench_lm(args) -> None:
         "unit": "tokens/sec",
         "vs_baseline": (round(tok_s / 94_600, 4)
                         if is_baseline_config else None),
+        **observability_fields(step_flops, wt.per_step_ms,
+                               jax.device_count(),
+                               args.steps * steps_per_call, dt),
     }
     print(json.dumps(result))
     return result, platform
@@ -679,7 +751,7 @@ def bench_image(args):
     n_chips = jax.device_count()
     global_batch = args.batch_size * n_chips
 
-    mesh, state, step = build(
+    mesh, state, step, model = build(
         args.model, global_batch, args.image_size, args.num_classes,
         zero_stage=args.zero_stage, remat=args.remat,
         remat_policy=args.remat_policy, param_dtype=args.param_dtype,
@@ -751,15 +823,30 @@ def bench_image(args):
         float(metrics["loss"])
 
     t0 = time.perf_counter()
+    wt = _WindowTimer()
+    win = 0
     for i in range(args.steps):
         state, metrics = step(state, batch, key)
+        win += steps_per_call
         if args.sync_interval > 0 and (i + 1) % args.sync_interval == 0:
             float(metrics["loss"])
+            wt.mark(win)
+            win = 0
     float(metrics["loss"])
+    wt.mark(win)
     dt = time.perf_counter() - t0
 
     images_per_sec = args.steps * steps_per_call * global_batch / dt
     per_chip = images_per_sec / n_chips
+    from distributed_training_tpu.observability import (
+        forward_flops,
+        train_step_flops,
+    )
+
+    # Instance dispatch covers resnet AND vit (None for models without a
+    # formula) and reads dims off the architecture actually benched.
+    step_flops = train_step_flops(forward_flops(
+        model, image_size=args.image_size, batch=global_batch))
     result = {
         "metric": f"{args.model} synthetic-ImageNet train throughput "
                   f"(bf16, batch {args.batch_size}/chip"
@@ -775,6 +862,8 @@ def bench_image(args):
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4),
+        **observability_fields(step_flops, wt.per_step_ms, n_chips,
+                               args.steps * steps_per_call, dt),
     }
     print(json.dumps(result))
     return result, platform

@@ -214,6 +214,67 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObservabilityConfig:
+    """Flight instruments (``observability/``): MFU accounting, the
+    flight recorder, device-memory telemetry, anomaly-triggered forensics.
+
+    Everything here respects the hot-loop contract of
+    ``utils/logging.py``: per-step cost is one host timestamp; every
+    other input is read at meter-flush boundaries from values the meter
+    already fetched. The reference has none of this surface (its only
+    observability is a per-step tqdm loss postfix, SURVEY.md §5).
+    """
+
+    # Ring buffer of per-step host timestamps + flushed metrics; dumps
+    # step-time p50/p95/max and goodput to JSON on demand / anomaly /
+    # crash (``tools/flight_report.py`` renders it).
+    flight_recorder: bool = True
+    ring_size: int = 1024
+    # Where anomaly/crash forensics land (flight JSON, offending batch
+    # npz, step HLO, profiler trace). None — the default — resolves to
+    # ``<checkpoint.directory>/flight`` in the trainers: forensics
+    # belong next to the run's durable artifacts, not in whatever cwd
+    # the process crashed from.
+    dump_dir: str | None = None
+    # Analytic model-FLOPs → ``mfu`` + ``model_flops_per_sec`` at every
+    # meter flush (models with a formula: ResNet/ViT/GPT; MoE reports
+    # none — routed FLOPs are runtime-dependent).
+    mfu: bool = True
+    # Override the per-chip peak FLOPs the MFU divides by (None → the
+    # device_kind table in observability/flops.py; unknown kinds, e.g.
+    # CPU, then omit mfu while keeping model_flops_per_sec).
+    peak_flops: float | None = None
+    # ``device.memory_stats()`` bytes-in-use / peak at flush boundaries
+    # (allocator counters — no device sync; absent on CPU).
+    memory_telemetry: bool = True
+    # Global L2 grad-norm as an on-device step metric (one extra fused
+    # reduction over the already-materialized grads; also what arms the
+    # anomaly detector's spike rule).
+    grad_norm: bool = False
+    # NaN/Inf-loss + grad-norm-spike detection over flushed metrics. On
+    # trigger (once per run): dump flight recorder, save batch + HLO,
+    # capture an ``anomaly_trace_steps``-step profiler trace, then skip
+    # or raise per ``anomaly_action``. A raise is deferred to the end of
+    # the trace window and fires on every host at the same step
+    # (detector inputs are replicated), so it cannot strand a multihost
+    # barrier.
+    anomaly_detection: bool = False
+    anomaly_action: str = "raise"  # raise | skip
+    anomaly_trace_steps: int = 3
+    grad_norm_spike_factor: float = 10.0
+
+    def __post_init__(self):
+        if self.anomaly_action not in ("raise", "skip"):
+            raise ValueError(
+                f"anomaly_action must be 'raise' or 'skip', got "
+                f"{self.anomaly_action!r}")
+        if self.anomaly_trace_steps < 0:
+            raise ValueError(
+                f"anomaly_trace_steps must be >= 0, got "
+                f"{self.anomaly_trace_steps}")
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Logical mesh axis sizes; -1 infers from device count."""
 
@@ -343,6 +404,10 @@ class TrainConfig:
     # Durable metric sinks (master-only, written at log_interval flushes).
     tensorboard_dir: str | None = None
     metrics_jsonl: str | None = None
+    # Flight instruments: MFU/goodput accounting, device-memory telemetry,
+    # anomaly-triggered trace capture (observability/).
+    observability: ObservabilityConfig = dataclasses.field(
+        default_factory=ObservabilityConfig)
 
     def replace(self, **kw: Any) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

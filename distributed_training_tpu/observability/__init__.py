@@ -1,0 +1,50 @@
+"""Observability subsystem: the trainer's flight instruments.
+
+The reference's only observability is a per-step tqdm loss postfix with
+DeepSpeed's ``wall_clock_breakdown`` shipped off (SURVEY.md §5). The seed
+grew that into async meters and TB/JSONL sinks; this package adds the
+hardware-utilization and forensics layer a production trainer needs:
+
+- :mod:`flops` — analytic per-step FLOPs for the model zoo (ResNet / ViT /
+  GPT), cross-checkable against XLA's AOT ``compiled.cost_analysis()``,
+  plus the per-chip peak-FLOPs table that turns a throughput into an MFU.
+- :mod:`flight_recorder` — a bounded ring buffer of per-step host
+  timestamps and flushed metrics: step-time p50/p95/max, goodput
+  (step vs data vs ckpt vs logging wall-time), dumpable to JSON on demand
+  or on crash.
+- :mod:`memory` — device-memory telemetry (``device.memory_stats()``
+  bytes-in-use / peak) sampled at meter-flush boundaries only, so it adds
+  no device syncs to the hot loop.
+- :mod:`anomaly` — NaN/Inf-loss and grad-norm-spike detection over the
+  flushed (already-on-host) metrics; on trigger the hooks dump the flight
+  recorder, capture an N-step ``jax.profiler`` trace, save the offending
+  batch + HLO, and then skip or raise per config.
+- :mod:`hooks` — :class:`TrainObservability`, the one object both
+  trainers (and bench) drive; it owns the no-new-syncs contract: every
+  input it reads is either a host timestamp or a value the meter already
+  fetched.
+"""
+
+from distributed_training_tpu.observability.anomaly import (  # noqa: F401
+    AnomalyDetector,
+    AnomalyError,
+)
+from distributed_training_tpu.observability.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    percentile,
+)
+from distributed_training_tpu.observability.flops import (  # noqa: F401
+    device_peak_flops,
+    forward_flops,
+    gpt_forward_flops,
+    resnet_forward_flops,
+    train_step_flops,
+    vit_forward_flops,
+    xla_cost_flops,
+)
+from distributed_training_tpu.observability.hooks import (  # noqa: F401
+    TrainObservability,
+)
+from distributed_training_tpu.observability.memory import (  # noqa: F401
+    device_memory_metrics,
+)

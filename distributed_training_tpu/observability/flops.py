@@ -1,0 +1,255 @@
+"""Analytic per-step FLOPs and MFU accounting.
+
+Model FLOPs utilization (MFU) is the one number that makes "runs as fast
+as the hardware allows" (ROADMAP.md) verifiable: achieved model FLOPs/sec
+over the chips' peak. The conventions here follow the PaLM appendix /
+Megatron accounting that every published MFU uses, so our numbers compare
+across papers:
+
+- **matmul FLOPs only** — a dot of ``[M, K] × [K, N]`` counts ``2·M·K·N``
+  (multiply + add). Elementwise work (LayerNorm, GELU, softmax, BN) is
+  excluded; XLA's ``cost_analysis()`` likewise books transcendentals
+  separately, which is what makes the cross-check in
+  ``tests/test_flops_accounting.py`` tight.
+- **backward = 2× forward** (each matmul differentiates into two), so a
+  train step is ``3× forward``. Rematerialization's recompute is NOT
+  charged: MFU counts *model* FLOPs, not schedule FLOPs — a remat run at
+  the same tokens/sec reports the same MFU (and genuinely did the same
+  useful work).
+- **attention is charged full-T²** (``4·B·T²·D`` per layer forward for
+  scores + values), the published convention even for causal models; the
+  exact-attention path really computes the full masked matrix, and flash
+  kernels that skip the upper triangle simply report a conservative MFU.
+- **accumulation-aware by construction**: callers pass the *effective*
+  batch (micro × accum × world) the compiled step consumes — the FLOPs of
+  one optimizer update, matching the step-time the meter measures.
+
+Embedding gathers are O(B·T·D) data movement, not matmuls, and are
+excluded (both here and by XLA's flops counter); the vocab-projection
+``lm_head`` IS a matmul and is charged.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any
+
+# Per-chip peak dense bf16 FLOPs/sec by jax ``device.device_kind``
+# (matched exactly, then by prefix). Public cloud numbers; fp32 peaks are
+# lower, but every throughput config this repo ships computes its matmuls
+# in bf16 on the MXU.
+PEAK_BF16_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # jax's device_kind for v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,        # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # Trillium / v6e
+    "TPU v6e": 918e12,
+}
+
+# Environment override (e.g. a CPU smoke run that still wants a numeric
+# MFU, or an unlisted accelerator): peak FLOPs/sec PER DEVICE.
+PEAK_FLOPS_ENV = "OBS_PEAK_FLOPS"
+
+
+def device_peak_flops(device=None) -> float | None:
+    """Peak dense bf16 FLOPs/sec of one device; None when unknown (CPU,
+    unlisted kinds). ``$OBS_PEAK_FLOPS`` overrides — the honest answer for
+    hardware the table doesn't know is "no MFU", not a guessed peak."""
+    env = os.environ.get(PEAK_FLOPS_ENV)
+    if env:
+        return float(env)
+    if device is None:
+        import jax
+
+        devs = jax.devices()
+        if not devs:
+            return None
+        device = devs[0]
+    kind = getattr(device, "device_kind", "") or ""
+    if kind in PEAK_BF16_FLOPS:
+        return PEAK_BF16_FLOPS[kind]
+    for name, peak in PEAK_BF16_FLOPS.items():
+        if kind.startswith(name):
+            return peak
+    return None
+
+
+def mfu(model_flops_per_sec: float, n_devices: int,
+        peak_per_device: float | None) -> float | None:
+    """``model_flops_per_sec / (n_devices × peak)``; None when peak is."""
+    if not peak_per_device or n_devices < 1:
+        return None
+    return model_flops_per_sec / (n_devices * peak_per_device)
+
+
+# -- forward-FLOPs formulas (matmul-only, multiply-add = 2) ----------------
+
+def gpt_forward_flops(*, num_layers: int, hidden_dim: int, seq_len: int,
+                      vocab_size: int, mlp_ratio: int = 4,
+                      batch: int = 1) -> float:
+    """Decoder-only transformer forward FLOPs (``models/gpt.py`` dims).
+
+    Per layer and token: QKV + out projections ``8·D²``, full-T² attention
+    scores + values ``4·T·D``, MLP ``4·r·D²``; plus the ``lm_head`` vocab
+    projection ``2·D·V`` per token. ``batch × seq_len`` = tokens consumed.
+    """
+    d, t = hidden_dim, seq_len
+    per_layer = 8 * t * d * d + 4 * t * t * d + 4 * mlp_ratio * t * d * d
+    return float(batch) * (num_layers * per_layer + 2 * t * d * vocab_size)
+
+
+def vit_forward_flops(*, image_size: int, patch_size: int, hidden_size: int,
+                      num_layers: int, mlp_dim: int, num_classes: int,
+                      batch: int = 1) -> float:
+    """ViT forward FLOPs (``models/vit.py``): patch-embed conv + encoder
+    blocks over ``(image/patch)² + 1`` tokens (cls token) + the head."""
+    n = (image_size // patch_size) ** 2
+    t = n + 1
+    d = hidden_size
+    fl = 2 * n * patch_size * patch_size * 3 * d           # patch embed
+    fl += num_layers * (8 * t * d * d + 4 * t * t * d      # attn
+                        + 4 * t * d * mlp_dim)             # mlp fc1+fc2
+    fl += 2 * d * num_classes                              # head (cls row)
+    return float(batch) * fl
+
+
+def resnet_forward_flops(name: str, *, image_size: int, num_classes: int,
+                         batch: int = 1, stem: str = "imagenet",
+                         num_filters: int | None = None) -> float:
+    """ResNet forward FLOPs, mirroring ``models/resnet.py`` exactly:
+    stem (7×7/2 + 3×3/2 maxpool, or CIFAR 3×3/1), per-stage blocks with
+    stride 2 at each stage>0 entry, 1×1 downsample convs where the
+    residual shape changes, and the dense head. SAME padding ⇒ spatial
+    dims ceil-divide by stride. BN/ReLU/pool are elementwise (excluded).
+    """
+    from distributed_training_tpu.models.resnet import (
+        BottleneckBlock,
+        STAGE_SIZES,
+    )
+
+    sizes, block_cls = STAGE_SIZES[name]
+    bottleneck = block_cls is BottleneckBlock
+    nf = num_filters if num_filters is not None else (
+        8 if name == "resnet_micro" else 64)
+
+    def conv(h_out: int, k: int, cin: int, cout: int) -> int:
+        return 2 * h_out * h_out * k * k * cin * cout
+
+    h = image_size
+    fl = 0
+    if stem == "imagenet":
+        h = math.ceil(h / 2)
+        fl += conv(h, 7, 3, nf)
+        h = math.ceil(h / 2)  # maxpool 3x3/2 SAME
+    elif stem == "cifar":
+        fl += conv(h, 3, 3, nf)
+    else:
+        raise ValueError(f"unknown stem {stem!r}")
+    cin = nf
+    for i, nblocks in enumerate(sizes):
+        f = nf * 2 ** i
+        out_ch = f * 4 if bottleneck else f
+        for j in range(nblocks):
+            stride = 2 if (i > 0 and j == 0) else 1
+            h_out = math.ceil(h / stride)
+            if bottleneck:
+                fl += conv(h, 1, cin, f)        # 1x1 at input resolution
+                fl += conv(h_out, 3, f, f)      # strided 3x3
+                fl += conv(h_out, 1, f, f * 4)
+            else:
+                fl += conv(h_out, 3, cin, f)    # strided 3x3
+                fl += conv(h_out, 3, f, f)
+            if stride != 1 or cin != out_ch:
+                fl += conv(h_out, 1, cin, out_ch)  # downsample projection
+            cin = out_ch
+            h = h_out
+    fl += 2 * cin * num_classes
+    return float(batch) * fl
+
+
+def forward_flops(model: Any, *, image_size: int | None = None,
+                  seq_len: int | None = None, batch: int = 1) -> float | None:
+    """Forward FLOPs of a model *instance* (the trainers' entry point).
+
+    Dispatches on the module's own attributes, so the numbers always match
+    the architecture actually built (a hand-copied dim here would silently
+    drift). Returns None for models without a formula (MoE: the routed
+    FLOPs depend on runtime capacity/top-k dispatch, and a wrong static
+    guess is worse than no MFU).
+    """
+    # TransformerLM: vocab_size + hidden_dim + mlp_ratio.
+    if hasattr(model, "vocab_size") and hasattr(model, "mlp_ratio"):
+        if getattr(model, "moe_num_experts", 0):
+            experts = model.moe_num_experts
+            moe_on = (any(int(e) > 0 for e in experts)
+                      if isinstance(experts, (tuple, list))
+                      else int(experts) > 0)
+            if moe_on:
+                return None
+        if seq_len is None:
+            raise ValueError("forward_flops for an LM needs seq_len=")
+        return gpt_forward_flops(
+            num_layers=model.num_layers, hidden_dim=model.hidden_dim,
+            seq_len=seq_len, vocab_size=model.vocab_size,
+            mlp_ratio=model.mlp_ratio, batch=batch)
+    if image_size is None:
+        raise ValueError("forward_flops for an image model needs image_size=")
+    # ViT: the full attribute set (MoEImageClassifier also carries
+    # patch_size/hidden_size but routes FLOPs at runtime — it must fall
+    # through to the no-formula None, not crash on a missing mlp_dim).
+    if all(hasattr(model, a) for a in
+           ("patch_size", "hidden_size", "mlp_dim", "num_layers")):
+        return vit_forward_flops(
+            image_size=image_size, patch_size=model.patch_size,
+            hidden_size=model.hidden_size, num_layers=model.num_layers,
+            mlp_dim=model.mlp_dim, num_classes=model.num_classes,
+            batch=batch)
+    # ResNet: stage_sizes + block_cls.
+    if hasattr(model, "stage_sizes") and hasattr(model, "block_cls"):
+        from distributed_training_tpu.models.resnet import STAGE_SIZES
+
+        sizes = tuple(model.stage_sizes)
+        name = next((n for n, (s, b) in STAGE_SIZES.items()
+                     if tuple(s) == sizes and b is model.block_cls), None)
+        if name is None:
+            return None
+        return resnet_forward_flops(
+            name, image_size=image_size, num_classes=model.num_classes,
+            batch=batch, stem=model.stem, num_filters=model.num_filters)
+    return None
+
+
+def train_step_flops(forward: float | None) -> float | None:
+    """Model FLOPs of one optimizer step: forward + backward = 3× forward
+    (backward differentiates each matmul into two). Remat recompute is
+    deliberately not charged — see the module docstring."""
+    return None if forward is None else 3.0 * forward
+
+
+# -- XLA cross-check --------------------------------------------------------
+
+def xla_cost_flops(fn, *args, **kwargs) -> float | None:
+    """FLOPs XLA books for ``jit(fn)(*args)`` via AOT ``cost_analysis()``.
+
+    The cross-check oracle for the analytic formulas above: lower + compile
+    without executing, then read the compiled program's flops estimate
+    (jax returns a per-device list on some versions, a bare dict on
+    others). None when the backend doesn't report a cost analysis.
+    """
+    import jax
+
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # pragma: no cover - backend without cost analysis
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict) or "flops" not in ca:
+        return None
+    return float(ca["flops"])

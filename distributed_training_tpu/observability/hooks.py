@@ -1,0 +1,261 @@
+"""TrainObservability: the one object the trainers (and bench) drive.
+
+Threads the four observability pieces — MFU accounting, the flight
+recorder, device-memory telemetry, anomaly detection — through a trainer
+loop with exactly two touch points:
+
+- :meth:`on_step` after every step *dispatch*: one ``perf_counter()``
+  ring write. No device interaction; the hot loop's no-sync contract
+  (``utils/logging.py``) is preserved by construction.
+- :meth:`on_flush` at every meter flush: computes MFU from the
+  flush-to-flush wall interval (flush boundaries are real host fetches,
+  so the interval brackets true device time), samples allocator memory
+  stats, feeds the recorder, and runs the anomaly detector over values
+  the meter already materialized.
+
+Anomaly trigger sequence (once per run): dump the flight recorder, save
+the offending batch (npz) and the step's HLO, start an N-step
+``jax.profiler`` trace, and then — after the trace window completes —
+skip or raise per ``anomaly_action``. The raise is DEFERRED to the end of
+the trace window so the trace actually captures anomalous steps; every
+host defers identically (detector inputs are replicated), so the raise
+cannot strand a multihost barrier.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable
+
+from distributed_training_tpu.observability.anomaly import (
+    AnomalyDetector,
+    AnomalyError,
+)
+from distributed_training_tpu.observability.flight_recorder import (
+    FlightRecorder,
+)
+from distributed_training_tpu.observability.flops import (
+    device_peak_flops,
+    mfu as _mfu,
+)
+from distributed_training_tpu.observability.memory import (
+    device_memory_metrics,
+)
+
+
+class TrainObservability:
+    """Flight instruments for one training run (see module docstring)."""
+
+    def __init__(self, cfg, *, step_flops: float | None = None,
+                 n_devices: int = 1, clock=None, is_master: bool = True,
+                 printer: Callable[[str], None] = print,
+                 dump_dir: str | None = None):
+        """``cfg`` is a :class:`~distributed_training_tpu.config.
+        ObservabilityConfig`; ``step_flops`` the analytic model FLOPs of
+        one optimizer step (None → no MFU line); ``clock`` the trainer's
+        WallClock for goodput attribution; ``dump_dir`` overrides
+        ``cfg.dump_dir`` (the trainers resolve the None default to
+        ``<checkpoint dir>/flight``)."""
+        self.cfg = cfg
+        self.dump_dir = dump_dir or cfg.dump_dir or "./flight"
+        self.is_master = is_master
+        self.printer = printer
+        self.clock = clock
+        self.n_devices = n_devices
+        self.step_flops = step_flops if cfg.mfu else None
+        self.peak_flops = (cfg.peak_flops if cfg.peak_flops
+                           else device_peak_flops())
+        self.recorder = (FlightRecorder(cfg.ring_size)
+                         if cfg.flight_recorder else None)
+        self.detector = (AnomalyDetector(
+            spike_factor=cfg.grad_norm_spike_factor)
+            if cfg.anomaly_detection else None)
+        self._rate_anchor: tuple[int, float] | None = None  # (step, t)
+        self._trace_left = 0
+        self._tracing = False
+        self._pending_raise: AnomalyError | None = None
+        self._fired = False
+        self._crash_dumped = False
+
+    def on_epoch(self) -> None:
+        """Epoch boundary: the eval/ckpt/reshuffle pause before the next
+        step must not be billed as a straggler step (step numbers stay
+        consecutive across epochs, so the recorder can't infer it), nor
+        into the next flush's FLOPs rate — drop the MFU anchor so
+        :meth:`on_step` re-anchors at the first step of the new epoch."""
+        if self.recorder is not None:
+            self.recorder.mark_gap()
+        self._rate_anchor = None
+
+    # -- hot path ------------------------------------------------------------
+    def on_step(self, step: int) -> None:
+        """Record one step dispatch; drives the post-anomaly trace window."""
+        t = time.perf_counter()
+        if self._rate_anchor is None:
+            # Anchor MFU at the first step, not at construction: the gap
+            # would otherwise charge model-building time to the first
+            # flush's FLOPs rate. (The first interval still includes the
+            # step compile; later flushes are clean steady state.)
+            self._rate_anchor = (step - 1, t)
+        if self.recorder is not None:
+            self.recorder.record_step(step, t)
+        if self._trace_left > 0:
+            self._trace_left -= 1
+            if self._trace_left == 0:
+                self._stop_trace()
+                if self._pending_raise is not None:
+                    err, self._pending_raise = self._pending_raise, None
+                    raise err
+
+    # -- flush boundary ------------------------------------------------------
+    def on_flush(self, flushed: dict[str, Any], *, batch=None, state=None,
+                 step_fn=None, rng=None) -> dict[str, float]:
+        """Augment a flushed metrics dict; returns the extra metrics to
+        write to the sinks (mfu / model_flops_per_sec / memory). May raise
+        :class:`AnomalyError` (``anomaly_action='raise'`` with
+        ``anomaly_trace_steps=0``); with a trace window the raise is
+        deferred to :meth:`on_step` / :meth:`close`."""
+        extras: dict[str, float] = {}
+        step = int(flushed.get("step", 0))
+        now = time.perf_counter()
+        if self.step_flops and self._rate_anchor is not None:
+            a_step, a_t = self._rate_anchor
+            if step > a_step and now > a_t:
+                fps = self.step_flops * (step - a_step) / (now - a_t)
+                extras["model_flops_per_sec"] = fps
+                u = _mfu(fps, self.n_devices, self.peak_flops)
+                if u is not None:
+                    extras["mfu"] = u
+        self._rate_anchor = (step, now)
+        if self.cfg.memory_telemetry:
+            extras.update(device_memory_metrics())
+        if self.recorder is not None:
+            self.recorder.record_flush(step, {**flushed, **extras})
+        if self.detector is not None and not self._fired:
+            reasons = self.detector.check(flushed)
+            if reasons:
+                self._trigger(step, reasons, batch=batch, state=state,
+                              step_fn=step_fn, rng=rng)
+        return extras
+
+    # -- anomaly trigger -----------------------------------------------------
+    def _trigger(self, step: int, reasons: list[str], *, batch, state,
+                 step_fn, rng) -> None:
+        self._fired = True  # one forensic capture per run, then stand down
+        if self.recorder is not None:
+            self.recorder.record_anomaly(step, reasons)
+        self.printer(f"[observability] ANOMALY at step {step}: "
+                     + "; ".join(reasons))
+        tag = f"anomaly_step{step}"
+        if self.is_master:
+            self.dump(os.path.join(self.dump_dir, f"{tag}_flight.json"),
+                      reason="anomaly: " + "; ".join(reasons))
+            self._save_batch(batch, tag)
+            self._save_hlo(step_fn, state, batch, rng, tag)
+        err = AnomalyError(
+            f"training anomaly at step {step}: {'; '.join(reasons)} "
+            f"(forensics in {self.dump_dir})")
+        if self.cfg.anomaly_trace_steps > 0:
+            self._start_trace(os.path.join(self.dump_dir, f"{tag}_trace"))
+            self._trace_left = self.cfg.anomaly_trace_steps
+            if self.cfg.anomaly_action == "raise":
+                self._pending_raise = err  # raise after the trace window
+        elif self.cfg.anomaly_action == "raise":
+            raise err
+
+    def _save_batch(self, batch, tag: str) -> None:
+        """The offending (device) batch as an npz — the one deliberate
+        device→host fetch in this module, paid only on anomaly."""
+        if batch is None:
+            return
+        try:
+            import jax
+            import numpy as np
+
+            arrays = {k: np.asarray(jax.device_get(v))
+                      for k, v in batch.items()}
+            os.makedirs(self.dump_dir, exist_ok=True)
+            np.savez(os.path.join(self.dump_dir, f"{tag}_batch.npz"),
+                     **arrays)
+        except Exception as e:  # forensics must not mask the anomaly
+            self.printer(f"[observability] batch save failed: {e}")
+
+    def _save_hlo(self, step_fn, state, batch, rng, tag: str) -> None:
+        """StableHLO of the exact step program, via the factories' AOT
+        ``.lower`` hook (re-lowers from cache; no execution)."""
+        if step_fn is None or state is None or batch is None or rng is None:
+            return
+        lower = getattr(step_fn, "lower", None)
+        if lower is None:
+            return
+        try:
+            text = lower(state, batch, rng).as_text()
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(os.path.join(self.dump_dir, f"{tag}_hlo.txt"),
+                      "w") as fh:
+                fh.write(text)
+        except Exception as e:
+            self.printer(f"[observability] HLO save failed: {e}")
+
+    def _start_trace(self, trace_dir: str) -> None:
+        import jax
+
+        try:
+            jax.profiler.start_trace(trace_dir)
+            self._tracing = True
+            self.printer(f"[observability] capturing "
+                         f"{self.cfg.anomaly_trace_steps}-step profiler "
+                         f"trace to {trace_dir}")
+        except Exception as e:  # e.g. a --profile-dir trace already running
+            self.printer(f"[observability] trace capture unavailable: {e}")
+            self._tracing = False
+
+    def _stop_trace(self) -> None:
+        if not self._tracing:
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover - backend quirk
+            self.printer(f"[observability] trace stop failed: {e}")
+        self._tracing = False
+
+    # -- dumps / lifecycle ---------------------------------------------------
+    def dump(self, path: str | None = None,
+             reason: str = "on-demand") -> str | None:
+        """Write the flight record to ``path`` (default
+        ``dump_dir/flight.json``); returns the path, or None when the
+        recorder is off."""
+        if self.recorder is None:
+            return None
+        if path is None:
+            path = os.path.join(self.dump_dir, "flight.json")
+        totals = self.clock.snapshot() if self.clock is not None else None
+        self.recorder.dump(path, reason=reason, phase_totals=totals)
+        return path
+
+    def on_crash(self) -> None:
+        """Crash-path dump; swallows its own errors (the original
+        exception must surface, not a forensics failure)."""
+        if self._crash_dumped or self.recorder is None or not self.is_master:
+            return
+        self._crash_dumped = True
+        try:
+            path = self.dump(
+                os.path.join(self.dump_dir, "flight_crash.json"),
+                reason="crash")
+            self.printer(f"[observability] crash flight record: {path}")
+        except Exception as e:
+            self.printer(f"[observability] crash dump failed: {e}")
+
+    def close(self, raise_pending: bool = True) -> None:
+        """Idempotent teardown: stop a dangling anomaly trace; surface a
+        deferred raise whose trace window the run's end cut short."""
+        self._trace_left = 0
+        self._stop_trace()
+        if raise_pending and self._pending_raise is not None:
+            err, self._pending_raise = self._pending_raise, None
+            raise err
+        self._pending_raise = None

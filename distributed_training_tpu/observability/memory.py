@@ -1,0 +1,57 @@
+"""Device-memory telemetry via ``device.memory_stats()``.
+
+HBM pressure is the binding constraint for most configs in this repo (the
+26 GB logits wall, the remat/offload levers), yet the seed had no way to
+see it short of an OOM. ``jax.local_devices()[i].memory_stats()`` reads
+the allocator's host-side counters — it performs NO device synchronization
+and costs microseconds — so sampling it at meter-flush boundaries keeps
+the "no hidden syncs in the hot loop" contract intact.
+
+CPU (and any backend without allocator stats) returns ``memory_stats() is
+None``; telemetry then reports ``{}`` and every consumer treats the keys
+as optional.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def device_memory_metrics(devices=None) -> dict[str, float]:
+    """Aggregate allocator stats over the local devices.
+
+    Returns (empty when unsupported):
+
+    - ``mem_bytes_in_use``: max bytes currently allocated on any local
+      device (the straggler chip is the one that OOMs);
+    - ``mem_peak_bytes``: max high-water mark on any local device;
+    - ``mem_bytes_limit``: the per-device capacity, when reported.
+    """
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    in_use: list[float] = []
+    peak: list[float] = []
+    limit: list[float] = []
+    for d in devices:
+        try:
+            stats: dict[str, Any] | None = d.memory_stats()
+        except Exception:  # pragma: no cover - backend quirk
+            stats = None
+        if not stats:
+            continue
+        if "bytes_in_use" in stats:
+            in_use.append(float(stats["bytes_in_use"]))
+        if "peak_bytes_in_use" in stats:
+            peak.append(float(stats["peak_bytes_in_use"]))
+        if "bytes_limit" in stats:
+            limit.append(float(stats["bytes_limit"]))
+    out: dict[str, float] = {}
+    if in_use:
+        out["mem_bytes_in_use"] = max(in_use)
+    if peak:
+        out["mem_peak_bytes"] = max(peak)
+    if limit:
+        out["mem_bytes_limit"] = max(limit)
+    return out

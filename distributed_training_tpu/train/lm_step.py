@@ -319,12 +319,13 @@ def _lm_loss_and_grads(state: TrainState, tokens, targets, rng,
 
 
 def _lm_metrics(new_state: TrainState, ce, aux, accuracy, finite,
-                pmean_axes=None):
+                pmean_axes=None, grad_norm=None):
     """The LM metrics contract; ``pmean_axes`` averages shard-local values
     (the GSPMD path computes global values already). ``loss`` is the full
     objective (CE + MoE aux); ``perplexity`` is ``exp(CE)`` so it stays
     comparable to eval perplexity. ``accuracy=None`` (metrics_accuracy off)
-    drops the key — the dict is static per compile. Keep this dict the
+    drops the key, ``grad_norm`` (the observability knob, already a global
+    scalar) adds one — the dict is static per compile. Keep this dict the
     single source of the metric key set."""
     if pmean_axes:
         ce = lax.pmean(ce, pmean_axes)
@@ -341,6 +342,8 @@ def _lm_metrics(new_state: TrainState, ce, aux, accuracy, finite,
     }
     if accuracy is None:
         del out["accuracy"]
+    if grad_norm is not None:
+        out["grad_norm"] = grad_norm
     return out
 
 
@@ -460,7 +463,7 @@ def make_lm_train_step(
     grad_accum_steps: int = 1, zero_stage: int = 0,
     accuracy_metric: bool = True, cpu_offload: bool = False,
     logits_dtype=None, ce_save_probs: bool = False,
-    tp_overlap: bool = False,
+    tp_overlap: bool = False, grad_norm_metric: bool = False,
 ) -> Callable:
     """Build the (data × sequence)-parallel jitted LM train step.
 
@@ -602,8 +605,17 @@ def make_lm_train_step(
             axis_names=axis_names,
         )
         grads, (ce, aux, accuracy) = sharded(gstate, batch, rng)
+        grad_norm = None
+        if grad_norm_metric:
+            # Outside the manual region the grads are GSPMD-global (the
+            # ring body already pmean'd and unscaled them), so one fused
+            # norm reduction yields the global value on every shard.
+            from distributed_training_tpu.train.step import global_grad_norm
+
+            grad_norm = global_grad_norm(grads)
         new_state, finite = commit_gradients(state, grads)
-        return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
+        return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite,
+                                      grad_norm=grad_norm)
 
     def extra_check(batch):
         if not tp_overlap:
@@ -779,6 +791,7 @@ def _make_gspmd_lm_step(
     cpu_offload: bool = False,
     ce_save_probs: bool = False,
     batch_spec: P | None = None,
+    grad_norm_metric: bool = False,
 ) -> Callable:
     """Shared GSPMD LM step builder (the TP and PP steps differ only in how
     the train state is placed): batch over ``data`` (or ``batch_spec`` —
@@ -815,8 +828,14 @@ def _make_gspmd_lm_step(
                 ce_chunk=ce_chunk, accuracy_metric=accuracy_metric,
                 logits_dtype=logits_dtype, ce_save_probs=ce_save_probs)
         grads = state.loss_scale.unscale_grads(grads)
+        grad_norm = None
+        if grad_norm_metric:
+            from distributed_training_tpu.train.step import global_grad_norm
+
+            grad_norm = global_grad_norm(grads)
         new_state, finite = commit_gradients(state, grads)
-        return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite)
+        return new_state, _lm_metrics(new_state, ce, aux, accuracy, finite,
+                                      grad_norm=grad_norm)
 
     return _lazy_jit_step(mesh, state_shardings_fn, body,
                           batch_sh=batch_sh, max_len=max_len, donate=donate)
@@ -827,6 +846,7 @@ def make_tp_lm_train_step(
     grad_accum_steps: int = 1, ce_chunk: int | None = None,
     accuracy_metric: bool = True, cpu_offload: bool = False,
     ce_save_probs: bool = False, tp_overlap: bool = False,
+    grad_norm_metric: bool = False,
 ) -> Callable:
     """Tensor-parallel (megatron-style) LM train step via GSPMD placement.
 
@@ -872,7 +892,8 @@ def make_tp_lm_train_step(
             mesh, model=model, donate=donate, ce_chunk=ce_chunk,
             grad_accum_steps=grad_accum_steps, zero_stage=zero_stage,
             accuracy_metric=accuracy_metric, cpu_offload=cpu_offload,
-            ce_save_probs=ce_save_probs, tp_overlap=True)
+            ce_save_probs=ce_save_probs, tp_overlap=True,
+            grad_norm_metric=grad_norm_metric)
     return _make_gspmd_lm_step(
         mesh,
         lambda state: tp_state_shardings(state, mesh, zero_stage=zero_stage,
@@ -881,7 +902,8 @@ def make_tp_lm_train_step(
         grad_accum_steps=grad_accum_steps, ce_chunk=ce_chunk,
         accuracy_metric=accuracy_metric,
         logits_dtype=model_logits_dtype(model),
-        cpu_offload=cpu_offload, ce_save_probs=ce_save_probs)
+        cpu_offload=cpu_offload, ce_save_probs=ce_save_probs,
+        grad_norm_metric=grad_norm_metric)
 
 
 def make_pp_lm_train_step(
@@ -889,6 +911,7 @@ def make_pp_lm_train_step(
     ce_chunk: int | None = None, accuracy_metric: bool = True,
     zero_stage: int = 0, virtual_stages: int = 1,
     cpu_offload: bool = False, ce_save_probs: bool = False,
+    grad_norm_metric: bool = False,
 ) -> Callable:
     """Pipeline-parallel LM train step (GPipe or circular schedule over
     ``pipe``).
@@ -959,7 +982,8 @@ def make_pp_lm_train_step(
         logits_dtype=model_logits_dtype(model),
         cpu_offload=cpu_offload, ce_save_probs=ce_save_probs,
         batch_spec=(P(AXIS_DATA, model.seq_axis)
-                    if model.seq_axis else None))
+                    if model.seq_axis else None),
+        grad_norm_metric=grad_norm_metric)
     step.pipelined = plm
     return step
 

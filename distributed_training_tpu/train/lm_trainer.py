@@ -27,6 +27,7 @@ propagate inside the shards and GSPMD inserts the collectives there.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -64,6 +65,12 @@ from distributed_training_tpu.train.train_state import (
     TrainState,
     init_train_state,
     param_count,
+)
+from distributed_training_tpu.observability import (
+    AnomalyError,
+    TrainObservability,
+    forward_flops,
+    train_step_flops,
 )
 from distributed_training_tpu.runtime.preemption import PreemptionGuard
 from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
@@ -329,7 +336,8 @@ class LMTrainer:
                 zero_stage=cfg.zero.stage,
                 virtual_stages=lm.virtual_stages,
                 cpu_offload=cfg.zero.cpu_offload,
-                ce_save_probs=lm.ce_save_probs)
+                ce_save_probs=lm.ce_save_probs,
+                grad_norm_metric=cfg.observability.grad_norm)
             plm = self.train_step.pipelined
             state = TrainState.create(
                 apply_fn=plm.apply_fn, params=plm.init_params(init_rng),
@@ -342,7 +350,8 @@ class LMTrainer:
                 accuracy_metric=lm.metrics_accuracy,
                 cpu_offload=cfg.zero.cpu_offload,
                 ce_save_probs=lm.ce_save_probs,
-                tp_overlap=cfg.tp_overlap and model_par > 1)
+                tp_overlap=cfg.tp_overlap and model_par > 1,
+                grad_norm_metric=cfg.observability.grad_norm)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -359,7 +368,8 @@ class LMTrainer:
                 accuracy_metric=lm.metrics_accuracy,
                 cpu_offload=cfg.zero.cpu_offload,
                 ce_save_probs=lm.ce_save_probs,
-                tp_overlap=cfg.tp_overlap and model_par > 1)
+                tp_overlap=cfg.tp_overlap and model_par > 1,
+                grad_norm_metric=cfg.observability.grad_norm)
             state = init_train_state(
                 self.model, init_rng, (1, 8), self.tx,
                 loss_scale=loss_scale, input_dtype=jnp.int32)
@@ -412,10 +422,27 @@ class LMTrainer:
             self._eval_fn = jax.jit(eval_loss)
 
         self.meter = MetricMeter(cfg.log_interval)
-        self.clock = WallClock(cfg.wall_clock_breakdown)
+        # Always-on when the flight recorder is (goodput attribution); the
+        # per-epoch report print stays gated on wall_clock_breakdown.
+        self.clock = WallClock(
+            cfg.wall_clock_breakdown or cfg.observability.flight_recorder)
         self.metrics_writer = MetricsWriter(
             cfg.tensorboard_dir, cfg.metrics_jsonl,
             enabled=self.coord.is_master())
+        # Flight instruments. Step FLOPs cover the EFFECTIVE batch's
+        # tokens (micro × accum × world × seq_len) — one optimizer step's
+        # model FLOPs, accumulation-aware by construction; MoE models
+        # report no MFU (routed FLOPs are runtime-dependent).
+        self.obs = TrainObservability(
+            cfg.observability,
+            step_flops=train_step_flops(forward_flops(
+                self.model, seq_len=lm.seq_len, batch=self.train_gbs)),
+            n_devices=int(self.mesh.devices.size),
+            clock=self.clock, is_master=self.coord.is_master(),
+            printer=self.coord.print,
+            # Forensics default next to the run's durable artifacts.
+            dump_dir=cfg.observability.dump_dir or os.path.join(
+                cfg.checkpoint.directory, "flight"))
         self._guard: PreemptionGuard | None = None
         self._global_step = 0
         self._epoch_step = 0
@@ -493,8 +520,10 @@ class LMTrainer:
                 f"[lm_trainer] resuming epoch {epoch} at step {skip_steps}")
             loader = SkipBatches(loader, skip_steps)
         self._epoch_step = skip_steps
+        self.obs.on_epoch()  # boundary pause ≠ a straggler step
         bar = EpochBar(len(loader), epoch, self.cfg.num_epochs,
                        self.coord.is_master())
+        gbatch = None
         for gbatch in self._batches(loader):
             with self.clock.phase("step"):
                 self.rng, step_rng = jax.random.split(self.rng)
@@ -504,11 +533,16 @@ class LMTrainer:
                 self._global_step += 1
                 self._epoch_step += 1
                 fetched = self.meter.push(self._global_step, metrics)
+                self.obs.on_step(self._global_step)
                 bar.update()
                 if fetched:
+                    extras = self.obs.on_flush(
+                        self.meter.last, batch=gbatch, state=self.state,
+                        step_fn=self.train_step, rng=self.rng)
                     bar.set_postfix(self.meter.last)
                     self.metrics_writer.write(
-                        self.meter.last["step"], self.meter.last)
+                        self.meter.last["step"],
+                        {**self.meter.last, **extras})
             if self._guard is not None and self._guard.should_stop(
                     at_sync_point=fetched):
                 break
@@ -516,7 +550,10 @@ class LMTrainer:
         # unconditional write would duplicate the last interval's point.
         if self.meter.pending:
             flushed = self.meter.flush()
-            self.metrics_writer.write(flushed["step"], flushed)
+            extras = self.obs.on_flush(
+                flushed, batch=gbatch, state=self.state,
+                step_fn=self.train_step, rng=self.rng)
+            self.metrics_writer.write(flushed["step"], {**flushed, **extras})
         bar.set_postfix(self.meter.last)
         bar.close()
         if self.cfg.wall_clock_breakdown:
@@ -552,8 +589,18 @@ class LMTrainer:
     # -- full run -----------------------------------------------------------
     def fit(self) -> dict:
         try:
-            return self._fit()
+            result = self._fit()
+            # Surfaces a deferred anomaly raise whose trace window the
+            # run's end cut short (forensics were dumped at trigger time).
+            self.obs.close()
+            return result
+        except AnomalyError:
+            raise
+        except BaseException:
+            self.obs.on_crash()  # flight record before the exception flies
+            raise
         finally:
+            self.obs.close(raise_pending=False)  # idempotent trace teardown
             self.metrics_writer.close()
 
     def _ckpt_layout(self) -> dict:
@@ -603,26 +650,29 @@ class LMTrainer:
                         done = self._epoch_step >= len(train_loader)
                         next_ep = epoch + 1 if done else epoch
                         estep = 0 if done else self._epoch_step
-                        ckpt_lib.save_checkpoint(
-                            cfg.checkpoint.directory, epoch, self.state,
-                            next_epoch=next_ep, epoch_step=estep,
-                            layout=self._ckpt_layout())
+                        with self.clock.phase("ckpt"):
+                            ckpt_lib.save_checkpoint(
+                                cfg.checkpoint.directory, epoch, self.state,
+                                next_epoch=next_ep, epoch_step=estep,
+                                layout=self._ckpt_layout())
                         self.coord.print(
                             f"[lm_trainer] SIGTERM: saved preemption "
                             f"checkpoint (resumes at epoch {next_ep} "
                             f"step {estep})")
                     break
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                    ppl = self.evaluate(eval_loader)
+                    with self.clock.phase("eval"):
+                        ppl = self.evaluate(eval_loader)
                     self.coord.print(
                         f"[eval] epoch {epoch + 1}: perplexity {ppl:.4f}")
                 if cfg.checkpoint.interval and (
                         epoch + 1) % cfg.checkpoint.interval == 0:
-                    ckpt_lib.save_checkpoint(
-                        cfg.checkpoint.directory, epoch, self.state,
-                        layout=self._ckpt_layout())
-                    ckpt_lib.prune_checkpoints(
-                        cfg.checkpoint.directory, cfg.checkpoint.keep)
+                    with self.clock.phase("ckpt"):
+                        ckpt_lib.save_checkpoint(
+                            cfg.checkpoint.directory, epoch, self.state,
+                            layout=self._ckpt_layout())
+                        ckpt_lib.prune_checkpoints(
+                            cfg.checkpoint.directory, cfg.checkpoint.keep)
         self._guard = None
         return {"final_perplexity": ppl, "preempted": preempted,
                 "last_metrics": self.meter.last,

@@ -186,10 +186,21 @@ def fetch_offloaded_opt_state(state: TrainState) -> TrainState:
         state.opt_state, jax.memory.Space.Device))
 
 
+def global_grad_norm(grads) -> jnp.ndarray:
+    """Global L2 norm of a gradient pytree, as an fp32 scalar.
+
+    The on-device grad-norm metric (``observability.grad_norm`` knob) and
+    the anomaly detector's spike signal. One fused reduction over grads
+    that are already materialized for the update — it rides the metrics
+    dict to the host at meter flushes only, costing no extra syncs.
+    """
+    return optax.global_norm(grads).astype(jnp.float32)
+
+
 def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
                accum_steps: int = 1, mesh: Mesh | None = None,
                label_smoothing: float = 0.0, input_affine=None,
-               cpu_offload: bool = False):
+               cpu_offload: bool = False, grad_norm_metric: bool = False):
     """Shared step body for the GSPMD and shard_map paths.
 
     When ``axis_name`` is set (shard_map path), gradients/metrics are
@@ -254,6 +265,10 @@ def _step_body(state: TrainState, batch, rng, *, axis_name: str | None = None,
         "loss_scale": new_state.loss_scale.scale,
         "grads_finite": finite.astype(jnp.float32),
     }
+    if grad_norm_metric:
+        # Post-pmean, post-unscale: the same (replicated) gradient the
+        # optimizer consumes, so every host flushes the identical value.
+        metrics["grad_norm"] = global_grad_norm(grads)
     return new_state, metrics
 
 
@@ -268,6 +283,7 @@ def make_train_step(
     cpu_offload: bool = False,
     tensor_parallel: bool = False,
     tp_overlap: bool = False,
+    grad_norm_metric: bool = False,
 ) -> Callable:
     """Build the GSPMD jitted train step for a mesh + ZeRO stage.
 
@@ -299,7 +315,7 @@ def make_train_step(
             mesh, zero_stage=zero_stage, donate=donate,
             grad_accum_steps=grad_accum_steps,
             label_smoothing=label_smoothing, input_affine=input_affine,
-            cpu_offload=cpu_offload)
+            cpu_offload=cpu_offload, grad_norm_metric=grad_norm_metric)
     cache: dict[Any, Callable] = {}
 
     def ensure_jitted(state: TrainState, batch):
@@ -330,7 +346,8 @@ def make_train_step(
                     mesh=mesh if grad_accum_steps > 1 else None,
                     label_smoothing=label_smoothing,
                     input_affine=input_affine,
-                    cpu_offload=cpu_offload),
+                    cpu_offload=cpu_offload,
+                    grad_norm_metric=grad_norm_metric),
                 in_shardings=(sshard, bshard, replicated(mesh)),
                 out_shardings=(sshard, replicated(mesh)),
                 donate_argnums=(0,) if donate else (),
@@ -407,6 +424,7 @@ def _overlap_tp_grads_body(gstate: TrainState, batch, rng, *,
 def _make_overlap_tp_train_step(
     mesh: Mesh, *, zero_stage: int, donate: bool, grad_accum_steps: int,
     label_smoothing: float, input_affine: tuple | None, cpu_offload: bool,
+    grad_norm_metric: bool = False,
 ) -> Callable:
     """Ring-overlapped TP image step (see :func:`make_train_step`).
 
@@ -465,6 +483,10 @@ def _make_overlap_tp_train_step(
                 "loss_scale": new_state.loss_scale.scale,
                 "grads_finite": finite.astype(jnp.float32),
             }
+            if grad_norm_metric:
+                # Outside the manual region: grads are GSPMD-global here
+                # (rule-table shards), so the norm reduces globally.
+                metrics["grad_norm"] = global_grad_norm(grads)
             return new_state, metrics
 
         fn = jax.jit(
@@ -487,7 +509,8 @@ def _make_overlap_tp_train_step(
 def make_shard_map_train_step(mesh: Mesh, donate: bool = True,
                               label_smoothing: float = 0.0,
                               input_affine: tuple | None = None,
-                              grad_accum_steps: int = 1) -> Callable:
+                              grad_accum_steps: int = 1,
+                              grad_norm_metric: bool = False) -> Callable:
     """Explicit-collective DP train step (``shard_map`` + ``lax.pmean``).
 
     The hand-written formulation of DDP's gradient all-reduce
@@ -511,7 +534,8 @@ def make_shard_map_train_step(mesh: Mesh, donate: bool = True,
             functools.partial(_step_body, axis_name=AXIS_DATA,
                               accum_steps=grad_accum_steps,
                               label_smoothing=label_smoothing,
-                              input_affine=input_affine),
+                              input_affine=input_affine,
+                              grad_norm_metric=grad_norm_metric),
             mesh,
             in_specs=(
                 jax.tree.map(lambda _: P(), state),

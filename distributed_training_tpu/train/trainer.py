@@ -12,6 +12,7 @@ and checkpoint save/resume (``:40-42``).
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +42,12 @@ from distributed_training_tpu.train.step import (
     make_train_step,
 )
 from distributed_training_tpu.train.train_state import init_train_state, param_count
+from distributed_training_tpu.observability import (
+    AnomalyError,
+    TrainObservability,
+    forward_flops,
+    train_step_flops,
+)
 from distributed_training_tpu.runtime.preemption import PreemptionGuard
 from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
 from distributed_training_tpu.utils.metrics_io import MetricsWriter
@@ -180,7 +187,8 @@ class Trainer:
                 input_affine=input_affine,
                 cpu_offload=cfg.zero.cpu_offload,
                 tensor_parallel=self.tp_size > 1,
-                tp_overlap=cfg.tp_overlap and self.tp_size > 1)
+                tp_overlap=cfg.tp_overlap and self.tp_size > 1,
+                grad_norm_metric=cfg.observability.grad_norm)
         else:
             if cfg.zero.stage != 0:
                 raise NotImplementedError(
@@ -194,13 +202,31 @@ class Trainer:
             self.train_step = make_shard_map_train_step(
                 self.mesh, label_smoothing=cfg.label_smoothing,
                 input_affine=input_affine,
-                grad_accum_steps=self.grad_accum)
+                grad_accum_steps=self.grad_accum,
+                grad_norm_metric=cfg.observability.grad_norm)
         self.eval_step = make_eval_step(self.mesh, input_affine=input_affine)
         self.meter = MetricMeter(cfg.log_interval)
-        self.clock = WallClock(cfg.wall_clock_breakdown)
+        # The clock always runs when the flight recorder does: goodput
+        # attribution costs two perf_counter reads per phase, and the
+        # per-epoch report print stays gated on wall_clock_breakdown.
+        self.clock = WallClock(
+            cfg.wall_clock_breakdown or cfg.observability.flight_recorder)
         self.metrics_writer = MetricsWriter(
             cfg.tensorboard_dir, cfg.metrics_jsonl,
             enabled=self.coord.is_master())
+        # Flight instruments: analytic step FLOPs (effective batch — MFU is
+        # accumulation-aware by construction) + the flush-boundary hooks.
+        self.obs = TrainObservability(
+            cfg.observability,
+            step_flops=train_step_flops(forward_flops(
+                self.model, image_size=cfg.data.image_size,
+                batch=self.train_gbs)),
+            n_devices=int(self.mesh.devices.size),
+            clock=self.clock, is_master=self.coord.is_master(),
+            printer=self.coord.print,
+            # Forensics default next to the run's durable artifacts.
+            dump_dir=cfg.observability.dump_dir or os.path.join(
+                cfg.checkpoint.directory, "flight"))
         self._guard: PreemptionGuard | None = None
         self._stats_refresh = None
         self._global_step = 0
@@ -252,8 +278,10 @@ class Trainer:
                 f"[trainer] resuming epoch {epoch} at step {skip_steps}")
             loader = SkipBatches(loader, skip_steps)
         self._epoch_step = skip_steps
+        self.obs.on_epoch()  # boundary pause ≠ a straggler step
         bar = EpochBar(len(loader), epoch, self.cfg.num_epochs,
                        self.coord.is_master())
+        gbatch = None
         for gbatch in self._batches(loader):
             with self.clock.phase("step"):
                 self.rng, step_rng = jax.random.split(self.rng)
@@ -265,11 +293,16 @@ class Trainer:
                 self._global_step += 1
                 self._epoch_step += 1
                 fetched = self.meter.push(self._global_step, metrics)
+                self.obs.on_step(self._global_step)
                 bar.update()
                 if fetched:
+                    extras = self.obs.on_flush(
+                        self.meter.last, batch=gbatch, state=self.state,
+                        step_fn=self.train_step, rng=self.rng)
                     bar.set_postfix(self.meter.last)
                     self.metrics_writer.write(
-                        self.meter.last["step"], self.meter.last)
+                        self.meter.last["step"],
+                        {**self.meter.last, **extras})
             if self._guard is not None and self._guard.should_stop(
                     at_sync_point=fetched):
                 break
@@ -277,7 +310,10 @@ class Trainer:
         # unconditional write would duplicate the last interval's point.
         if self.meter.pending:
             flushed = self.meter.flush()
-            self.metrics_writer.write(flushed["step"], flushed)
+            extras = self.obs.on_flush(
+                flushed, batch=gbatch, state=self.state,
+                step_fn=self.train_step, rng=self.rng)
+            self.metrics_writer.write(flushed["step"], {**flushed, **extras})
         bar.set_postfix(self.meter.last)
         bar.close()
         if self.cfg.wall_clock_breakdown:
@@ -384,8 +420,21 @@ class Trainer:
     # -- full run -----------------------------------------------------------
     def fit(self) -> dict:
         try:
-            return self._fit()
+            result = self._fit()
+            # Surfaces a deferred anomaly raise whose trace window the
+            # run's end cut short (forensics were dumped at trigger time).
+            self.obs.close()
+            return result
+        except AnomalyError:
+            raise
+        except BaseException:
+            # Crash forensics: the flight recorder's last ring of steps,
+            # flushed metrics, and goodput — written before the exception
+            # propagates (the process may be about to die).
+            self.obs.on_crash()
+            raise
         finally:
+            self.obs.close(raise_pending=False)  # idempotent trace teardown
             # Both exits (incl. preemption — the process is about to die in
             # its SIGTERM grace window — and the target_acc raise) must
             # flush buffered TensorBoard events.
@@ -430,24 +479,27 @@ class Trainer:
                         done = self._epoch_step >= len(train_loader)
                         next_ep = epoch + 1 if done else epoch
                         estep = 0 if done else self._epoch_step
-                        ckpt_lib.save_checkpoint(
-                            cfg.checkpoint.directory, epoch, self.state,
-                            next_epoch=next_ep, epoch_step=estep)
+                        with self.clock.phase("ckpt"):
+                            ckpt_lib.save_checkpoint(
+                                cfg.checkpoint.directory, epoch, self.state,
+                                next_epoch=next_ep, epoch_step=estep)
                         self.coord.print(
                             f"[trainer] SIGTERM: saved preemption checkpoint "
                             f"(resumes at epoch {next_ep} step {estep})")
                     break
                 if cfg.eval_every and (epoch + 1) % cfg.eval_every == 0:
-                    final_acc = self.evaluate(eval_loader, train_loader)
+                    with self.clock.phase("eval"):
+                        final_acc = self.evaluate(eval_loader, train_loader)
                     last_eval_epoch = epoch + 1
                     self.coord.print(
                         f"[eval] epoch {epoch + 1}: top-1 {final_acc:.4f}")
                 if cfg.checkpoint.interval and (
                         epoch + 1) % cfg.checkpoint.interval == 0:
-                    ckpt_lib.save_checkpoint(
-                        cfg.checkpoint.directory, epoch, self.state)
-                    ckpt_lib.prune_checkpoints(
-                        cfg.checkpoint.directory, cfg.checkpoint.keep)
+                    with self.clock.phase("ckpt"):
+                        ckpt_lib.save_checkpoint(
+                            cfg.checkpoint.directory, epoch, self.state)
+                        ckpt_lib.prune_checkpoints(
+                            cfg.checkpoint.directory, cfg.checkpoint.keep)
         self._guard = None
         if preempted:
             return {"final_acc": None, "preempted": True,

@@ -6,6 +6,21 @@ serializes the pipeline (SURVEY.md §2.5) — and gates tqdm on the master rank
 jax.Arrays; the meter keeps references and only calls ``.item()`` (blocking)
 at ``log_interval`` boundaries, so the steady-state step never waits on the
 host. tqdm is used when available, plain prints otherwise.
+
+The no-hidden-transfer claim is a PINNED contract, not prose:
+``tests/test_transfer_guard.py`` runs steady-state train steps (image and
+LM) with the whole between-flush window wrapped in
+``jax.transfer_guard("disallow")`` — any implicit transfer the backend
+can observe fails the suite (on the CPU test mesh that is every hidden
+host→device upload, e.g. an unplaced numpy batch; on a real accelerator
+the same wrapper also rejects implicit device→host fetches like a stray
+``float(metric)``). The meter's flush itself uses the explicit
+``jax.device_get``, which the guard permits by design: explicit fetches at
+log intervals ARE the contract. The observability hooks
+(``observability/hooks.py``) keep the same rule — per-step cost is one
+host ``perf_counter()`` ring write; MFU, memory telemetry, and anomaly
+detection all read at flush boundaries from values the meter already
+fetched.
 """
 
 from __future__ import annotations

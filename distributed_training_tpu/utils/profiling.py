@@ -36,22 +36,51 @@ def trace(log_dir: str | None):
 
 
 class WallClock:
-    """Phase timer: ``with clock.phase('data'): ...``; report per epoch."""
+    """Phase timer: ``with clock.phase('data'): ...``; report per epoch.
+
+    Attribution is EXCLUSIVE: entering a nested phase pauses the outer
+    one (e.g. the eval loop's internal 'data' staging accrues to 'data',
+    not double-counted under 'eval'), so the totals partition the tracked
+    wall-time — which is what lets the flight recorder's goodput read
+    them as fractions that sum to 1 (``observability/flight_recorder.py``).
+    """
 
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
         self.totals: dict[str, float] = defaultdict(float)
+        # Run-lifetime totals: ``report()`` clears ``totals`` per epoch,
+        # but the flight recorder's goodput wants the whole run.
+        self.lifetime: dict[str, float] = defaultdict(float)
+        self._stack: list[list] = []  # [name, segment_start] frames
+
+    def _accrue(self, name: str, dt: float) -> None:
+        self.totals[name] += dt
+        self.lifetime[name] += dt
 
     @contextlib.contextmanager
     def phase(self, name: str):
         if not self.enabled:
             yield
             return
-        t0 = time.perf_counter()
+        now = time.perf_counter()
+        if self._stack:  # pause the outer phase
+            outer = self._stack[-1]
+            self._accrue(outer[0], now - outer[1])
+        self._stack.append([name, now])
         try:
             yield
         finally:
-            self.totals[name] += time.perf_counter() - t0
+            now = time.perf_counter()
+            frame = self._stack.pop()
+            self._accrue(frame[0], now - frame[1])
+            if self._stack:  # resume the outer phase's segment
+                self._stack[-1][1] = now
+
+    def snapshot(self) -> dict[str, float]:
+        """Run-lifetime phase totals, never cleared (the flight
+        recorder's goodput reads this at dump time; ``report`` keeps its
+        clearing per-epoch semantics)."""
+        return dict(self.lifetime)
 
     def report(self) -> dict[str, float]:
         out = dict(self.totals)

@@ -117,6 +117,32 @@ def add_argument() -> argparse.Namespace:
                         help="resume from the newest checkpoint if present")
     parser.add_argument("--tensorboard-dir", type=str, default=None)
     parser.add_argument("--metrics-jsonl", type=str, default=None)
+    # Observability (flight instruments; docs/OBSERVABILITY.md).
+    parser.add_argument("--flight-recorder",
+                        action=argparse.BooleanOptionalAction, default=True,
+                        help="ring buffer of per-step timestamps + flushed "
+                             "metrics; step-time p50/p95 + goodput, dumped "
+                             "to JSON on anomaly/crash (read it with "
+                             "tools/flight_report.py)")
+    parser.add_argument("--flight-dir", type=str, default=None,
+                        help="where anomaly/crash forensics land (flight "
+                             "JSON, offending batch, HLO, profiler trace)")
+    parser.add_argument("--grad-norm-metric", action="store_true",
+                        default=False,
+                        help="global L2 grad norm as an on-device step "
+                             "metric (no extra host syncs; also arms the "
+                             "anomaly detector's spike rule)")
+    parser.add_argument("--anomaly-detection", action="store_true",
+                        default=False,
+                        help="NaN/Inf-loss + grad-norm-spike detection at "
+                             "meter flushes; on trigger: flight dump + "
+                             "batch/HLO save + N-step profiler trace, then "
+                             "--anomaly-action")
+    parser.add_argument("--anomaly-action", default="raise",
+                        choices=["raise", "skip"])
+    parser.add_argument("--anomaly-trace-steps", type=int, default=3,
+                        help="profiler-trace steps captured after an "
+                             "anomaly trigger (0 = no trace)")
     return parser.parse_args()
 
 
@@ -127,6 +153,7 @@ def build_config(args: argparse.Namespace):
         LMConfig,
         MeshSpec,
         MoEConfig,
+        ObservabilityConfig,
         TrainConfig,
         ZeroConfig,
     )
@@ -157,6 +184,14 @@ def build_config(args: argparse.Namespace):
         profile_dir=args.profile_dir,
         tensorboard_dir=args.tensorboard_dir,
         metrics_jsonl=args.metrics_jsonl,
+        observability=ObservabilityConfig(
+            flight_recorder=args.flight_recorder,
+            dump_dir=args.flight_dir,
+            grad_norm=args.grad_norm_metric or args.anomaly_detection,
+            anomaly_detection=args.anomaly_detection,
+            anomaly_action=args.anomaly_action,
+            anomaly_trace_steps=args.anomaly_trace_steps,
+        ),
         precision=dataclasses.replace(cfg.precision, dtype=args.dtype),
         zero=ZeroConfig(stage=args.stage),
         # expert gated on --moe: a dense run must keep the full data axis

@@ -152,6 +152,26 @@ def add_argument() -> argparse.Namespace:
                         help="TensorBoard scalar log directory")
     parser.add_argument("--metrics-jsonl", type=str, default=None,
                         help="append metric flushes to this JSONL file")
+    # Observability (flight instruments; docs/OBSERVABILITY.md). Same
+    # surface as gpt/jax_tpu/train.py.
+    parser.add_argument("--flight-recorder",
+                        action=argparse.BooleanOptionalAction, default=True,
+                        help="ring buffer of per-step timestamps + flushed "
+                             "metrics (step-time percentiles, goodput; "
+                             "dumped on anomaly/crash)")
+    parser.add_argument("--flight-dir", type=str, default=None,
+                        help="anomaly/crash forensics directory")
+    parser.add_argument("--grad-norm-metric", action="store_true",
+                        default=False,
+                        help="global L2 grad norm as an on-device metric")
+    parser.add_argument("--anomaly-detection", action="store_true",
+                        default=False,
+                        help="NaN/Inf-loss + grad-norm-spike detection at "
+                             "meter flushes (flight dump + batch/HLO + "
+                             "profiler trace on trigger)")
+    parser.add_argument("--anomaly-action", default="raise",
+                        choices=["raise", "skip"])
+    parser.add_argument("--anomaly-trace-steps", type=int, default=3)
 
     return parser.parse_args()
 
@@ -212,6 +232,7 @@ def build_config(args: argparse.Namespace):
         CheckpointConfig,
         DataConfig,
         MoEConfig,
+        ObservabilityConfig,
         TrainConfig,
         from_ds_config,
     )
@@ -257,6 +278,14 @@ def build_config(args: argparse.Namespace):
         profile_dir=args.profile_dir,
         tensorboard_dir=args.tensorboard_dir,
         metrics_jsonl=args.metrics_jsonl,
+        observability=ObservabilityConfig(
+            flight_recorder=args.flight_recorder,
+            dump_dir=args.flight_dir,
+            grad_norm=args.grad_norm_metric or args.anomaly_detection,
+            anomaly_detection=args.anomaly_detection,
+            anomaly_action=args.anomaly_action,
+            anomaly_trace_steps=args.anomaly_trace_steps,
+        ),
         checkpoint=CheckpointConfig(
             directory=args.checkpoint,
             interval=args.interval,

@@ -1,0 +1,228 @@
+"""Flight recorder: ring wraparound, percentile math, dump round-trip,
+goodput accounting, and the WallClock's exclusive phase attribution.
+
+Pure host-side logic (no devices) — the recorder's whole design is that
+the hot path is one ``perf_counter`` ring write; these tests pin the
+derived statistics that the anomaly/crash dumps and
+``tools/flight_report.py`` rely on.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from distributed_training_tpu.observability.flight_recorder import (
+    FlightRecorder,
+    percentile,
+)
+from distributed_training_tpu.utils.profiling import WallClock
+
+
+class TestRing:
+    def test_wraparound_keeps_last_ring_size(self):
+        r = FlightRecorder(ring_size=8)
+        for s in range(1, 21):
+            r.record_step(s, t=float(s))
+        assert len(r) == 8
+        assert [n for n, _ in r.steps] == list(range(13, 21))
+        assert r._count == 20
+
+    def test_partial_ring_in_order(self):
+        r = FlightRecorder(ring_size=8)
+        for s in range(1, 4):
+            r.record_step(s, t=float(s))
+        assert [n for n, _ in r.steps] == [1, 2, 3]
+
+    def test_flush_ring_wraps_too(self):
+        r = FlightRecorder(ring_size=4)
+        for s in range(10):
+            r.record_flush(s, {"loss": float(s)})
+        assert [f["step"] for f in r.flushes] == [6, 7, 8, 9]
+
+    def test_flush_drops_none_and_step_key(self):
+        r = FlightRecorder(ring_size=4)
+        r.record_flush(3, {"loss": 1.0, "accuracy": None, "step": 3})
+        assert r.flushes == [{"step": 3, "loss": 1.0}]
+
+    def test_ring_size_validated(self):
+        with pytest.raises(ValueError, match="ring_size"):
+            FlightRecorder(ring_size=1)
+
+
+class TestPercentiles:
+    def test_matches_numpy_linear(self):
+        rng = np.random.RandomState(0)
+        xs = rng.rand(37).tolist()
+        for q in (0, 25, 50, 95, 100):
+            assert percentile(xs, q) == pytest.approx(
+                float(np.percentile(xs, q)), rel=1e-12)
+
+    def test_single_value(self):
+        assert percentile([4.2], 95) == 4.2
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError):
+            percentile([], 50)
+
+    def test_synthetic_timeline_stats(self):
+        # 9 steps at 10 ms, one 100 ms straggler: p50 pins the steady
+        # state, max pins the straggler, p95 interpolates between them.
+        r = FlightRecorder(ring_size=64)
+        t = 0.0
+        r.record_step(1, t=t)
+        for s in range(2, 11):
+            t += 0.1 if s == 10 else 0.01
+            r.record_step(s, t=t)
+        stats = r.step_time_stats()
+        times = r.step_times_ms()
+        assert len(times) == 9
+        assert stats["step_time_p50_ms"] == pytest.approx(10.0, rel=1e-6)
+        assert stats["step_time_max_ms"] == pytest.approx(100.0, rel=1e-6)
+        assert stats["step_time_p95_ms"] == pytest.approx(
+            float(np.percentile(times, 95)), rel=1e-9)
+
+    def test_non_adjacent_steps_excluded(self):
+        # A gap in step numbering (eval/ckpt between epochs) must not be
+        # billed as a 5-second "step".
+        r = FlightRecorder(ring_size=8)
+        r.record_step(1, t=0.0)
+        r.record_step(2, t=0.01)
+        r.record_step(10, t=5.0)   # resumed after a gap
+        r.record_step(11, t=5.01)
+        times = r.step_times_ms()
+        assert len(times) == 2
+        assert max(times) == pytest.approx(10.0, rel=1e-6)
+
+    def test_marked_epoch_gap_excluded(self):
+        # Step numbers stay CONSECUTIVE across epochs, so the numbering
+        # heuristic can't see the eval/ckpt pause — mark_gap (called by
+        # the trainers at epoch start) excludes that one delta.
+        r = FlightRecorder(ring_size=8)
+        r.record_step(1, t=0.0)
+        r.record_step(2, t=0.01)
+        r.mark_gap()                 # epoch boundary: eval + checkpoint
+        r.record_step(3, t=5.0)      # first step of the next epoch
+        r.record_step(4, t=5.01)
+        times = r.step_times_ms()
+        assert len(times) == 2
+        assert max(times) == pytest.approx(10.0, rel=1e-6)
+
+    def test_too_few_steps_empty_stats(self):
+        r = FlightRecorder()
+        assert r.step_time_stats() == {}
+        r.record_step(1, t=0.0)
+        assert r.step_time_stats() == {}
+
+
+class TestDump:
+    def test_dump_load_round_trip(self, tmp_path):
+        r = FlightRecorder(ring_size=16)
+        for s in range(1, 6):
+            r.record_step(s, t=s * 0.01)
+        r.record_flush(5, {"loss": 1.25, "grad_norm": 3.0})
+        r.record_anomaly(5, ["non-finite loss (nan)"])
+        path = str(tmp_path / "sub" / "flight.json")  # dirs auto-created
+        written = r.dump(path, reason="unit-test",
+                         phase_totals={"step": 3.0, "data": 1.0})
+        loaded = FlightRecorder.load(path)
+        assert loaded == json.loads(json.dumps(written))  # JSON-stable
+        assert loaded["reason"] == "unit-test"
+        assert loaded["steps"] == [[s, s * 0.01] for s in range(1, 6)]
+        assert loaded["flushes"][-1]["grad_norm"] == 3.0
+        assert loaded["anomalies"][0]["reasons"] == ["non-finite loss (nan)"]
+        assert loaded["wall_clock"]["goodput"] == pytest.approx(0.75)
+        assert loaded["step_time_stats"]["step_time_p50_ms"] == pytest.approx(
+            10.0, rel=1e-6)
+
+    def test_non_finite_metrics_dump_strict_json(self, tmp_path):
+        # The anomaly dump's star witness IS a NaN loss — it must survive
+        # as a parseable token, not as invalid bare `NaN`/`Infinity`
+        # (jq / JSON.parse reject those).
+        r = FlightRecorder(ring_size=8)
+        r.record_flush(1, {"loss": float("nan"), "grad_norm": float("inf")})
+        path = str(tmp_path / "f.json")
+        r.dump(path, reason="anomaly: non-finite loss")
+        text = open(path).read()
+        assert "NaN" not in text and "Infinity" not in text
+        snap = json.loads(text)
+        assert snap["flushes"][-1]["loss"] == "nan"
+        assert snap["flushes"][-1]["grad_norm"] == "inf"
+
+    def test_load_rejects_unknown_format(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"format_version": 999}))
+        with pytest.raises(ValueError, match="format"):
+            FlightRecorder.load(str(p))
+
+    def test_goodput_fractions_partition(self):
+        g = FlightRecorder.goodput(
+            {"step": 6.0, "data": 2.0, "log": 1.0, "ckpt": 1.0})
+        assert g["goodput"] == pytest.approx(0.6)
+        assert sum(g["phase_fraction"].values()) == pytest.approx(1.0)
+        assert FlightRecorder.goodput({}) == {}
+
+
+class TestWallClock:
+    def test_nested_phase_attribution_is_exclusive(self):
+        clock = WallClock(enabled=True)
+        with clock.phase("eval"):
+            with clock.phase("data"):
+                pass
+        totals = clock.snapshot()
+        # Exclusive attribution: eval + data partition the eval span, so
+        # goodput fractions can sum to 1 (no double counting).
+        assert set(totals) == {"eval", "data"}
+        assert totals["eval"] >= 0 and totals["data"] >= 0
+
+    def test_report_clears_but_snapshot_is_lifetime(self):
+        clock = WallClock(enabled=True)
+        with clock.phase("step"):
+            pass
+        first = clock.report()
+        assert first["step"] > 0
+        assert clock.report() == {}  # report() clears per epoch
+        with clock.phase("step"):
+            pass
+        # snapshot accumulates across report() clears (whole-run goodput).
+        assert clock.snapshot()["step"] >= first["step"]
+        second = clock.report()["step"]
+        assert clock.snapshot()["step"] == pytest.approx(
+            first["step"] + second)
+
+    def test_disabled_clock_is_free(self):
+        clock = WallClock(enabled=False)
+        with clock.phase("step"):
+            pass
+        assert clock.snapshot() == {} and clock.report() == {}
+
+
+class TestFlightReportTool:
+    def test_summarize_and_render(self, tmp_path):
+        from conftest import load_cli_module
+
+        mod = load_cli_module("tools/flight_report.py")
+        r = FlightRecorder(ring_size=16)
+        for s in range(1, 5):
+            r.record_step(s, t=s * 0.02)
+        r.record_flush(4, {"loss": 2.0, "mfu": 0.41,
+                           "mem_peak_bytes": 2.0 * 2 ** 30})
+        r.record_anomaly(4, ["grad-norm spike"])
+        path = str(tmp_path / "f.json")
+        r.dump(path, reason="anomaly",
+               phase_totals={"step": 8.0, "data": 2.0})
+        summary = mod.summarize(mod.FlightRecorder.load(path))
+        assert summary["goodput"] == pytest.approx(0.8)
+        assert summary["last_flush"]["mfu"] == 0.41
+        text = mod.render(summary)
+        assert "p50 20.00 ms" in text
+        assert "goodput: 80.0%" in text
+        assert "grad-norm spike" in text
+        # CLI main round-trips --json
+        import io
+        import contextlib
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            assert mod.main([path, "--json"]) == 0
+        assert json.loads(buf.getvalue())["steps_in_ring"] == 4

@@ -1,0 +1,199 @@
+"""Analytic FLOPs vs XLA's AOT cost analysis, and the MFU plumbing.
+
+The analytic formulas (``observability/flops.py``) count matmul FLOPs
+only (multiply-add = 2), the published MFU convention; XLA's
+``cost_analysis()`` books the same matmuls plus elementwise arithmetic
+(LayerNorm/BN adds, residuals, softmax normalization — transcendentals
+are a separate counter). The cross-check therefore pins a RATIO BAND:
+analytic must land just under XLA's number on matmul-dominated configs —
+close enough to catch a wrong term (any conv/projection miscount is a
+>2x move at these dims), strict enough that analytic never exceeds XLA
+by more than rounding.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.observability.flops import (
+    device_peak_flops,
+    forward_flops,
+    gpt_forward_flops,
+    mfu,
+    resnet_forward_flops,
+    train_step_flops,
+    vit_forward_flops,
+    xla_cost_flops,
+)
+
+
+def _fwd_cost(model, *args, **apply_kwargs):
+    return xla_cost_flops(
+        lambda p, x: model.apply({"params": p}, x, **apply_kwargs),
+        *args)
+
+
+class TestAnalyticVsCostAnalysis:
+    def test_tiny_gpt_forward_agrees(self):
+        # Matmul-dominated dims; exact attention computes the full masked
+        # T^2 score matrix, matching the full-T^2 charging convention.
+        model = get_model("transformer_lm", num_classes=512, num_layers=2,
+                          num_heads=4, hidden_dim=128, max_len=64)
+        tokens = jnp.zeros((1, 64), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        xla = _fwd_cost(model, params, tokens, train=False)
+        assert xla is not None
+        analytic = gpt_forward_flops(
+            num_layers=2, hidden_dim=128, seq_len=64, vocab_size=512,
+            mlp_ratio=4, batch=1)
+        ratio = analytic / xla
+        assert 0.75 <= ratio <= 1.02, (analytic, xla, ratio)
+
+    def test_tiny_resnet_forward_agrees(self):
+        model = get_model("resnet18", num_classes=10, stem="cifar")
+        x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        xla = xla_cost_flops(
+            lambda v, x: model.apply(v, x, train=False), variables, x)
+        assert xla is not None
+        analytic = resnet_forward_flops(
+            "resnet18", image_size=64, num_classes=10, batch=1, stem="cifar")
+        # Analytic sits slightly ABOVE XLA here: the published-convention
+        # count charges every output position x kernel tap, while XLA's
+        # cost analysis excludes the SAME-padding taps that read padding
+        # (measured +4.3% per 3x3 conv at 32^2, growing as spatial dims
+        # shrink). Band asymmetric around 1 accordingly.
+        ratio = analytic / xla
+        assert 0.95 <= ratio <= 1.20, (analytic, xla, ratio)
+
+    def test_tiny_vit_forward_agrees(self):
+        model = get_model("vit_b16", num_classes=10, patch_size=8,
+                          hidden_size=64, num_layers=2, num_heads=4,
+                          mlp_dim=128)
+        x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x, train=False)["params"]
+        xla = _fwd_cost(model, params, x, train=False)
+        assert xla is not None
+        analytic = vit_forward_flops(
+            image_size=32, patch_size=8, hidden_size=64, num_layers=2,
+            mlp_dim=128, num_classes=10, batch=1)
+        ratio = analytic / xla
+        assert 0.70 <= ratio <= 1.02, (analytic, xla, ratio)
+
+
+class TestFormulaProperties:
+    def test_linear_in_batch_accum_awareness(self):
+        # The trainers pass the EFFECTIVE batch (micro x accum x world):
+        # doubling it doubles step FLOPs — accumulation-aware MFU needs
+        # exactly this linearity.
+        one = gpt_forward_flops(num_layers=2, hidden_dim=64, seq_len=32,
+                                vocab_size=128, batch=1)
+        eight = gpt_forward_flops(num_layers=2, hidden_dim=64, seq_len=32,
+                                  vocab_size=128, batch=8)
+        assert eight == pytest.approx(8 * one)
+        assert resnet_forward_flops(
+            "resnet_micro", image_size=32, num_classes=10, batch=4,
+            stem="cifar") == pytest.approx(4 * resnet_forward_flops(
+                "resnet_micro", image_size=32, num_classes=10, batch=1,
+                stem="cifar"))
+
+    def test_step_is_three_forwards(self):
+        assert train_step_flops(10.0) == 30.0
+        assert train_step_flops(None) is None
+
+    def test_instance_dispatch_matches_name_formulas(self):
+        lm = get_model("transformer_lm", num_classes=256, num_layers=3,
+                       num_heads=2, hidden_dim=64, max_len=128)
+        assert forward_flops(lm, seq_len=128, batch=2) == pytest.approx(
+            gpt_forward_flops(num_layers=3, hidden_dim=64, seq_len=128,
+                              vocab_size=256, batch=2))
+        rn = get_model("resnet50", num_classes=1000)
+        assert forward_flops(rn, image_size=224) == pytest.approx(
+            resnet_forward_flops("resnet50", image_size=224,
+                                 num_classes=1000))
+        # ResNet-50's textbook count is ~4.1 GMACs/image; this module
+        # (like XLA and peak-FLOPs specs) charges 2 FLOPs per
+        # multiply-add, so the anchor is ~8.2e9 — a sanity check that the
+        # architecture walk is right, not just internally consistent.
+        assert 7.5e9 < forward_flops(rn, image_size=224) < 8.8e9
+
+    def test_moe_lm_reports_none(self):
+        moe = get_model("transformer_lm", num_classes=256, num_layers=2,
+                        num_heads=2, hidden_dim=64, max_len=64,
+                        moe_num_experts=4, moe_every=1)
+        assert forward_flops(moe, seq_len=64) is None
+
+    def test_missing_dims_raise(self):
+        lm = get_model("transformer_lm", num_classes=256, num_layers=1,
+                       num_heads=2, hidden_dim=64, max_len=64)
+        with pytest.raises(ValueError, match="seq_len"):
+            forward_flops(lm)
+
+
+class TestMfu:
+    def test_peak_env_override(self, monkeypatch):
+        monkeypatch.setenv("OBS_PEAK_FLOPS", "1e12")
+        assert device_peak_flops() == 1e12
+
+    def test_cpu_peak_unknown(self, monkeypatch):
+        monkeypatch.delenv("OBS_PEAK_FLOPS", raising=False)
+        # The virtual test devices are CPU: no peak, so MFU is honestly
+        # absent rather than a guessed number.
+        assert device_peak_flops(jax.devices()[0]) is None
+
+    def test_known_kind_table(self):
+        class FakeDev:
+            device_kind = "TPU v5 lite"
+
+        assert device_peak_flops(FakeDev()) == 197e12
+
+    def test_mfu_math(self):
+        assert mfu(100e12, 2, 250e12) == pytest.approx(0.2)
+        assert mfu(100e12, 2, None) is None
+
+
+class TestStepCostAnalysis:
+    def test_lm_step_lower_hook_cost_analysis(self, mesh):
+        """The AOT ``.lower`` hook the factories expose feeds the same
+        cross-check at the STEP level: one fwd+bwd+Adam program books
+        more than the model forward alone, in the right ballpark of 3x
+        forward + optimizer elementwise."""
+        import optax
+
+        from distributed_training_tpu.config import PrecisionConfig
+        from distributed_training_tpu.train.lm_step import (
+            make_lm_batch,
+            make_tp_lm_train_step,
+        )
+        from distributed_training_tpu.train.precision import LossScaleState
+        from distributed_training_tpu.train.train_state import (
+            init_train_state,
+        )
+
+        model = get_model("transformer_lm", num_classes=512, num_layers=2,
+                          num_heads=4, hidden_dim=128, max_len=64)
+        state = init_train_state(
+            model, jax.random.PRNGKey(0), (1, 8), optax.sgd(0.1),
+            loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+            input_dtype=jnp.int32)
+        step = make_tp_lm_train_step(mesh, model=model)
+        toks = np.zeros((8, 65), np.int32)
+        batch = jax.device_put(
+            {k: jnp.asarray(v) for k, v in make_lm_batch(toks).items()},
+            step.batch_shardings)
+        compiled = step.lower(state, batch, jax.random.PRNGKey(0)).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        # The partitioned program's cost analysis is PER DEVICE (the
+        # batch is sharded 8 ways over the mesh); scale back to global.
+        xla = float(ca["flops"]) * mesh.devices.size
+        fwd = gpt_forward_flops(num_layers=2, hidden_dim=128, seq_len=64,
+                                vocab_size=512, batch=8)
+        # Step >= ~3x forward (XLA adds optimizer/elementwise work); and
+        # the analytic step number stays within 2x of what XLA booked.
+        assert xla > 2.4 * fwd, (xla, fwd)
+        assert train_step_flops(fwd) == pytest.approx(3 * fwd)
+        assert train_step_flops(fwd) / xla > 0.5

@@ -1,0 +1,163 @@
+"""The no-hidden-transfer hot-loop contract, pinned with jax.transfer_guard.
+
+``utils/logging.py`` claims the steady-state step never waits on the
+host: metrics stay device-resident and only the meter's ``log_interval``
+flush fetches them (explicitly, via ``jax.device_get``). These tests make
+that claim a regression gate: the whole between-flush window — step
+calls, rng splits, meter pushes, observability's ``on_step``/``on_flush``
+— runs under ``jax.transfer_guard("disallow")``, which errors on any
+IMPLICIT transfer while permitting the explicit flush-time ``device_get``
+that IS the contract.
+
+What the guard can observe depends on the backend. On the virtual CPU
+mesh, device buffers ARE host memory, so a device→host fetch is
+zero-copy and invisible to the guard — but every *implicit host→device*
+upload (a numpy batch fed straight to the step, a python-scalar constant
+materialized per step) is caught, and those are exactly the per-step
+transfers a sloppy loop hides. On a real accelerator the same wrapper
+additionally rejects implicit device→host fetches (the reference's
+per-step ``loss.item()``, SURVEY.md §2.5). The loop code under test is
+the trainers' window verbatim: rng state created once at init (like
+``Trainer.rng``), batches explicitly placed, metrics pushed by
+reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from distributed_training_tpu.config import (
+    ObservabilityConfig,
+    PrecisionConfig,
+)
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.observability import TrainObservability
+from distributed_training_tpu.parallel.sharding import (
+    batch_sharding,
+    place_state,
+    state_shardings,
+)
+from distributed_training_tpu.train.precision import LossScaleState
+from distributed_training_tpu.train.train_state import init_train_state
+from distributed_training_tpu.utils.logging import MetricMeter
+
+
+def _image_setup(mesh, grad_norm_metric=False):
+    from distributed_training_tpu.train.step import make_train_step
+
+    model = get_model("resnet_micro", num_classes=10, stem="cifar")
+    state = init_train_state(
+        model, jax.random.PRNGKey(0), (8, 8, 8, 3), optax.sgd(0.1),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")))
+    state = place_state(state, state_shardings(state, mesh, 0))
+    step = make_train_step(mesh, grad_norm_metric=grad_norm_metric)
+    rng = np.random.RandomState(0)
+    host_batch = {"image": rng.rand(8, 8, 8, 3).astype(np.float32),
+                  "label": rng.randint(0, 10, 8).astype(np.int32)}
+    batch = jax.device_put(
+        host_batch,
+        {"image": batch_sharding(mesh, 4), "label": batch_sharding(mesh, 1)})
+    return state, step, batch, host_batch
+
+
+def _lm_setup(mesh):
+    from distributed_training_tpu.train.lm_step import (
+        make_lm_batch,
+        make_tp_lm_train_step,
+    )
+
+    model = get_model("transformer_lm", num_classes=64, num_layers=1,
+                      num_heads=2, hidden_dim=32, max_len=32)
+    state = init_train_state(
+        model, jax.random.PRNGKey(0), (1, 8), optax.sgd(0.1),
+        loss_scale=LossScaleState.create(PrecisionConfig(dtype="fp32")),
+        input_dtype=jnp.int32)
+    step = make_tp_lm_train_step(mesh, model=model, grad_norm_metric=True)
+    toks = np.random.RandomState(0).randint(0, 64, (8, 17)).astype(np.int32)
+    batch = jax.device_put(
+        {k: jnp.asarray(v) for k, v in make_lm_batch(toks).items()},
+        step.batch_shardings)
+    return state, step, batch
+
+
+def _steady_loop(state, step, batch, key, meter, obs=None, steps=4):
+    """The trainers' between-flush window, verbatim in miniature. ``key``
+    comes from outside (the trainers create ``self.rng`` ONCE at init —
+    a per-step ``PRNGKey(seed)`` would itself be an implicit upload)."""
+    flushed = None
+    for i in range(steps):
+        key, step_rng = jax.random.split(key)
+        state, metrics = step(state, batch, step_rng)
+        fetched = meter.push(i + 1, metrics)
+        if obs is not None:
+            obs.on_step(i + 1)
+        if fetched:
+            flushed = dict(meter.last)
+            if obs is not None:
+                obs.on_flush(flushed)
+    return state, flushed
+
+
+class TestHotLoopNoHiddenTransfers:
+    def test_image_step_between_flushes(self, mesh):
+        state, step, batch, _ = _image_setup(mesh, grad_norm_metric=True)
+        key = jax.random.PRNGKey(1)
+        state, _ = step(state, batch, key)  # compile outside the guard
+        meter = MetricMeter(log_interval=4)
+        with jax.transfer_guard("disallow"):
+            state, flushed = _steady_loop(state, step, batch, key, meter)
+        assert flushed is not None
+        assert np.isfinite(flushed["loss"])
+        assert np.isfinite(flushed["grad_norm"])
+
+    def test_lm_step_between_flushes(self, mesh):
+        state, step, batch = _lm_setup(mesh)
+        key = jax.random.PRNGKey(1)
+        state, _ = step(state, batch, key)
+        meter = MetricMeter(log_interval=4)
+        with jax.transfer_guard("disallow"):
+            state, flushed = _steady_loop(state, step, batch, key, meter)
+        assert flushed is not None
+        assert np.isfinite(flushed["loss"])
+        assert np.isfinite(flushed["perplexity"])
+
+    def test_observability_hooks_add_no_transfers(self, mesh):
+        """on_step (ring write) and on_flush (reads already-fetched host
+        floats + allocator counters) stay clean under the guard too."""
+        state, step, batch, _ = _image_setup(mesh)
+        key = jax.random.PRNGKey(1)
+        state, _ = step(state, batch, key)
+        meter = MetricMeter(log_interval=2)
+        obs = TrainObservability(
+            ObservabilityConfig(grad_norm=False), step_flops=1e6,
+            n_devices=mesh.devices.size)
+        with jax.transfer_guard("disallow"):
+            state, flushed = _steady_loop(
+                state, step, batch, key, meter, obs=obs, steps=4)
+        assert flushed is not None
+        assert len(obs.recorder) == 4
+
+    def test_guard_catches_unplaced_host_batch(self, mesh):
+        """Negative control — proof the positive tests can fail: feeding
+        a HOST numpy batch straight to the step (skipping the explicit
+        device_put the data layer does) is an implicit per-step upload
+        and the guard rejects it."""
+        state, step, batch, host_batch = _image_setup(mesh)
+        key = jax.random.PRNGKey(1)
+        state, _ = step(state, batch, key)
+        with jax.transfer_guard("disallow"):
+            with pytest.raises(Exception, match="[Dd]isallow"):
+                step(state, host_batch, key)
+
+    def test_explicit_flush_fetch_is_permitted(self, mesh):
+        """The meter's device_get at flush is EXPLICIT and allowed —
+        explicit fetches at log intervals are the contract, not a
+        violation of it."""
+        state, step, batch, _ = _image_setup(mesh)
+        state, metrics = step(state, batch, jax.random.PRNGKey(1))
+        meter = MetricMeter(log_interval=1)
+        with jax.transfer_guard("disallow"):
+            fetched = meter.push(1, metrics)
+        assert fetched and np.isfinite(meter.last["loss"])

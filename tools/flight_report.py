@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Summarize a flight-recorder dump (observability/flight_recorder.py).
+
+The human end of the flight recorder: trainers (and the anomaly/crash
+paths) write ``*_flight.json`` ring dumps; this renders one into the
+questions an on-call actually asks — how fast were steps, where did the
+wall-time go, what did the last metrics look like, and what tripped.
+
+    python tools/flight_report.py flight/anomaly_step12_flight.json
+    python tools/flight_report.py --json flight/flight_crash.json
+
+``--json`` re-emits the summary as one machine-readable object (for
+dashboards / the driver), same fields as the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Script-style tools/ dir (like tools/profile_step.py): make the package
+# importable when run from the repo root or the tools dir.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_training_tpu.observability.flight_recorder import (  # noqa: E402
+    FlightRecorder,
+)
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.2f} {unit}"
+        n /= 1024.0
+    return f"{n:.2f} TiB"  # pragma: no cover
+
+
+def summarize(snap: dict) -> dict:
+    """Flatten a flight snapshot into the report's field set."""
+    out: dict = {
+        "reason": snap.get("reason"),
+        "steps_in_ring": len(snap.get("steps", [])),
+        "steps_recorded_total": snap.get("steps_recorded_total"),
+    }
+    steps = snap.get("steps") or []
+    if steps:
+        out["first_step"], out["last_step"] = steps[0][0], steps[-1][0]
+        out["ring_wall_seconds"] = steps[-1][1] - steps[0][1]
+    out.update(snap.get("step_time_stats") or {})
+    wc = snap.get("wall_clock") or {}
+    if wc:
+        out["goodput"] = wc.get("goodput")
+        out["phase_fraction"] = wc.get("phase_fraction")
+        out["tracked_seconds"] = wc.get("tracked_seconds")
+    flushes = snap.get("flushes") or []
+    if flushes:
+        out["last_flush"] = flushes[-1]
+    out["anomalies"] = snap.get("anomalies") or []
+    return out
+
+
+def render(summary: dict) -> str:
+    lines = []
+    add = lines.append
+    add(f"flight record: reason={summary['reason']!r}  "
+        f"ring={summary['steps_in_ring']} steps "
+        f"(of {summary['steps_recorded_total']} recorded)")
+    if "first_step" in summary:
+        add(f"  window: steps {summary['first_step']}..{summary['last_step']}"
+            f" over {summary['ring_wall_seconds']:.2f}s")
+    if "step_time_p50_ms" in summary:
+        add(f"  step time: p50 {summary['step_time_p50_ms']:.2f} ms  "
+            f"p95 {summary['step_time_p95_ms']:.2f} ms  "
+            f"max {summary['step_time_max_ms']:.2f} ms")
+    if summary.get("goodput") is not None:
+        frac = summary.get("phase_fraction") or {}
+        body = "  ".join(f"{k} {v:.1%}" for k, v in sorted(
+            frac.items(), key=lambda kv: -kv[1]))
+        add(f"  goodput: {summary['goodput']:.1%} of "
+            f"{summary['tracked_seconds']:.1f}s tracked  ({body})")
+    last = summary.get("last_flush")
+    if last:
+        keys = ("loss", "perplexity", "accuracy", "grad_norm", "mfu",
+                "model_flops_per_sec", "loss_scale", "grads_finite")
+
+        def fmt(v):  # non-finite values arrive as 'nan'/'inf' strings
+            return f"{v:.4g}" if isinstance(v, (int, float)) else str(v)
+
+        body = "  ".join(f"{k}={fmt(last[k])}" for k in keys if k in last)
+        add(f"  last flush (step {last.get('step')}): {body}")
+        if "mem_peak_bytes" in last:
+            add(f"  device memory: in-use "
+                f"{_fmt_bytes(last.get('mem_bytes_in_use', 0))}  "
+                f"peak {_fmt_bytes(last['mem_peak_bytes'])}")
+    if summary["anomalies"]:
+        add("  ANOMALIES:")
+        for a in summary["anomalies"]:
+            add(f"    step {a['step']}: " + "; ".join(a["reasons"]))
+    else:
+        add("  anomalies: none")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a flight-recorder JSON dump")
+    ap.add_argument("path", help="flight JSON written by the trainers / "
+                                 "TrainObservability.dump()")
+    ap.add_argument("--json", action="store_true", default=False,
+                    help="emit the summary as one JSON object")
+    args = ap.parse_args(argv)
+    snap = FlightRecorder.load(args.path)
+    summary = summarize(snap)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
