@@ -275,6 +275,63 @@ class ObservabilityConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Continuous-batching inference engine knobs (``serving/``).
+
+    Everything here is static shape-wise: the engine compiles ONE decode
+    step for ``max_batch`` slots × ``max_len`` cache positions and a small
+    bucketed family of prefill programs, then serves any request mix
+    without retracing (finished sequences leave via per-slot active masks,
+    not shape changes).
+    """
+
+    # Decode slots: sequences decoded together per iteration. Freed slots
+    # refill from the queue at iteration boundaries (Orca-style
+    # iteration-level scheduling).
+    max_batch: int = 8
+    # Per-slot KV-cache positions (prompt + generated). None → the model's
+    # max_len; smaller caps shrink the slot cache and tighten admission
+    # (inference/sampler.py::cache_budget).
+    max_len: int | None = None
+    # Default completion budget per request (requests may ask for less).
+    max_new_tokens: int = 128
+    # Sampling transforms (sampler.py semantics; 0 temperature = greedy).
+    temperature: float = 0.0
+    top_k: int | None = None
+    top_p: float | None = None
+    eos_id: int | None = None
+    pad_id: int = 0
+    # Prompts pad up to a multiple of this for prefill, so the engine
+    # compiles at most max_len/prefill_bucket prefill programs instead of
+    # one per distinct prompt length. Pad K/V writes are zeroed and the
+    # write head rewound to the true length, so padding never changes a
+    # single emitted token (pinned by tests/test_serving.py).
+    prefill_bucket: int = 64
+    # SLA telemetry: flight-recorder ring size (one entry per decode
+    # iteration) and iterations between metric flushes into it.
+    ring_size: int = 4096
+    flush_every: int = 32
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.prefill_bucket < 1:
+            raise ValueError(
+                f"prefill_bucket must be >= 1, got {self.prefill_bucket}")
+        if self.flush_every < 1:
+            raise ValueError(
+                f"flush_every must be >= 1, got {self.flush_every}")
+        if self.max_len is not None and self.max_len < 2:
+            raise ValueError(
+                f"max_len must be >= 2 (one prompt token + one generated), "
+                f"got {self.max_len}")
+
+
+@dataclasses.dataclass(frozen=True)
 class MeshSpec:
     """Logical mesh axis sizes; -1 infers from device count."""
 

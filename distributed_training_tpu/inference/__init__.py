@@ -5,9 +5,11 @@ from distributed_training_tpu.inference.beam import (  # noqa: F401
     BeamSearcher,
 )
 from distributed_training_tpu.inference.sampler import (  # noqa: F401
+    CacheBudgetError,
     Generator,
     SampleConfig,
     apply_top_k,
     apply_top_p,
+    cache_budget,
     sample_token,
 )

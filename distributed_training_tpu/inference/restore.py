@@ -1,0 +1,126 @@
+"""Checkpoint → inference-params restore shared by the gpt/jax_tpu CLIs.
+
+``generate.py`` and ``serve.py`` need the identical sequence — build the
+model with training-mirrored flags, build the TEMPLATE train state with
+the same optimizer factory (including the EMA wrapper when the training
+run used ``--ema-decay``, so the orbax opt-state tree round-trips),
+restore the requested/latest epoch, and pick raw or EMA params. Keeping
+it here means restore-contract changes (like the round-5 head-bias
+default flip this error message names) happen once, not per CLI.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping
+
+
+def moe_kwargs_from_flags(*, enabled: bool, num_experts, top_k: int,
+                          min_capacity: int, mlp_type: str) -> dict:
+    """The ``--moe`` CLI flag family → model kwargs (one definition for
+    both CLIs — a drifted copy would silently fail checkpoint restore
+    with a pytree mismatch). Per-layer ``num_experts`` lists build the
+    same per-layer architecture training used
+    (``models/gpt.py::moe_layer_experts``), so checkpoints trained with
+    e.g. ``--num-experts 4 8`` restore with the matching flags."""
+    if not enabled:
+        return {}
+    return dict(
+        moe_num_experts=tuple(int(n) for n in num_experts),
+        moe_top_k=int(top_k),
+        moe_min_capacity=int(min_capacity),
+        moe_mlp_type=mlp_type,
+    )
+
+
+def build_lm_and_restore(
+    *,
+    vocab_size: int = 256,
+    num_layers: int = 4,
+    num_heads: int = 4,
+    hidden_dim: int = 256,
+    max_len: int = 2048,
+    dtype: str = "fp32",
+    head_bias: bool = False,
+    logits_dtype: str = "bf16",
+    moe_kwargs: Mapping[str, Any] | None = None,
+    checkpoint: str = "./checkpoint",
+    resume: int = -1,
+    ema_decay: float | None = None,
+    use_ema: bool = False,
+    seed: int = 0,
+    printer: Callable[[str], None] = print,
+) -> tuple[Any, Any, int]:
+    """Returns ``(model, params, epoch)``; ``epoch`` is -1 when no
+    checkpoint existed (params are then the seeded random init).
+
+    Raises ``SystemExit`` with an actionable message on a tree-mismatch
+    restore failure or an ``use_ema`` request without the matching
+    ``ema_decay`` (the flags must mirror training for the template state
+    to match the checkpoint).
+    """
+    import jax
+
+    from distributed_training_tpu import checkpoint as ckpt_lib
+    from distributed_training_tpu.config import (
+        OptimizerConfig,
+        PrecisionConfig,
+        SchedulerConfig,
+    )
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.train.lm_step import parse_logits_dtype
+    from distributed_training_tpu.train.optim import make_optimizer
+    from distributed_training_tpu.train.precision import LossScaleState, Policy
+    from distributed_training_tpu.train.train_state import init_train_state
+
+    if use_ema and ema_decay is None:
+        raise SystemExit("--use-ema requires --ema-decay (mirror training)")
+
+    precision = PrecisionConfig(dtype=dtype)
+    model = get_model(
+        "transformer_lm",
+        num_classes=vocab_size,
+        dtype=Policy.from_config(precision).compute_dtype,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        hidden_dim=hidden_dim,
+        max_len=max_len,
+        head_bias=head_bias,
+        logits_dtype=parse_logits_dtype(logits_dtype),
+        **dict(moe_kwargs or {}),
+    )
+    tx = make_optimizer(OptimizerConfig(ema_decay=ema_decay),
+                        SchedulerConfig(), world_size=1)
+    state = init_train_state(
+        model, jax.random.PRNGKey(seed), (1, 8), tx,
+        loss_scale=LossScaleState.create(precision),
+        input_dtype=jax.numpy.int32)
+
+    epoch = resume
+    if epoch < 0:
+        latest = ckpt_lib.latest_epoch(checkpoint)
+        epoch = -1 if latest is None else latest
+    if epoch >= 0:
+        try:
+            state, _, _ = ckpt_lib.restore_checkpoint(checkpoint, epoch, state)
+        except Exception as e:
+            # The most common tree mismatch after round 5 is the head-bias
+            # default flip: pre-round-5 checkpoints carry an lm_head bias
+            # the new bias-less template lacks. Name the flag instead of
+            # leaving the user to decode a pytree-structure error.
+            raise SystemExit(
+                f"checkpoint restore failed — model flags must mirror the "
+                f"training run. Most likely: this build defaults to NO "
+                f"lm_head bias (round 5); pass --head-bias for checkpoints "
+                f"trained before that (or check --num-layers/--hidden-dim/"
+                f"--moe flags). Original error: {e}") from e
+        printer(f"restored epoch {epoch} from {checkpoint}")
+    else:
+        printer("no checkpoint found; using the seeded random init")
+
+    params = state.params
+    if use_ema:
+        from distributed_training_tpu.train.optim import ema_params
+
+        params = ema_params(state.opt_state)
+        printer("sampling from EMA parameter average")
+    return model, params, epoch

@@ -98,12 +98,40 @@ def check_unsharded(model: Any) -> None:
             "with seq_axis=None (params are layout-identical)")
 
 
+class CacheBudgetError(ValueError):
+    """A request's token footprint does not fit the KV cache.
+
+    Subclasses ``ValueError`` so pre-existing callers that catch the old
+    bare error keep working; serving admission catches this type to turn
+    an oversized request into a rejection instead of a crash.
+    """
+
+
+def cache_budget(model: Any, max_len: int | None = None) -> int:
+    """Token capacity of one sequence's KV cache (prompt + generated).
+
+    The hard ceiling is ``model.max_len`` — cache slots past the
+    positional table would decode at silently-clamped pos-embed rows
+    (``models/gpt.py`` poisons that case). ``max_len`` optionally caps it
+    further: the serving engine allocates that many slots per decode slot
+    and admits only requests whose whole lifetime fits.
+    """
+    budget = int(model.max_len)
+    if max_len is not None:
+        if max_len < 1:
+            raise ValueError(f"max_len must be >= 1, got {max_len}")
+        budget = min(budget, int(max_len))
+    return budget
+
+
 def check_cache_fits(model: Any, prompt_len: int, max_new_tokens: int) -> None:
+    """Thin wrapper over :func:`cache_budget` for the generate-call shape."""
     total = prompt_len + max_new_tokens
-    if total > model.max_len:
-        raise ValueError(
+    budget = cache_budget(model)
+    if total > budget:
+        raise CacheBudgetError(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) = "
-            f"{total} exceeds the KV cache (max_len={model.max_len})")
+            f"{total} exceeds the KV cache (max_len={budget})")
 
 
 class Generator:

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from distributed_training_tpu.parallel.ring_attention import RingSelfAttention
@@ -282,6 +283,31 @@ class TransformerLM(nn.Module):
         if return_hidden:
             return x
         return make_lm_head(self, name="lm_head")(x)
+
+
+def init_decode_cache(model: "TransformerLM", params: Any,
+                      batch_size: int = 1):
+    """Empty KV-cache pytree for ``decode=True`` without running a forward.
+
+    ``jax.eval_shape`` traces a one-token decode apply (no FLOPs, no
+    allocation) to learn the cache structure — per block:
+    ``cached_key``/``cached_value`` [B, cache_len, H, hd] plus the scalar
+    ``cache_index`` write head — then materializes zeros. A zero cache with
+    index 0 is exactly the state a prefill starts from, so the serving
+    engine (``serving/engine.py``) stacks one of these per decode slot and
+    scatters freshly-prefilled caches into freed slots without ever
+    tracing a throwaway forward.
+    """
+
+    def shape_fn(p):
+        toks = jnp.zeros((batch_size, 1), jnp.int32)
+        _, vars_out = model.apply(
+            {"params": p}, toks, positions=jnp.zeros_like(toks),
+            train=False, decode=True, mutable=["cache"])
+        return vars_out["cache"]
+
+    shapes = jax.eval_shape(shape_fn, params)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
 
 
 def make_transformer_lm(

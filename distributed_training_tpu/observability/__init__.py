@@ -23,6 +23,12 @@ hardware-utilization and forensics layer a production trainer needs:
   trainers (and bench) drive; it owns the no-new-syncs contract: every
   input it reads is either a host timestamp or a value the meter already
   fetched.
+
+The serving engine (``serving/metrics.py``) rides the same flight
+recorder for its SLA telemetry: decode iterations are recorded as steps
+(so ``step_time_*`` stats become per-iteration decode latency) and its
+dumps carry a ``serving`` section that ``tools/flight_report.py``
+renders alongside the training fields.
 """
 
 from distributed_training_tpu.observability.anomaly import (  # noqa: F401

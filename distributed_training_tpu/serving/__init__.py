@@ -1,0 +1,31 @@
+"""Serving subsystem: continuous-batching inference over the KV cache.
+
+The first consumer-facing layer of the framework (ROADMAP north star:
+"serves heavy traffic from millions of users"). Orca-style
+iteration-level batching + vLLM-style fixed-slot cache management,
+restated for XLA's static-shape world:
+
+- :mod:`queue` — thread-safe arrival-ordered admission with a per-request
+  cache-budget guard (typed rejection, not a wedged queue head).
+- :mod:`scheduler` — fixed decode slots; FIFO refill and EOS/length
+  eviction at iteration boundaries; active masks instead of shape changes.
+- :mod:`engine` — the compiled prefill/scatter/decode trio over a
+  slot-axis KV-cache pytree, and the admit→prefill→decode→evict loop.
+- :mod:`metrics` — TTFT/TPOT/throughput/queue-depth SLA telemetry through
+  the round-7 flight recorder.
+
+Surfaces: ``gpt/jax_tpu/serve.py`` (interactive/file serving CLI) and
+``tools/serve_bench.py`` (Poisson load generator). See docs/SERVING.md.
+"""
+
+from distributed_training_tpu.serving.engine import Engine  # noqa: F401
+from distributed_training_tpu.serving.metrics import ServeTelemetry  # noqa: F401
+from distributed_training_tpu.serving.queue import RequestQueue  # noqa: F401
+from distributed_training_tpu.serving.request import (  # noqa: F401
+    FINISH_EOS,
+    FINISH_LENGTH,
+    ActiveSequence,
+    FinishedRequest,
+    Request,
+)
+from distributed_training_tpu.serving.scheduler import SlotScheduler  # noqa: F401

@@ -1,0 +1,316 @@
+"""Continuous-batching inference engine: admit → prefill → decode → evict.
+
+The training stack's decode loop (``inference/sampler.py``) compiles one
+``generate`` program per prompt: great latency for one user, zero
+batching across users. This engine turns the same
+``RingSelfAttention._decode_attend`` KV cache into a multi-tenant server
+with THREE compiled programs total (one bucketed prefill family, one
+slot scatter, one decode step), all static-shape:
+
+- **Slot-axis cache.** The per-sequence cache pytree (per block:
+  ``cached_key``/``cached_value`` [1, cache_len, H, hd] + scalar
+  ``cache_index``) gains a leading slot axis via
+  ``models/gpt.py::init_decode_cache`` + stacking: leaves become
+  [max_batch, 1, cache_len, H, hd] and the write heads [max_batch]. The
+  decode step ``jax.vmap``s the model's single-sequence decode over that
+  axis, so every slot keeps its OWN cache length counter — the exact
+  per-slot state continuous batching needs, with zero model changes.
+- **Bucketed prefill.** A request's prompt pads up to a multiple of
+  ``prefill_bucket`` and prefills at batch 1; pad K/V writes are zeroed
+  and the write head rewound to the true length afterwards, so the
+  emitted tokens are untouched by padding (causal masking already kept
+  the real-token logits exact) while the engine compiles at most
+  ``budget / prefill_bucket`` prefill shapes.
+- **Iteration-level scheduling.** At each iteration boundary the
+  :class:`SlotScheduler` evicts finished sequences (EOS / length budget)
+  and refills freed slots FIFO from the :class:`RequestQueue`; the
+  decode step then advances every active slot one token. Slot membership
+  is a boolean mask — shapes never change, nothing retraces.
+- **Lane independence = bitwise determinism.** Each vmap lane runs the
+  identical single-sequence program regardless of which other requests
+  share the batch, and sampling RNG is ``fold_in(fold_in(seed, uid),
+  position)`` — a pure function of the request and position. A request's
+  tokens are therefore bitwise independent of batch composition (pinned
+  by ``tests/test_serving.py``).
+
+SLA telemetry (TTFT / TPOT / throughput / queue depth) flows through the
+round-7 flight recorder via :class:`ServeTelemetry`; ``dump_flight``
+writes a ``tools/flight_report.py``-readable record.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from distributed_training_tpu.config import ServeConfig
+from distributed_training_tpu.inference.sampler import (
+    SampleConfig,
+    cache_budget,
+    check_unsharded,
+    sample_token,
+)
+from distributed_training_tpu.models.gpt import init_decode_cache
+from distributed_training_tpu.serving.metrics import ServeTelemetry
+from distributed_training_tpu.serving.queue import RequestQueue
+from distributed_training_tpu.serving.request import FinishedRequest, Request
+from distributed_training_tpu.serving.scheduler import SlotScheduler
+
+
+class Engine:
+    """Continuous-batching serving engine for a :class:`TransformerLM`.
+
+    >>> eng = Engine(model, params, ServeConfig(max_batch=8))
+    >>> eng.submit(prompt_tokens)
+    >>> done = eng.run()          # list[FinishedRequest]
+    >>> eng.stats()               # SLA summary dict
+
+    Thread model: ``submit`` is safe from any thread (the queue locks);
+    ``step``/``run`` belong to one serving thread.
+    """
+
+    def __init__(self, model: Any, params: Any, cfg: ServeConfig):
+        check_unsharded(model)
+        self.cfg = cfg
+        self.budget = cache_budget(model, cfg.max_len)
+        if self.budget < 2:
+            raise ValueError(
+                f"cache budget {self.budget} cannot hold a prompt token "
+                f"plus a generated token")
+        # One clone with the serving cache length; every compiled program
+        # below derives its shapes from it.
+        self.model = model.clone(cache_len=self.budget)
+        self.params = params
+        self.sample_cfg = SampleConfig(
+            max_new_tokens=cfg.max_new_tokens,
+            temperature=cfg.temperature, top_k=cfg.top_k, top_p=cfg.top_p,
+            eos_id=cfg.eos_id, pad_id=cfg.pad_id)
+        self.queue = RequestQueue(
+            self.budget, default_max_new_tokens=cfg.max_new_tokens)
+        self.scheduler = SlotScheduler(cfg.max_batch)
+        self.telemetry = ServeTelemetry(cfg.ring_size)
+        self._base_rng = jax.random.PRNGKey(cfg.seed)
+        self._iteration = 0
+
+        # Slot-axis device state. The stacked cache comes from the model's
+        # own structure (init_decode_cache), so scatters from prefill
+        # results are structure-identical by construction.
+        s = cfg.max_batch
+        single = init_decode_cache(self.model, params, batch_size=1)
+        self._cache = jax.tree.map(
+            lambda leaf: jnp.zeros((s,) + leaf.shape, leaf.dtype), single)
+        self._tok = jnp.zeros((s,), jnp.int32)    # last emitted token/slot
+        self._pos = jnp.zeros((s,), jnp.int32)    # cache write head/slot
+        self._rngs = jnp.zeros((s,) + self._base_rng.shape,
+                               self._base_rng.dtype)
+
+        # Donation keeps one slot-cache resident instead of two per decode
+        # step; the CPU backend can't donate (it would only warn noisily).
+        donate = jax.default_backend() != "cpu"
+        self._prefill = jax.jit(self._prefill_impl)
+        self._admit = jax.jit(
+            self._admit_impl,
+            donate_argnums=(0, 1, 2, 3) if donate else ())
+        self._decode = jax.jit(
+            self._decode_impl,
+            donate_argnums=(1, 2, 3) if donate else ())
+
+    # -- compiled pieces -----------------------------------------------------
+    def _prefill_impl(self, params, prompt, true_len, rng):
+        """[1, Lb] padded prompt → (single-sequence cache, first token).
+
+        Retraces once per padded length Lb (bucketed by the caller). The
+        pad positions' K/V writes are zeroed and the write head rewound to
+        ``true_len``: the cache leaves the call exactly as an unpadded
+        prefill would have left it, so decode math downstream is
+        bitwise-independent of the bucket size.
+        """
+        lb = prompt.shape[1]
+        positions = jnp.arange(lb)[None, :]
+        logits, vars_out = self.model.apply(
+            {"params": params}, prompt, positions=positions,
+            train=False, decode=True, mutable=["cache"])
+
+        def fix(leaf):
+            if leaf.ndim == 0:  # per-block cache_index write head
+                return true_len.astype(leaf.dtype)
+            # [1, cache_len, H, hd]: zero every position >= true_len.
+            pos_ax = jnp.arange(leaf.shape[1]).reshape(
+                (1, -1) + (1,) * (leaf.ndim - 2))
+            return jnp.where(pos_ax >= true_len,
+                             jnp.zeros((), leaf.dtype), leaf)
+
+        cache = jax.tree.map(fix, vars_out["cache"])
+        last = lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=1)
+        tok = sample_token(jax.random.fold_in(rng, true_len - 1),
+                           last[:, 0, :], self.sample_cfg)[0]
+        return cache, tok
+
+    def _admit_impl(self, cache, tok, pos, rngs, slot, new_cache,
+                    first_tok, true_len, rng):
+        """Scatter one prefilled sequence into decode slot ``slot``."""
+        cache = jax.tree.map(
+            lambda big, small: lax.dynamic_update_index_in_dim(
+                big, small, slot, 0),
+            cache, new_cache)
+        tok = tok.at[slot].set(first_tok)
+        pos = pos.at[slot].set(true_len)
+        rngs = rngs.at[slot].set(rng)
+        return cache, tok, pos, rngs
+
+    def _decode_impl(self, params, cache, tok, pos, active, rngs):
+        """One token for every active slot; inactive lanes are frozen.
+
+        The vmap gives each slot its own scalar ``cache_index`` trajectory
+        — the per-slot cache length counter that lets sequences of
+        different ages share one compiled step. Inactive lanes still
+        compute (vmap has no ragged skip) but their cache/pos/token
+        updates are discarded by the mask select, so a freed slot stays
+        bitwise intact until the next admission overwrites it.
+        """
+
+        def lane(cache_s, tok_s, pos_s, rng_s):
+            logits, vars_out = self.model.apply(
+                {"params": params, "cache": cache_s},
+                tok_s[None, None], positions=pos_s[None, None],
+                train=False, decode=True, mutable=["cache"])
+            nxt = sample_token(jax.random.fold_in(rng_s, pos_s),
+                               logits[:, -1, :], self.sample_cfg)[0]
+            return vars_out["cache"], nxt
+
+        new_cache, nxt = jax.vmap(lane)(cache, tok, pos, rngs)
+
+        def keep(new, old):
+            mask = active.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(mask, new, old)
+
+        new_cache = jax.tree.map(keep, new_cache, cache)
+        nxt = jnp.where(active, nxt, jnp.int32(self.sample_cfg.pad_id))
+        pos = jnp.where(active, pos + 1, pos)
+        return new_cache, nxt, pos
+
+    # -- host-side lifecycle -------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int | None = None,
+               arrival_t: float | None = None) -> Request:
+        """Enqueue a request (thread-safe). Raises
+        :class:`~distributed_training_tpu.inference.sampler.
+        CacheBudgetError` when it can never fit a slot."""
+        return self.queue.submit(prompt, max_new_tokens=max_new_tokens,
+                                 arrival_t=arrival_t)
+
+    @property
+    def idle(self) -> bool:
+        return len(self.queue) == 0 and self.scheduler.num_active == 0
+
+    def _bucket(self, n: int) -> int:
+        b = self.cfg.prefill_bucket
+        return min(self.budget, -(-n // b) * b)
+
+    def _prefill_request(self, seq) -> None:
+        req = seq.request
+        n = req.prompt.size
+        padded = np.full((1, self._bucket(n)), self.sample_cfg.pad_id,
+                         np.int32)
+        padded[0, :n] = req.prompt
+        req_rng = jax.random.fold_in(self._base_rng, req.uid)
+        new_cache, tok = self._prefill(
+            self.params, jnp.asarray(padded), jnp.int32(n), req_rng)
+        self._cache, self._tok, self._pos, self._rngs = self._admit(
+            self._cache, self._tok, self._pos, self._rngs,
+            jnp.int32(seq.slot), new_cache, tok, jnp.int32(n), req_rng)
+        first = int(tok)  # the one deliberate sync: TTFT is measured here
+        t = time.perf_counter()
+        seq.note_token(first, t)
+        self.telemetry.on_tokens(1, t)
+
+    def step(self) -> list[FinishedRequest]:
+        """One engine iteration: admit+prefill, decode, evict.
+
+        Returns the requests that finished this iteration. Safe to call
+        when idle (records an excluded gap and returns [])."""
+        it = self._iteration
+        self._iteration += 1
+        eos = self.sample_cfg.eos_id
+        finished: list[FinishedRequest] = []
+
+        had_work = not self.idle
+        if had_work:
+            self.telemetry.begin_work()
+        for seq in self.scheduler.admit(self.queue):
+            self._prefill_request(seq)
+        # Prefill-time completions: a 1-token budget or an instant EOS
+        # never joins a decode iteration.
+        finished.extend(self.scheduler.evict_finished(eos))
+
+        active_seqs = self.scheduler.active()
+        if active_seqs:
+            mask = self.scheduler.active_mask()
+            self._cache, nxt, self._pos = self._decode(
+                self.params, self._cache, self._tok, self._pos,
+                jnp.asarray(mask), self._rngs)
+            self._tok = nxt
+            toks = np.asarray(nxt)  # per-iteration sync: tokens must land
+            t = time.perf_counter()
+            for seq in active_seqs:
+                seq.note_token(toks[seq.slot], t)
+            self.telemetry.on_tokens(len(active_seqs), t)
+            finished.extend(self.scheduler.evict_finished(eos))
+
+        if had_work:
+            self.telemetry.on_iteration(
+                it, queue_depth=len(self.queue), active=len(active_seqs))
+            if self.idle:  # drained: close the busy segment at last token
+                self.telemetry.end_work()
+        else:
+            self.telemetry.on_idle()
+        for fin in finished:
+            self.telemetry.on_finished(fin)
+        if self._iteration % self.cfg.flush_every == 0:
+            self.telemetry.flush(it, len(self.queue),
+                                 self.scheduler.num_active)
+        return finished
+
+    def run(self, max_iterations: int | None = None
+            ) -> list[FinishedRequest]:
+        """Drive :meth:`step` until every queued/active request finishes
+        (or ``max_iterations``); returns completions in finish order."""
+        out: list[FinishedRequest] = []
+        n = 0
+        while not self.idle:
+            out.extend(self.step())
+            n += 1
+            if max_iterations is not None and n >= max_iterations:
+                break
+        return out
+
+    # -- telemetry surface ---------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        """SLA summary. ``queue_depth_max`` is the submit-time high-water
+        (the telemetry's iteration-boundary view misses intra-boundary
+        bursts, so the max of both is reported); submitted/rejected come
+        from the queue's admission counters."""
+        stats = self.telemetry.stats()
+        stats["queue_depth_max"] = max(stats["queue_depth_max"],
+                                       self.queue.depth_max)
+        stats["requests_submitted"] = self.queue.submitted
+        stats["requests_rejected"] = self.queue.rejected
+        return stats
+
+    def reset_stats(self) -> None:
+        """Fresh telemetry window (e.g. after a compile warm-up pass);
+        compiled programs and slot state are untouched."""
+        self.telemetry = ServeTelemetry(self.cfg.ring_size)
+        self.queue.reset_counters()
+        self._iteration = 0
+
+    def dump_flight(self, path: str, *,
+                    reason: str = "serving") -> dict[str, Any]:
+        """Flight-recorder-compatible JSON dump (tools/flight_report.py)."""
+        self.telemetry.flush(self._iteration, len(self.queue),
+                             self.scheduler.num_active)
+        return self.telemetry.dump(path, reason=reason, stats=self.stats())

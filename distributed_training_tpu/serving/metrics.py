@@ -1,0 +1,137 @@
+"""SLA telemetry for the serving engine, on the round-7 flight recorder.
+
+Serving SLAs are tail-latency numbers, so the telemetry mirrors what an
+inference on-call actually pages on:
+
+- **TTFT** (time to first token): arrival → first emitted token, per
+  request. Includes queueing delay — that is the point: a saturated
+  engine shows up here first.
+- **TPOT** (time per output token): mean inter-token interval over a
+  request's decode phase (first → last token, / n-1).
+- **throughput_tok_s**: emitted tokens over the engine's busy time — the
+  SUM of work segments (work start → last token before each drain), so
+  idle waits between arrivals measure as queue emptiness, not as lost
+  serving capacity.
+- **queue_depth_max**: admission high-water mark.
+
+The engine drives the same two touch points the trainers use
+(``observability/hooks.py`` shape): :meth:`on_iteration` per decode
+iteration (one host timestamp into the :class:`FlightRecorder` ring — so
+``step_time_*`` stats ARE per-iteration decode latency), and
+:meth:`flush` every ``flush_every`` iterations (queue depth, active
+slots, running totals into the flush ring). :meth:`dump` writes the
+standard flight-record JSON with a ``serving`` section, readable by
+``tools/flight_report.py`` and ``FlightRecorder.load``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from distributed_training_tpu.observability.flight_recorder import (
+    FlightRecorder,
+    percentile,
+)
+from distributed_training_tpu.serving.request import FinishedRequest
+
+
+class ServeTelemetry:
+    """Per-request SLA accounting + flight-recorder ring for one engine."""
+
+    def __init__(self, ring_size: int = 4096):
+        self.recorder = FlightRecorder(ring_size)
+        self.ttft_ms: list[float] = []
+        self.tpot_ms: list[float] = []
+        self.tokens_emitted = 0
+        self.requests_finished = 0
+        self.queue_depth_max = 0
+        # Busy time is a SUM of work segments, not first-work→last-token
+        # wall clock: at low arrival rates the engine sits idle between
+        # requests, and billing those gaps to the throughput denominator
+        # would report arrival rate, not serving capacity.
+        self._busy_s = 0.0
+        self._seg_t0: float | None = None  # open segment start
+        self._busy_t1: float | None = None  # last token landed
+
+    # -- engine touch points -------------------------------------------------
+    def begin_work(self, t: float | None = None) -> None:
+        """Open a busy segment (idempotent while one is open). The engine
+        calls this BEFORE an iteration's prefill/decode work, so the
+        first iteration's wall time sits in the denominator alongside its
+        tokens — opening at iteration END would inflate throughput, and a
+        run whose requests all finish at prefill would never open it."""
+        if self._seg_t0 is None:
+            self._seg_t0 = time.perf_counter() if t is None else t
+
+    def end_work(self) -> None:
+        """Close the open busy segment at the last token's landing time
+        (the engine calls this when it drains to idle)."""
+        if self._seg_t0 is not None:
+            if self._busy_t1 is not None:
+                self._busy_s += max(self._busy_t1 - self._seg_t0, 0.0)
+            self._seg_t0 = None
+
+    def on_iteration(self, iteration: int, *, queue_depth: int,
+                     active: int, t: float | None = None) -> None:
+        """One decode iteration happened (or a prefill-only boundary)."""
+        t = time.perf_counter() if t is None else t
+        self.queue_depth_max = max(self.queue_depth_max, queue_depth)
+        self.recorder.record_step(iteration, t)
+
+    def on_idle(self) -> None:
+        """No work this boundary: the next iteration's wall delta is
+        arrival wait, not decode latency — exclude it from the stats."""
+        self.recorder.mark_gap()
+
+    def on_tokens(self, n: int, t: float | None = None) -> None:
+        self.tokens_emitted += n
+        self._busy_t1 = time.perf_counter() if t is None else t
+
+    def on_finished(self, fin: FinishedRequest) -> None:
+        self.requests_finished += 1
+        self.ttft_ms.append(fin.ttft_ms)
+        if fin.tpot_ms is not None:
+            self.tpot_ms.append(fin.tpot_ms)
+
+    def flush(self, iteration: int, queue_depth: int, active: int) -> None:
+        self.recorder.record_flush(iteration, {
+            "queue_depth": queue_depth,
+            "active_slots": active,
+            "tokens_emitted": self.tokens_emitted,
+            "requests_finished": self.requests_finished,
+        })
+
+    # -- derived -------------------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        """The serving SLA summary; every field always present (0.0 when
+        no sample exists) so downstream JSON consumers need no key
+        guards."""
+
+        def pct(xs: list[float], q: float) -> float:
+            return percentile(xs, q) if xs else 0.0
+
+        busy_s = self._busy_s
+        if self._seg_t0 is not None and self._busy_t1 is not None:
+            busy_s += max(self._busy_t1 - self._seg_t0, 0.0)
+        tput = self.tokens_emitted / busy_s if busy_s > 0 else 0.0
+        return {
+            "throughput_tok_s": tput,
+            "ttft_p50_ms": pct(self.ttft_ms, 50),
+            "ttft_p95_ms": pct(self.ttft_ms, 95),
+            "tpot_p50_ms": pct(self.tpot_ms, 50),
+            "tpot_p95_ms": pct(self.tpot_ms, 95),
+            "queue_depth_max": int(self.queue_depth_max),
+            "requests_finished": self.requests_finished,
+            "tokens_emitted": self.tokens_emitted,
+            "busy_seconds": busy_s,
+        }
+
+    def dump(self, path: str, *, reason: str = "serving",
+             stats: dict[str, Any] | None = None) -> dict[str, Any]:
+        """Flight-recorder-compatible JSON dump with a ``serving`` extra
+        section (``tools/flight_report.py`` renders it). ``stats`` lets
+        the engine pass its merged summary (queue counters included)."""
+        return self.recorder.dump(
+            path, reason=reason,
+            extra={"serving": stats if stats is not None else self.stats()})

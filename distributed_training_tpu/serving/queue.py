@@ -1,0 +1,99 @@
+"""Thread-safe arrival-ordered request queue with admission control.
+
+Producers (CLI readers, the bench load generator, RPC handlers) submit
+from any thread; the engine drains from its scheduling loop. Admission is
+checked at submit time against the engine's per-slot cache budget
+(:func:`~distributed_training_tpu.inference.sampler.cache_budget`): a
+request whose prompt + completion cannot ever fit a slot is rejected with
+the typed :class:`~distributed_training_tpu.inference.sampler.
+CacheBudgetError` immediately, instead of wedging the head of the queue
+forever (it would never become admissible).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from distributed_training_tpu.inference.sampler import CacheBudgetError
+from distributed_training_tpu.serving.request import Request
+
+
+class RequestQueue:
+    """FIFO of :class:`Request` with a per-request length guard.
+
+    ``budget`` is the per-slot KV-cache capacity in tokens; ``submit``
+    enforces ``prompt_len + max_new_tokens <= budget``. ``depth_max``
+    tracks the high-water queue depth for SLA telemetry.
+    """
+
+    def __init__(self, budget: int, default_max_new_tokens: int = 128):
+        if budget < 2:
+            raise ValueError(f"budget must be >= 2, got {budget}")
+        self.budget = int(budget)
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self._lock = threading.Lock()
+        self._q: collections.deque[Request] = collections.deque()
+        self._next_uid = 0
+        self.depth_max = 0
+        self.submitted = 0
+        self.rejected = 0
+
+    def submit(self, prompt, max_new_tokens: int | None = None,
+               arrival_t: float | None = None) -> Request:
+        """Enqueue one request; returns its admission record.
+
+        Raises :class:`CacheBudgetError` when the request can never fit a
+        slot. ``arrival_t`` defaults to now (perf_counter) — the bench
+        passes its scheduled arrival so queueing delay is measured from
+        the intended arrival, not from when the host thread got around to
+        the submit call.
+        """
+        tokens = np.ascontiguousarray(np.asarray(prompt).reshape(-1),
+                                      dtype=np.int32)
+        if tokens.size < 1:
+            raise ValueError("empty prompt (need at least one token)")
+        mnt = (self.default_max_new_tokens
+               if max_new_tokens is None else int(max_new_tokens))
+        if mnt < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
+        total = tokens.size + mnt
+        if total > self.budget:
+            with self._lock:
+                self.rejected += 1
+            raise CacheBudgetError(
+                f"prompt ({tokens.size}) + max_new_tokens ({mnt}) = "
+                f"{total} exceeds the KV cache (max_len={self.budget})")
+        with self._lock:
+            req = Request(
+                uid=self._next_uid, prompt=tokens, max_new_tokens=mnt,
+                arrival_t=(time.perf_counter()
+                           if arrival_t is None else float(arrival_t)))
+            self._next_uid += 1
+            self._q.append(req)
+            self.submitted += 1
+            self.depth_max = max(self.depth_max, len(self._q))
+        return req
+
+    def reset_counters(self) -> None:
+        """Zero the telemetry counters (depth high-water, submitted,
+        rejected) without touching queued requests or the uid sequence —
+        the engine calls this from ``reset_stats`` so a compile warm-up
+        pass doesn't contaminate the measured SLA window."""
+        with self._lock:
+            self.depth_max = len(self._q)
+            self.submitted = 0
+            self.rejected = 0
+
+    def pop(self) -> Request | None:
+        """Oldest queued request, or None when empty (never blocks — the
+        engine polls at iteration boundaries, it does not park a thread)."""
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)

@@ -1,0 +1,88 @@
+"""Request lifecycle datatypes for the serving engine.
+
+A request moves queue → slot → finished:
+
+- :class:`Request` is the immutable admission record (tokens + budget +
+  arrival timestamp).
+- :class:`ActiveSequence` is a slot's host-side bookkeeping while the
+  sequence decodes (emitted tokens, first/last token timestamps).
+- :class:`FinishedRequest` is the completed result with its SLA numbers
+  (TTFT from arrival to first emitted token; TPOT as the mean inter-token
+  interval over the decode phase).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Why a sequence left its slot.
+FINISH_EOS = "eos"        # emitted the configured eos_id
+FINISH_LENGTH = "length"  # hit its max_new_tokens budget
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One admitted generation request (arrival-ordered by ``uid``)."""
+
+    uid: int
+    prompt: np.ndarray        # int32 [T], T >= 1
+    max_new_tokens: int
+    arrival_t: float          # perf_counter at submit
+
+
+@dataclasses.dataclass
+class ActiveSequence:
+    """Host-side state of one occupied decode slot."""
+
+    request: Request
+    slot: int
+    tokens: list = dataclasses.field(default_factory=list)  # emitted ids
+    first_token_t: float | None = None
+    last_token_t: float | None = None
+
+    def note_token(self, token: int, t: float) -> None:
+        self.tokens.append(int(token))
+        if self.first_token_t is None:
+            self.first_token_t = t
+        self.last_token_t = t
+
+    def finish_reason(self, eos_id: int | None) -> str | None:
+        """None while the sequence should keep decoding."""
+        if eos_id is not None and self.tokens and self.tokens[-1] == eos_id:
+            return FINISH_EOS
+        if len(self.tokens) >= self.request.max_new_tokens:
+            return FINISH_LENGTH
+        return None
+
+
+@dataclasses.dataclass(frozen=True)
+class FinishedRequest:
+    """A completed request with its per-request SLA measurements."""
+
+    uid: int
+    prompt: np.ndarray
+    tokens: np.ndarray        # int32 [n], n >= 1 (EOS included when hit)
+    finish_reason: str        # FINISH_EOS | FINISH_LENGTH
+    ttft_ms: float            # arrival → first emitted token
+    tpot_ms: float | None     # mean inter-token ms; None for 1-token outputs
+    arrival_t: float          # perf_counter timestamps (fairness audits)
+    first_token_t: float
+
+    @staticmethod
+    def from_active(seq: ActiveSequence, reason: str) -> "FinishedRequest":
+        n = len(seq.tokens)
+        tpot = None
+        if n > 1:
+            tpot = (seq.last_token_t - seq.first_token_t) * 1e3 / (n - 1)
+        return FinishedRequest(
+            uid=seq.request.uid,
+            prompt=seq.request.prompt,
+            tokens=np.asarray(seq.tokens, np.int32),
+            finish_reason=reason,
+            ttft_ms=(seq.first_token_t - seq.request.arrival_t) * 1e3,
+            tpot_ms=tpot,
+            arrival_t=seq.request.arrival_t,
+            first_token_t=seq.first_token_t,
+        )

@@ -1,0 +1,95 @@
+"""Slot scheduler: iteration-level (continuous) batching over fixed slots.
+
+Orca's scheduling insight, restated for XLA: the decode step's shapes
+must never change (a retrace costs seconds), so the batch is
+``max_batch`` fixed SLOTS rather than a dynamic list of sequences. At
+every iteration boundary the scheduler
+
+- **admits**: pops queued requests FIFO into however many slots are free
+  (each admission triggers one prefill that scatters into the freed
+  slot's cache rows), and
+- **evicts**: returns finished sequences (EOS emitted, or completion
+  budget spent) to the caller and marks their slots free.
+
+Mid-iteration the slot set is immutable — the decode step sees a boolean
+active mask and per-slot cache write heads, nothing else. All state here
+is host-side Python; no jax imports.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_training_tpu.serving.request import (
+    ActiveSequence,
+    FinishedRequest,
+    Request,
+)
+
+
+class SlotScheduler:
+    """Fixed decode slots, FIFO refill, boundary eviction."""
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = int(num_slots)
+        self._slots: list[ActiveSequence | None] = [None] * self.num_slots
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def num_active(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    def active(self) -> list[ActiveSequence]:
+        """Occupied slots, slot-index order."""
+        return [s for s in self._slots if s is not None]
+
+    def active_mask(self) -> np.ndarray:
+        """bool [num_slots] — the decode step's per-slot active mask."""
+        return np.asarray([s is not None for s in self._slots], bool)
+
+    def sequence(self, slot: int) -> ActiveSequence:
+        seq = self._slots[slot]
+        if seq is None:
+            raise KeyError(f"slot {slot} is free")
+        return seq
+
+    # -- iteration boundaries ------------------------------------------------
+    def admit(self, queue) -> list[ActiveSequence]:
+        """Fill free slots from ``queue`` in strict arrival order.
+
+        Lowest free slot first — slot choice is cosmetic (slots are
+        independent lanes), but a deterministic rule keeps batched runs
+        reproducible. Returns the newly seated sequences; the engine
+        prefills each one.
+        """
+        seated: list[ActiveSequence] = []
+        for slot in range(self.num_slots):
+            if self._slots[slot] is not None:
+                continue
+            req: Request | None = queue.pop()
+            if req is None:
+                break
+            seq = ActiveSequence(request=req, slot=slot)
+            self._slots[slot] = seq
+            seated.append(seq)
+        return seated
+
+    def evict_finished(self, eos_id: int | None) -> list[FinishedRequest]:
+        """Free every slot whose sequence has finished; returns results.
+
+        Called after tokens land (post-prefill and post-decode-step): a
+        one-token request or an instant EOS finishes without ever joining
+        a decode iteration.
+        """
+        done: list[FinishedRequest] = []
+        for slot in range(self.num_slots):
+            seq = self._slots[slot]
+            if seq is None:
+                continue
+            reason = seq.finish_reason(eos_id)
+            if reason is not None:
+                done.append(FinishedRequest.from_active(seq, reason))
+                self._slots[slot] = None
+        return done

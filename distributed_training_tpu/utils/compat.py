@@ -32,6 +32,22 @@ def axis_size(axis_name) -> int:
     return lax.psum(1, axis_name)
 
 
+def supports_partial_manual() -> bool:
+    """True when this jax's ``shard_map`` accepts ``axis_names`` (jax >=
+    0.6) — i.e. the partial-manual compositions (TP×SP, PP×TP, PP×EP,
+    SP-accum, SP×PP) can run at all. The test suite gates its xfail marks
+    on this so the known-broken compositions don't burn CI minutes
+    re-raising the same TypeError on older jax, yet re-run (and XPASS,
+    flagging the marks for removal) the moment the environment upgrades.
+    """
+    import inspect
+
+    try:
+        return "axis_names" in inspect.signature(_shard_map).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        return False
+
+
 def shard_map(fn, mesh, in_specs, out_specs, axis_names=None):
     """``shard_map`` without replication checking, across jax versions.
 

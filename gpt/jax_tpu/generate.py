@@ -77,85 +77,34 @@ def main() -> int:
     import jax
     import numpy as np
 
-    from distributed_training_tpu import checkpoint as ckpt_lib
-    from distributed_training_tpu.config import (
-        OptimizerConfig,
-        PrecisionConfig,
-        SchedulerConfig,
-    )
     from distributed_training_tpu.inference import Generator, SampleConfig
-    from distributed_training_tpu.models import get_model
-    from distributed_training_tpu.train.optim import make_optimizer
-    from distributed_training_tpu.train.precision import LossScaleState, Policy
-    from distributed_training_tpu.train.train_state import init_train_state
+    from distributed_training_tpu.inference.restore import (
+        build_lm_and_restore,
+        moe_kwargs_from_flags,
+    )
 
-    precision = PrecisionConfig(dtype=args.dtype)
-    moe_kwargs = {}
-    if args.moe:
-        # Per-layer lists build the same per-layer architecture training
-        # used (models/gpt.py::moe_layer_experts), so checkpoints trained
-        # with e.g. --num-experts 4 8 sample with the matching flags.
-        moe_kwargs = dict(
-            moe_num_experts=tuple(int(n) for n in args.num_experts),
-            moe_top_k=args.moe_top_k,
-            moe_min_capacity=args.min_capacity,
-            moe_mlp_type=args.mlp_type,
-        )
-    from distributed_training_tpu.train.lm_step import parse_logits_dtype
+    moe_kwargs = moe_kwargs_from_flags(
+        enabled=args.moe, num_experts=args.num_experts,
+        top_k=args.moe_top_k, min_capacity=args.min_capacity,
+        mlp_type=args.mlp_type)
 
-    model = get_model(
-        "transformer_lm",
-        num_classes=args.vocab_size,
-        dtype=Policy.from_config(precision).compute_dtype,
+    model, params, _ = build_lm_and_restore(
+        vocab_size=args.vocab_size,
         num_layers=args.num_layers,
         num_heads=args.num_heads,
         hidden_dim=args.hidden_dim,
         max_len=args.max_len,
+        dtype=args.dtype,
         head_bias=args.head_bias,
-        logits_dtype=parse_logits_dtype(args.logits_dtype),
-        **moe_kwargs,
+        logits_dtype=args.logits_dtype,
+        moe_kwargs=moe_kwargs,
+        checkpoint=args.checkpoint,
+        resume=args.resume,
+        ema_decay=args.ema_decay,
+        use_ema=args.use_ema,
+        seed=args.seed,
+        printer=lambda msg: print(f"[generate] {msg}"),
     )
-
-    # Template state matching LMTrainer's tensor/dp construction — same
-    # optimizer factory (including the EMA wrapper when --ema-decay mirrors
-    # the training run), so the orbax opt-state tree round-trips; only
-    # params (or the EMA average) are consumed here.
-    if args.use_ema and args.ema_decay is None:
-        raise SystemExit("--use-ema requires --ema-decay (mirror training)")
-    tx = make_optimizer(OptimizerConfig(ema_decay=args.ema_decay),
-                        SchedulerConfig(), world_size=1)
-    state = init_train_state(
-        model, jax.random.PRNGKey(args.seed), (1, 8), tx,
-        loss_scale=LossScaleState.create(precision), input_dtype=jax.numpy.int32)
-    epoch = args.resume
-    if epoch < 0:
-        latest = ckpt_lib.latest_epoch(args.checkpoint)
-        epoch = -1 if latest is None else latest
-    if epoch >= 0:
-        try:
-            state, _, _ = ckpt_lib.restore_checkpoint(
-                args.checkpoint, epoch, state)
-        except Exception as e:
-            # The most common tree mismatch after round 5 is the head-bias
-            # default flip: pre-round-5 checkpoints carry an lm_head bias
-            # the new bias-less template lacks. Name the flag instead of
-            # leaving the user to decode a pytree-structure error.
-            raise SystemExit(
-                f"checkpoint restore failed — model flags must mirror the "
-                f"training run. Most likely: this build defaults to NO "
-                f"lm_head bias (round 5); pass --head-bias for checkpoints "
-                f"trained before that (or check --num-layers/--hidden-dim/"
-                f"--moe flags). Original error: {e}") from e
-        print(f"[generate] restored epoch {epoch} from {args.checkpoint}")
-    else:
-        print("[generate] no checkpoint found; sampling from random init")
-
-    params = state.params
-    if args.use_ema:
-        from distributed_training_tpu.train.optim import ema_params
-
-        params = ema_params(state.opt_state)
-        print("[generate] sampling from EMA parameter average")
 
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)
     if (prompt >= args.vocab_size).any():

@@ -1,0 +1,192 @@
+"""Continuous-batching serving CLI for TransformerLM checkpoints.
+
+The consumer end of ``distributed_training_tpu/serving/``: reads one
+prompt per line (stdin by default, or ``--prompts-file``), serves them
+all through the continuous-batching engine — up to ``--max-batch``
+sequences decode together, freed slots refill mid-flight — and prints
+completions in submission order plus an SLA summary (TTFT/TPOT
+percentiles, throughput, queue depth).
+
+Model flags must mirror the training run so the checkpoint restores
+(same contract as ``generate.py``); byte-level I/O (vocab 256 = one
+token per byte) like the rest of the gpt/jax_tpu surface.
+
+    echo -e "The \\nOnce upon" | python gpt/jax_tpu/serve.py \\
+        -c ./checkpoint --max-batch 8 --max-new-tokens 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# Script-style backend dir (like tools/serve_bench.py): make the package
+# importable when run from anywhere, not just the repo root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def add_argument() -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        description="TransformerLM continuous-batching serving")
+    parser.add_argument("--prompts-file", type=str, default=None,
+                        help="one UTF-8 prompt per line; default: stdin")
+    # Serving knobs (ServeConfig).
+    parser.add_argument("--max-batch", type=int, default=8,
+                        help="decode slots (sequences batched/iteration)")
+    parser.add_argument("--max-len", type=int, default=None,
+                        help="per-slot KV-cache tokens (prompt + output); "
+                             "default: the model's --max-len table")
+    parser.add_argument("--max-new-tokens", type=int, default=128)
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="0 = greedy")
+    parser.add_argument("--top-k", type=int, default=None)
+    parser.add_argument("--top-p", type=float, default=None)
+    parser.add_argument("--eos-id", type=int, default=None)
+    parser.add_argument("--prefill-bucket", type=int, default=64,
+                        help="prompt lengths pad to a multiple of this "
+                             "(bounds prefill compile count)")
+    parser.add_argument("--flight-dump", type=str, default=None,
+                        help="write a flight-recorder JSON here at exit "
+                             "(tools/flight_report.py renders it)")
+    parser.add_argument("--json", action="store_true", default=False,
+                        help="emit the SLA stats as one JSON line")
+    # Model flags (mirror training; generate.py contract).
+    parser.add_argument("--vocab-size", type=int, default=256)
+    parser.add_argument("--num-layers", type=int, default=4)
+    parser.add_argument("--num-heads", type=int, default=4)
+    parser.add_argument("--hidden-dim", type=int, default=256)
+    parser.add_argument("--model-max-len", type=int, default=2048,
+                        help="positional-table length used at training")
+    parser.add_argument("--dtype", type=str, default="fp32",
+                        choices=["bf16", "fp16", "fp32"])
+    parser.add_argument("--head-bias", action=argparse.BooleanOptionalAction,
+                        default=False)
+    parser.add_argument("--logits-dtype", type=str, default="bf16",
+                        choices=["fp32", "bf16"])
+    # MoE model flags (must match training; generate.py contract — the
+    # engine's vmapped decode runs MoE FFNs position-wise like training).
+    parser.add_argument("--moe", action="store_true", default=False)
+    parser.add_argument("--num-experts", type=int, nargs="+", default=[8])
+    parser.add_argument("--moe-top-k", type=int, default=1)
+    parser.add_argument("--min-capacity", type=int, default=0)
+    parser.add_argument("--mlp-type", type=str, default="standard",
+                        choices=["standard", "residual"])
+    parser.add_argument("-c", "--checkpoint", type=str, default="./checkpoint")
+    parser.add_argument("-r", "--resume", type=int, default=-1,
+                        help="epoch to load; -1 = latest (random init if "
+                             "no checkpoint exists)")
+    parser.add_argument("--ema-decay", type=float, default=None,
+                        help="must mirror training (restore-template tree)")
+    parser.add_argument("--use-ema", action="store_true", default=False,
+                        help="serve the EMA parameter average")
+    parser.add_argument("--seed", type=int, default=0)
+    return parser.parse_args()
+
+
+def main() -> int:
+    args = add_argument()
+
+    import numpy as np
+
+    from distributed_training_tpu.config import ServeConfig
+    from distributed_training_tpu.inference.restore import (
+        build_lm_and_restore,
+        moe_kwargs_from_flags,
+    )
+    from distributed_training_tpu.inference.sampler import CacheBudgetError
+    from distributed_training_tpu.serving import Engine
+
+    moe_kwargs = moe_kwargs_from_flags(
+        enabled=args.moe, num_experts=args.num_experts,
+        top_k=args.moe_top_k, min_capacity=args.min_capacity,
+        mlp_type=args.mlp_type)
+
+    model, params, _ = build_lm_and_restore(
+        vocab_size=args.vocab_size,
+        num_layers=args.num_layers,
+        num_heads=args.num_heads,
+        hidden_dim=args.hidden_dim,
+        max_len=args.model_max_len,
+        dtype=args.dtype,
+        head_bias=args.head_bias,
+        logits_dtype=args.logits_dtype,
+        moe_kwargs=moe_kwargs,
+        checkpoint=args.checkpoint,
+        resume=args.resume,
+        ema_decay=args.ema_decay,
+        use_ema=args.use_ema,
+        seed=args.seed,
+        printer=lambda msg: print(f"[serve] {msg}", file=sys.stderr),
+    )
+
+    engine = Engine(model, params, ServeConfig(
+        max_batch=args.max_batch,
+        max_len=args.max_len,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        eos_id=args.eos_id,
+        prefill_bucket=args.prefill_bucket,
+        seed=args.seed,
+    ))
+
+    if args.prompts_file:
+        with open(args.prompts_file) as fh:
+            lines = [ln.rstrip("\n") for ln in fh]
+    else:
+        lines = [ln.rstrip("\n") for ln in sys.stdin]
+    lines = [ln for ln in lines if ln]
+    if not lines:
+        raise SystemExit("no prompts (stdin/--prompts-file was empty)")
+
+    texts: dict[int, str] = {}
+    for text in lines:
+        tokens = np.frombuffer(text.encode("utf-8"), np.uint8)
+        if (tokens >= args.vocab_size).any():
+            print(f"[serve] SKIP (bytes outside vocab "
+                  f"{args.vocab_size}): {text!r}", file=sys.stderr)
+            continue
+        try:
+            req = engine.submit(tokens.astype(np.int32))
+        except CacheBudgetError as e:
+            print(f"[serve] REJECT {text!r}: {e}", file=sys.stderr)
+            continue
+        texts[req.uid] = text
+
+    done = engine.run()
+
+    def decode_bytes(toks):
+        return bytes(int(t) % 256 for t in toks).decode(
+            "utf-8", errors="replace")
+
+    for fin in sorted(done, key=lambda f: f.uid):
+        print(f"[serve] #{fin.uid} ({fin.finish_reason}, "
+              f"ttft {fin.ttft_ms:.1f} ms): "
+              f"{texts[fin.uid]!r} -> {decode_bytes(fin.tokens)!r}")
+
+    stats = engine.stats()
+    if args.json:
+        import json
+
+        print(json.dumps(stats, allow_nan=False))
+    else:
+        print(f"[serve] {stats['requests_finished']} requests, "
+              f"{stats['tokens_emitted']} tokens, "
+              f"{stats['throughput_tok_s']:.1f} tok/s | "
+              f"ttft p50 {stats['ttft_p50_ms']:.1f} / "
+              f"p95 {stats['ttft_p95_ms']:.1f} ms | "
+              f"tpot p50 {stats['tpot_p50_ms']:.2f} / "
+              f"p95 {stats['tpot_p95_ms']:.2f} ms | "
+              f"queue depth max {stats['queue_depth_max']}",
+              file=sys.stderr)
+    if args.flight_dump:
+        engine.dump_flight(args.flight_dump)
+        print(f"[serve] flight record: {args.flight_dump}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,21 @@ except Exception:
 import pytest  # noqa: E402
 
 from distributed_training_tpu.runtime.mesh import MeshConfig, create_mesh  # noqa: E402
+from distributed_training_tpu.utils.compat import supports_partial_manual  # noqa: E402
+
+# Known pre-existing failure, kept visible but not red: every composition
+# that needs PARTIAL-MANUAL shard_map (axis_names=..., so the strategy's
+# own axes are manual while model/expert stay automatic for GSPMD) raises
+# on the baked jax 0.4.37 — the axis_names kwarg landed in jax 0.6
+# (utils/compat.py::shard_map; CHANGES.md rounds 6/7). run= skips the
+# deterministic re-raise on old jax (it only burns CI minutes) but
+# re-executes on jax>=0.6, where strict=False turns survivors into loud
+# XPASSes flagging the marks for removal.
+needs_partial_manual = pytest.mark.xfail(
+    strict=False,
+    run=supports_partial_manual(),
+    reason="partial-manual shard_map (axis_names) needs jax>=0.6; "
+           "pre-existing on the baked jax 0.4.37 (CHANGES.md round 6/7)")
 
 
 @pytest.fixture(scope="session")

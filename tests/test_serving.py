@@ -1,0 +1,325 @@
+"""Serving subsystem tests: continuous batching over the KV cache.
+
+Load-bearing properties, in order of importance:
+
+1. **Oracle equivalence**: batched continuous-batching greedy decode is
+   token-identical to the sequential :class:`Generator` (temperature 0)
+   run per prompt — slot packing, bucketed prefill, and mid-flight
+   refills must not change a single emitted token.
+2. **Composition independence**: a request's tokens are bitwise
+   independent of which other requests share the batch (engine at
+   max_batch=N == engine at max_batch=1), greedy AND sampled — the
+   per-slot vmap lanes and fold_in(uid, position) RNG guarantee it. The
+   solo engine also runs UNPADDED prefill (bucket 1) against the batched
+   engine's padded buckets, so the same equality pins prefill-padding
+   invisibility.
+3. **Scheduler mechanics**: FIFO admission, slot refill at iteration
+   boundaries, EOS/length eviction, typed admission rejection.
+4. **Telemetry**: the SLA summary carries all five latency/throughput
+   fields; the flight dump round-trips through FlightRecorder.load.
+
+Engines compile real XLA programs, so the expensive greedy runs are
+module-scoped fixtures shared across the assertion classes.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_tpu.config import ServeConfig
+from distributed_training_tpu.inference import (
+    CacheBudgetError,
+    Generator,
+    SampleConfig,
+    cache_budget,
+)
+from distributed_training_tpu.inference.sampler import check_cache_fits
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.serving import (
+    FINISH_EOS,
+    FINISH_LENGTH,
+    Engine,
+    RequestQueue,
+    SlotScheduler,
+)
+
+VOCAB = 61
+MAX_LEN = 64
+N_NEW = 6
+# Three distinct lengths only: the Generator oracle and the unpadded
+# (bucket-1) engine retrace per prompt length, so variety is capped to
+# what buys coverage — one sub-bucket, one at-bucket, one cross-bucket.
+PROMPT_LENS = [3, 5, 9, 5, 3, 9]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    # head_bias=True so the EOS tests can force an argmax by construction.
+    model = get_model(
+        "transformer_lm", num_classes=VOCAB, num_layers=2, num_heads=2,
+        hidden_dim=32, max_len=MAX_LEN, head_bias=True)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((2, 16), np.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.RandomState(1)
+    return [rng.randint(0, VOCAB, size=l).astype(np.int32)
+            for l in PROMPT_LENS]
+
+
+def _serve(model, params, prompts, **cfg_kw):
+    """Run one engine over ``prompts``; returns (engine, {uid: result})."""
+    cfg = ServeConfig(**{"prefill_bucket": 8, **cfg_kw})
+    eng = Engine(model, params, cfg)
+    for p in prompts:
+        eng.submit(p)
+    done = eng.run()
+    assert len(done) == len(prompts)
+    return eng, {f.uid: f for f in done}
+
+
+@pytest.fixture(scope="module")
+def batched_greedy(lm, prompts):
+    """6 greedy requests through 2 slots (3× oversubscription, padded
+    prefill buckets) — the shared continuous-batching run."""
+    model, params = lm
+    return _serve(model, params, prompts, max_batch=2,
+                  max_new_tokens=N_NEW, temperature=0.0, flush_every=2)
+
+
+@pytest.fixture(scope="module")
+def solo_greedy(lm, prompts):
+    """Same requests, one slot, UNPADDED prefill (bucket 1): the
+    sequential + padding-free counterpart of ``batched_greedy``."""
+    model, params = lm
+    return _serve(model, params, prompts, max_batch=1,
+                  max_new_tokens=N_NEW, temperature=0.0, prefill_bucket=1)
+
+
+class TestOracleEquivalence:
+    def test_batched_greedy_matches_sequential_generator(
+            self, lm, prompts, batched_greedy):
+        """Acceptance: ≥2× more requests than slots; every completion is
+        token-identical to the per-prompt sequential Generator."""
+        model, params = lm
+        _, by_uid = batched_greedy
+        gen = Generator(model, params, SampleConfig(
+            max_new_tokens=N_NEW, temperature=0.0))
+        for uid, p in enumerate(prompts):
+            np.testing.assert_array_equal(
+                by_uid[uid].tokens, gen(p)[0],
+                err_msg=f"request {uid} diverged from sequential decode")
+
+    def test_batched_vs_sequential_engine_bitwise_greedy(
+            self, batched_greedy, solo_greedy):
+        """A request's tokens must not depend on batch composition OR on
+        the prefill bucket: max_batch=2/bucket-8 output is bitwise equal
+        to max_batch=1/unpadded output."""
+        _, batched = batched_greedy
+        _, solo = solo_greedy
+        for uid in batched:
+            np.testing.assert_array_equal(batched[uid].tokens,
+                                          solo[uid].tokens)
+
+    def test_batched_vs_sequential_engine_bitwise_sampled(self, lm, prompts):
+        """Same independence for stochastic sampling: the RNG is a pure
+        function of request uid and position, not of slot neighbors."""
+        model, params = lm
+        subset = prompts[:3]
+        _, batched = _serve(model, params, subset, max_batch=3,
+                            max_new_tokens=4, temperature=1.0, top_k=10)
+        _, solo = _serve(model, params, subset, max_batch=1,
+                         max_new_tokens=4, temperature=1.0, top_k=10)
+        for uid in batched:
+            np.testing.assert_array_equal(batched[uid].tokens,
+                                          solo[uid].tokens)
+
+
+class TestSchedulerMechanics:
+    def test_slot_refill_under_oversubscription(self, batched_greedy):
+        """2 slots, 6 requests: freed slots refill at iteration
+        boundaries, every request completes, the queue high-water mark
+        sees the oversubscription."""
+        eng, by_uid = batched_greedy
+        assert eng.idle
+        assert eng.scheduler.num_active == 0
+        for f in by_uid.values():
+            assert f.finish_reason == FINISH_LENGTH
+            assert f.tokens.size == N_NEW
+        assert eng.stats()["queue_depth_max"] >= 4
+
+    def test_fifo_fairness_under_full_queue(self, batched_greedy):
+        """Absolute first-token times are nondecreasing in arrival order
+        for shape-identical co-queued requests (lengths repeat across the
+        burst): admission is FIFO, never slot- or recency-biased."""
+        _, by_uid = batched_greedy
+        times = [by_uid[uid].first_token_t for uid in range(len(by_uid))]
+        assert times == sorted(times), f"non-FIFO first tokens: {times}"
+
+    def test_eos_eviction_frees_slot(self, lm):
+        """Force EOS as the argmax (biased head): sequences finish with
+        reason 'eos', and the freed slot serves the queued request."""
+        model, params = lm
+        eos = 7
+        biased = dict(params)
+        head = dict(biased["lm_head"])
+        head["bias"] = head["bias"].at[eos].add(1e4)
+        biased["lm_head"] = head
+        eng = Engine(model, biased, ServeConfig(
+            max_batch=1, max_new_tokens=N_NEW, eos_id=eos,
+            prefill_bucket=8))
+        eng.submit(np.array([1, 2], np.int32))
+        eng.submit(np.array([3, 4, 5], np.int32))
+        done = eng.run()
+        assert len(done) == 2
+        for f in done:
+            assert f.finish_reason == FINISH_EOS
+            assert f.tokens[-1] == eos
+            assert f.tokens.size == 1  # EOS is the argmax immediately
+
+    def test_one_token_budget_finishes_at_prefill(self, lm, prompts,
+                                                  batched_greedy):
+        """max_new_tokens=1 completes without any decode iteration (the
+        prefill emits the token) and matches the full run's first token."""
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=2, max_new_tokens=1, prefill_bucket=8))
+        eng.submit(prompts[0])
+        done = eng.run()
+        assert len(done) == 1 and done[0].tokens.size == 1
+        _, by_uid = batched_greedy
+        assert done[0].tokens[0] == by_uid[0].tokens[0]
+
+    def test_scheduler_unit(self):
+        """SlotScheduler admits FIFO into free slots and reports masks."""
+        sched = SlotScheduler(2)
+        q = RequestQueue(budget=32, default_max_new_tokens=4)
+        for i in range(3):
+            q.submit(np.arange(1 + i))
+        seated = sched.admit(q)
+        assert [s.request.uid for s in seated] == [0, 1]
+        assert sched.num_active == 2 and len(q) == 1
+        assert sched.active_mask().tolist() == [True, True]
+        # Finish slot 0 (budget reached) → evict → refill seats uid 2.
+        for _ in range(4):
+            sched.sequence(0).note_token(9, t=1.0)
+        done = sched.evict_finished(eos_id=None)
+        assert [f.uid for f in done] == [0]
+        assert sched.active_mask().tolist() == [False, True]
+        seated = sched.admit(q)
+        assert [s.request.uid for s in seated] == [2]
+        assert seated[0].slot == 0  # lowest free slot reused
+
+
+class TestAdmissionControl:
+    def test_cache_budget_helper(self, lm):
+        model, _ = lm
+        assert cache_budget(model) == MAX_LEN
+        assert cache_budget(model, 16) == 16
+        assert cache_budget(model, 10 * MAX_LEN) == MAX_LEN  # table caps
+        with pytest.raises(ValueError, match="max_len"):
+            cache_budget(model, 0)
+
+    def test_check_cache_fits_raises_typed(self, lm):
+        model, _ = lm
+        with pytest.raises(CacheBudgetError, match="exceeds the KV cache"):
+            check_cache_fits(model, MAX_LEN, 1)
+        assert issubclass(CacheBudgetError, ValueError)
+
+    def test_oversized_request_rejected_at_submit(self, lm):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=1, max_new_tokens=2, max_len=16, prefill_bucket=8))
+        with pytest.raises(CacheBudgetError, match="exceeds the KV cache"):
+            eng.submit(np.arange(15, dtype=np.int32))  # 15 + 2 > 16
+        eng.submit(np.arange(8, dtype=np.int32))       # 8 + 2 fits
+        assert len(eng.run()) == 1
+        assert eng.queue.rejected == 1
+
+    def test_empty_prompt_rejected(self, lm):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(max_batch=1))
+        with pytest.raises(ValueError, match="empty prompt"):
+            eng.submit(np.zeros((0,), np.int32))
+
+
+class TestTelemetry:
+    def test_stats_fields_flight_dump_and_report(self, batched_greedy,
+                                                 tmp_path):
+        from conftest import load_cli_module
+
+        from distributed_training_tpu.observability import FlightRecorder
+
+        eng, by_uid = batched_greedy
+        stats = eng.stats()
+        for key in ("throughput_tok_s", "ttft_p50_ms", "ttft_p95_ms",
+                    "tpot_p50_ms", "tpot_p95_ms", "queue_depth_max"):
+            assert key in stats, key
+        assert stats["throughput_tok_s"] > 0
+        assert stats["ttft_p95_ms"] >= stats["ttft_p50_ms"] > 0
+        assert stats["tokens_emitted"] == len(by_uid) * N_NEW
+        for f in by_uid.values():
+            assert f.ttft_ms > 0 and f.tpot_ms > 0
+
+        path = str(tmp_path / "serve_flight.json")
+        eng.dump_flight(path)
+        snap = FlightRecorder.load(path)  # strict-JSON + format round-trip
+        assert snap["serving"]["requests_finished"] == len(by_uid)
+        assert snap["flushes"], "iteration flushes missing from the ring"
+
+        report = load_cli_module("tools/flight_report.py")
+        summary = report.summarize(snap)
+        assert summary["serving"]["requests_finished"] == len(by_uid)
+        text = report.render(summary)
+        assert "serving:" in text and "ttft" in text
+
+
+class TestServeBenchCli:
+    def test_emits_parseable_json_line(self, monkeypatch, capsys):
+        """Acceptance: serve_bench on the CPU backend prints one strict-
+        JSON line carrying all five latency/throughput fields."""
+        from conftest import load_cli_module
+
+        bench = load_cli_module("tools/serve_bench.py")
+        monkeypatch.setattr("sys.argv", [
+            "serve_bench.py", "--requests", "6", "--rate", "500",
+            "--max-batch", "2", "--num-layers", "1", "--num-heads", "2",
+            "--hidden-dim", "32", "--model-max-len", "64",
+            "--prompt-len", "6", "--max-new-tokens", "4",
+            "--prefill-bucket", "16"])
+        assert bench.main() == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        stats = json.loads(line)
+        for key in ("throughput_tok_s", "ttft_p50_ms", "ttft_p95_ms",
+                    "tpot_p50_ms", "tpot_p95_ms", "queue_depth_max"):
+            assert key in stats, key
+        assert stats["throughput_tok_s"] > 0
+        assert stats["requests_finished"] == 6
+
+
+class TestServeCli:
+    def test_serves_prompt_file_and_prints_stats(self, tmp_path,
+                                                 monkeypatch, capsys):
+        from conftest import load_cli_module
+
+        pfile = tmp_path / "prompts.txt"
+        pfile.write_text("ab\ncdef\n\nxy\n")  # blank line skipped
+        serve_cli = load_cli_module("gpt/jax_tpu/serve.py")
+        monkeypatch.setattr("sys.argv", [
+            "serve.py", "-c", str(tmp_path / "nockpt"),
+            "--prompts-file", str(pfile),
+            "--num-layers", "1", "--num-heads", "2", "--hidden-dim", "32",
+            "--model-max-len", "64", "--max-new-tokens", "4",
+            "--max-batch", "2", "--prefill-bucket", "16", "--json"])
+        assert serve_cli.main() == 0
+        out = capsys.readouterr().out
+        lines = [ln for ln in out.splitlines() if ln.strip()]
+        assert sum(ln.startswith("[serve] #") for ln in lines) == 3
+        stats = json.loads(lines[-1])
+        assert stats["requests_finished"] == 3
+        assert stats["throughput_tok_s"] > 0

@@ -58,6 +58,11 @@ def summarize(snap: dict) -> dict:
     if flushes:
         out["last_flush"] = flushes[-1]
     out["anomalies"] = snap.get("anomalies") or []
+    # Serving-engine dumps (serving/metrics.py) carry an SLA section;
+    # steps there are decode iterations, so step_time_* above is
+    # per-iteration decode latency.
+    if snap.get("serving"):
+        out["serving"] = snap["serving"]
     return out
 
 
@@ -83,7 +88,10 @@ def render(summary: dict) -> str:
     last = summary.get("last_flush")
     if last:
         keys = ("loss", "perplexity", "accuracy", "grad_norm", "mfu",
-                "model_flops_per_sec", "loss_scale", "grads_finite")
+                "model_flops_per_sec", "loss_scale", "grads_finite",
+                # serving-engine flushes (serving/metrics.py)
+                "queue_depth", "active_slots", "tokens_emitted",
+                "requests_finished")
 
         def fmt(v):  # non-finite values arrive as 'nan'/'inf' strings
             return f"{v:.4g}" if isinstance(v, (int, float)) else str(v)
@@ -94,6 +102,16 @@ def render(summary: dict) -> str:
             add(f"  device memory: in-use "
                 f"{_fmt_bytes(last.get('mem_bytes_in_use', 0))}  "
                 f"peak {_fmt_bytes(last['mem_peak_bytes'])}")
+    srv = summary.get("serving")
+    if srv:
+        add(f"  serving: {srv['requests_finished']} requests  "
+            f"{srv['tokens_emitted']} tokens  "
+            f"{srv['throughput_tok_s']:.1f} tok/s")
+        add(f"    ttft p50 {srv['ttft_p50_ms']:.1f} ms  "
+            f"p95 {srv['ttft_p95_ms']:.1f} ms  |  "
+            f"tpot p50 {srv['tpot_p50_ms']:.2f} ms  "
+            f"p95 {srv['tpot_p95_ms']:.2f} ms  |  "
+            f"queue depth max {srv['queue_depth_max']}")
     if summary["anomalies"]:
         add("  ANOMALIES:")
         for a in summary["anomalies"]:

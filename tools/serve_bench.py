@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Synthetic-load benchmark for the continuous-batching serving engine.
+
+Drives ``distributed_training_tpu/serving/`` with a Poisson arrival
+process (exponential inter-arrival times at ``--rate`` req/s) over
+random-token prompts against a random-weight GPT, and prints ONE
+strict-JSON line with the SLA summary:
+
+    {"throughput_tok_s": ..., "ttft_p50_ms": ..., "ttft_p95_ms": ...,
+     "tpot_p50_ms": ..., "tpot_p95_ms": ..., "queue_depth_max": ..., ...}
+
+Same contract as bench.py's JSON lines: machine-readable, last line of
+stdout, parseable by ``json.loads`` (the CI smoke step asserts exactly
+that plus ``throughput_tok_s > 0``). Warm-up requests (compile) are
+served before the measured window unless ``--no-warmup``.
+
+    python tools/serve_bench.py --requests 32 --rate 50 --max-batch 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def add_argument() -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="Poisson-load benchmark for the serving engine")
+    p.add_argument("--requests", type=int, default=32,
+                   help="measured requests")
+    p.add_argument("--rate", type=float, default=50.0,
+                   help="mean arrival rate, requests/second")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=None,
+                   help="per-slot KV budget; default model max-len")
+    p.add_argument("--prompt-len", type=int, default=32,
+                   help="mean prompt length (uniform in [1, 2*mean-1])")
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--eos-id", type=int, default=None)
+    p.add_argument("--prefill-bucket", type=int, default=16)
+    # Tiny random-weight model (no checkpoint: this benches the ENGINE —
+    # scheduling, prefill/decode latency — not model quality).
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-heads", type=int, default=2)
+    p.add_argument("--hidden-dim", type=int, default=64)
+    p.add_argument("--model-max-len", type=int, default=256)
+    p.add_argument("--no-warmup", action="store_true", default=False,
+                   help="skip the compile warm-up pass (its compile time "
+                        "then lands in the measured TTFT tail)")
+    p.add_argument("--flight-dump", type=str, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+def main() -> int:
+    args = add_argument()
+
+    import jax
+    import numpy as np
+
+    from distributed_training_tpu.config import ServeConfig
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.serving import Engine
+
+    # Per-slot budget exactly as the engine computes it; sampled prompt
+    # lengths are clamped so every generated request is admissible (an
+    # uncaught CacheBudgetError mid-measurement would kill the bench
+    # after the warm-up time was already spent).
+    budget = min(args.max_len or args.model_max_len, args.model_max_len)
+    max_prompt = budget - args.max_new_tokens
+    if max_prompt < 1:
+        raise SystemExit(
+            f"--max-new-tokens {args.max_new_tokens} leaves no room for a "
+            f"prompt in the {budget}-token per-slot budget "
+            f"(--max-len/--model-max-len)")
+
+    model = get_model(
+        "transformer_lm", num_classes=args.vocab_size,
+        num_layers=args.num_layers, num_heads=args.num_heads,
+        hidden_dim=args.hidden_dim, max_len=args.model_max_len)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        np.zeros((1, 8), np.int32))["params"]
+
+    engine = Engine(model, params, ServeConfig(
+        max_batch=args.max_batch, max_len=args.max_len,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature, eos_id=args.eos_id,
+        prefill_bucket=args.prefill_bucket, seed=args.seed))
+
+    rng = np.random.RandomState(args.seed)
+
+    def prompts(n):
+        hi = min(2 * args.prompt_len, max_prompt + 1)
+        lens = rng.randint(1, max(hi, 2), size=n)
+        return [rng.randint(0, args.vocab_size, size=int(l)).astype(np.int32)
+                for l in lens]
+
+    if not args.no_warmup:
+        # Exercise every prefill bucket + the decode/admit programs on the
+        # measured engine itself (compiles are per-jit-closure, so a
+        # throwaway engine would not warm this one), then reset the
+        # telemetry window.
+        for lb in range(args.prefill_bucket, 2 * args.prompt_len - 1 +
+                        args.prefill_bucket, args.prefill_bucket):
+            lb = min(lb, engine.budget - 2)  # keep warm-ups admissible
+            engine.submit(rng.randint(0, args.vocab_size,
+                                      size=lb).astype(np.int32),
+                          max_new_tokens=2)
+        warm_tokens = sum(f.tokens.size for f in engine.run())
+        engine.reset_stats()
+        print(f"[serve_bench] warm-up done ({warm_tokens} tokens)",
+              file=sys.stderr)
+
+    n = args.requests
+    load = prompts(n)
+    # Poisson process: exponential inter-arrival gaps at the target rate.
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate, size=n))
+
+    t0 = time.perf_counter()
+    submitted = 0
+    finished = 0
+    while finished < n:
+        now = time.perf_counter() - t0
+        while submitted < n and arrivals[submitted] <= now:
+            engine.submit(load[submitted],
+                          arrival_t=t0 + arrivals[submitted])
+            submitted += 1
+        if engine.idle and submitted < n:
+            # Ahead of the arrival process: sleep to the next arrival
+            # instead of spinning empty iterations.
+            time.sleep(min(arrivals[submitted] - now, 0.05))
+            continue
+        finished += len(engine.step())
+
+    stats = engine.stats()
+    stats["requests"] = n
+    stats["arrival_rate_req_s"] = args.rate
+    stats["max_batch"] = args.max_batch
+    if args.flight_dump:
+        engine.dump_flight(args.flight_dump, reason="serve_bench")
+        print(f"[serve_bench] flight record: {args.flight_dump}",
+              file=sys.stderr)
+    print(json.dumps(stats, allow_nan=False))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
