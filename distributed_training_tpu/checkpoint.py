@@ -1,4 +1,4 @@
-"""Checkpoint / resume (orbax).
+"""Checkpoint / resume (orbax), with verified saves and last-good fallback.
 
 The reference *parses* ``--resume <epoch> --checkpoint <dir> --interval <n>``
 but never wires them: ``start_epoch = 0`` is hardcoded in all three trainers
@@ -8,18 +8,41 @@ train state — params, BatchNorm stats, optimizer state (including ZeRO
 shards: orbax saves/restores respecting each array's sharding), dynamic
 loss-scale state, step counter — plus the epoch index round-trips through
 orbax.
+
+Resilience round (docs/RESILIENCE.md): every save is *verified* — a
+per-file/per-leaf checksum manifest plus an atomic ``COMMITTED`` marker
+written last (``resilience/verify.py``) — and every restore path is
+corruption-aware. A torn, uncommitted, or checksum-failing save raises
+the typed :class:`~distributed_training_tpu.resilience.errors.
+CheckpointCorruptError` (naming the directory and the remedy) instead of
+an opaque orbax crash; :func:`latest_valid_epoch` scans newest→oldest
+past bad saves (quarantining them to ``epoch_N.corrupt``) so
+``auto_resume`` falls back to the newest *good* checkpoint, and
+:func:`prune_checkpoints` never deletes the last verified one. Orbax
+writes run under the deterministic :class:`~distributed_training_tpu.
+resilience.retry.RetryPolicy` so a transient filesystem fault costs a
+bounded retry, not the save.
 """
 
 from __future__ import annotations
 
 import os
 import re
+import warnings
 from typing import Any
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
 from flax import serialization
+
+from distributed_training_tpu.resilience import verify as verify_lib
+from distributed_training_tpu.resilience.errors import CheckpointCorruptError
+from distributed_training_tpu.resilience.retry import RetryPolicy
+
+# Transient-I/O retry for the orbax write itself. OSError only: a
+# structural error (tree mismatch) must surface on the first attempt.
+_CKPT_IO_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.1)
 
 # ResNet blocks were renamed from Flax auto-names ("BasicBlock_3",
 # "BottleneckBlock_0", remat-prefixed "CheckpointBasicBlock_1") to explicit
@@ -53,6 +76,15 @@ def save_checkpoint(directory: str, epoch: int, state: Any,
     pipe_size × virtual_stages): a resume into a different layout would
     load shape-identical but silently permuted weights, so restore
     validates it (see :func:`restore_checkpoint`).
+
+    Single-process saves are *verified*: after the orbax write
+    completes, a checksum manifest over every file (plus per-leaf
+    content checksums) and then an atomic ``COMMITTED`` marker are
+    written — the marker last, so any earlier crash leaves a save that
+    ``resilience/verify.py::verify_checkpoint`` classifies as
+    uncommitted without reading array data. Multihost saves stay
+    manifest-less (legacy classification): no process can safely hash
+    files a peer may still be flushing.
     """
     path = _epoch_dir(directory, epoch)
     meta = {"epoch": np.int32(epoch),
@@ -63,7 +95,15 @@ def save_checkpoint(directory: str, epoch: int, state: Any,
         meta[f"layout_{k}"] = np.int32(v)
     payload = {"state": serialization.to_state_dict(state), "meta": meta}
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, payload, force=True)
+    _CKPT_IO_RETRY.call(ckptr.save, path, payload, force=True)
+    if jax.process_count() == 1:
+        # Manifest + atomic COMMITTED marker (single-process saves only:
+        # hashing files another process may still be flushing would
+        # record checksums of in-flight bytes — a false corruption
+        # verdict later. Multihost saves stay manifest-less and verify
+        # structurally, like pre-resilience "legacy" saves.)
+        verify_lib.write_manifest(
+            path, leaves=verify_lib.leaf_checksums(payload))
     return path
 
 
@@ -164,6 +204,11 @@ def restore_checkpoint(directory: str, epoch: int, state: Any,
     path = _epoch_dir(directory, epoch)
     if not os.path.isdir(path):
         raise FileNotFoundError(f"no checkpoint at {path}")
+    # Validity gate BEFORE orbax touches the tree: a partial/empty/torn
+    # save used to surface as a raw orbax exception deep in metadata or
+    # array deserialization; now it is the typed CheckpointCorruptError
+    # naming the directory and the remedy (resilience/verify.py).
+    verify_lib.verify_checkpoint(path)
     ckptr = ocp.PyTreeCheckpointer()
     saved_md = ckptr.metadata(path)
     if hasattr(saved_md, "item_metadata"):  # orbax >= 0.9 metadata object
@@ -226,44 +271,107 @@ def restore_checkpoint(directory: str, epoch: int, state: Any,
 
 def resolve_resume(ckpt_cfg) -> int:
     """Resume epoch for a :class:`CheckpointConfig`: an explicit
-    ``resume >= 0`` wins; else ``auto_resume`` finds the newest save
-    (the preemption-restart pairing, ``runtime/preemption.py``); -1 = fresh.
+    ``resume >= 0`` wins (restore then raises the typed
+    ``CheckpointCorruptError`` if that save is bad — the user named it,
+    so silence would be lying); else ``auto_resume`` finds the newest
+    *verified* save, skipping and quarantining torn/uncommitted ones
+    (the preemption-restart pairing, ``runtime/preemption.py``);
+    -1 = fresh.
     """
     if ckpt_cfg.resume >= 0:
         return ckpt_cfg.resume
     if ckpt_cfg.auto_resume:
-        latest = latest_epoch(ckpt_cfg.directory)
+        latest = latest_valid_epoch(ckpt_cfg.directory)
         if latest is not None:
             return latest
     return -1
 
 
-def latest_epoch(directory: str) -> int | None:
-    """Highest epoch with a saved checkpoint, or None."""
-    directory = os.path.abspath(directory)
+def _epoch_list(directory: str) -> list[int]:
     if not os.path.isdir(directory):
-        return None
-    epochs = [
-        int(d.split("_", 1)[1])
-        for d in os.listdir(directory)
-        if d.startswith("epoch_") and d.split("_", 1)[1].isdigit()
-    ]
-    return max(epochs) if epochs else None
-
-
-def prune_checkpoints(directory: str, keep: int) -> None:
-    """Retain only the ``keep`` newest epoch checkpoints (process 0 only)."""
-    if jax.process_index() != 0:
-        return
-    directory = os.path.abspath(directory)
-    if not os.path.isdir(directory):
-        return
-    epochs = sorted(
+        return []
+    return sorted(
         int(d.split("_", 1)[1])
         for d in os.listdir(directory)
         if d.startswith("epoch_") and d.split("_", 1)[1].isdigit()
     )
+
+
+def latest_epoch(directory: str) -> int | None:
+    """Highest epoch with a saved checkpoint (validity NOT checked — use
+    :func:`latest_valid_epoch` for resume decisions), or None."""
+    epochs = _epoch_list(os.path.abspath(directory))
+    return max(epochs) if epochs else None
+
+
+def latest_valid_epoch(directory: str, *,
+                       quarantine: bool = True) -> int | None:
+    """Newest epoch whose save passes verification, or None.
+
+    Scans newest→oldest; an uncommitted / torn / checksum-failing dir is
+    skipped and (when ``quarantine``, process 0 only) renamed to
+    ``epoch_N.corrupt`` so later scans stop re-hashing it while the
+    bytes stay available for forensics. This is the fallback behind
+    ``auto_resume``: a preemption that tore the newest save silently
+    costs one epoch of progress instead of the run.
+    """
+    directory = os.path.abspath(directory)
+    for e in reversed(_epoch_list(directory)):
+        path = _epoch_dir(directory, e)
+        try:
+            verify_lib.verify_checkpoint(path)
+            return e
+        except CheckpointCorruptError as err:
+            if quarantine and jax.process_index() == 0:
+                dst = verify_lib.quarantine_checkpoint(path)
+                warnings.warn(
+                    f"skipping corrupt checkpoint (quarantined to {dst}): "
+                    f"{err}", stacklevel=2)
+            else:
+                warnings.warn(f"skipping corrupt checkpoint: {err}",
+                              stacklevel=2)
+        except OSError as err:
+            # A dir vanishing mid-verify (another process's quarantine
+            # rename, a concurrent prune) or a transient read fault must
+            # skip this candidate, not kill the very scan that exists to
+            # survive bad saves. No quarantine: the dir may be gone or
+            # healthy-but-unreadable right now.
+            warnings.warn(
+                f"skipping unreadable checkpoint {path}: {err}",
+                stacklevel=2)
+    return None
+
+
+def prune_checkpoints(directory: str, keep: int) -> None:
+    """Retain the ``keep`` newest epoch checkpoints (process 0 only) —
+    and NEVER the last verified one: when every newer save is torn or
+    uncommitted, deleting the newest *good* save by age would leave the
+    run nothing to fall back to."""
+    if jax.process_index() != 0:
+        return
+    directory = os.path.abspath(directory)
+    epochs = _epoch_list(directory)
+    if not epochs or keep <= 0:
+        return
+    victims = epochs[:-keep]
+    if not victims:
+        return
+    # A victim needs protection only when NO surviving (kept) epoch
+    # verifies — otherwise a newer verified save outlives the sweep by
+    # construction. The common case therefore verifies at most the
+    # newest survivor and never re-hashes the victims. Quarantining here
+    # would be a surprising side effect of a retention sweep, so the
+    # scan is verify-only.
+    protected = None
+    if not any(verify_lib.checkpoint_is_valid(_epoch_dir(directory, e))
+               for e in reversed(epochs[-keep:])):
+        protected = next(
+            (e for e in reversed(victims)
+             if verify_lib.checkpoint_is_valid(_epoch_dir(directory, e))),
+            None)
     import shutil
 
-    for e in epochs[:-keep] if keep > 0 else []:
+    for e in victims:
+        if e == protected:
+            continue
         shutil.rmtree(_epoch_dir(directory, e), ignore_errors=True)

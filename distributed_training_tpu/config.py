@@ -183,10 +183,20 @@ class CheckpointConfig:
     resume: int = -1           # epoch to resume from; -1 = fresh
     keep: int = 3              # retained checkpoints
     # Preemption safety (the failure-handling subsystem the reference lacks,
-    # SURVEY.md §5): resume from the newest checkpoint in `directory` when
-    # present, and save one on SIGTERM before returning.
+    # SURVEY.md §5): resume from the newest VERIFIED checkpoint in
+    # `directory` when present (torn/uncommitted saves are skipped and
+    # quarantined — checkpoint.latest_valid_epoch), and save one on
+    # SIGTERM before returning.
     auto_resume: bool = False
     save_on_preemption: bool = True
+    # Verified async checkpointing (resilience/async_ckpt.py): the step
+    # loop blocks only for the host-side state snapshot; orbax write,
+    # checksum manifest, and the atomic COMMITTED marker run on a
+    # background writer thread. Single-process runs only — multihost
+    # falls back to synchronous saves (orbax coordinates the per-host
+    # gathers itself there). Preemption saves always complete before the
+    # process returns, async or not.
+    async_save: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -275,6 +285,66 @@ class ObservabilityConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ChaosConfig:
+    """Deterministic fault injection (``resilience/chaos.py``).
+
+    Every fault is step- or epoch-addressed and seeded — a pure function
+    of this config, no wall-clock randomness — so chaos runs replay
+    bit-identically and recovery paths (preemption save, auto-resume
+    fallback, transient-I/O retry) are exercised by tier-1 tests rather
+    than only by real TPU evictions. All defaults are inert; the
+    trainers build a :class:`~distributed_training_tpu.resilience.chaos.
+    ChaosMonkey` only when :attr:`active`.
+    """
+
+    seed: int = 0
+    # Deliver a termination signal from inside the step loop at this
+    # global step: "sigterm" = graceful cloud-TPU eviction (the
+    # PreemptionGuard path: finish the step, save, return); "kill" =
+    # SIGKILL, hard death with no save (the resume must fall back to the
+    # last committed interval save).
+    kill_at_step: int | None = None
+    kill_signal: str = "sigterm"  # sigterm | kill
+    # After this epoch's checkpoint save completes, truncate its largest
+    # file and drop the COMMITTED marker — byte-for-byte what a crash
+    # mid-write leaves, which latest_valid_epoch must skip.
+    torn_ckpt_epoch: int | None = None
+    torn_truncate_bytes: int = 64
+    # Probability (per distinct read key, seeded) that a data read
+    # raises a ONE-SHOT transient ChaosIOError — the RetryPolicy on the
+    # loaders must absorb it.
+    data_error_rate: float = 0.0
+    # Inject a host-side stall of slow_step_ms every slow_step_every-th
+    # step (straggler simulation; shows up as flight-recorder p95).
+    slow_step_every: int | None = None
+    slow_step_ms: float = 50.0
+
+    @property
+    def active(self) -> bool:
+        return (self.kill_at_step is not None
+                or self.torn_ckpt_epoch is not None
+                or self.data_error_rate > 0
+                or self.slow_step_every is not None)
+
+    def __post_init__(self):
+        if self.kill_signal not in ("sigterm", "kill"):
+            raise ValueError(
+                f"kill_signal must be 'sigterm' or 'kill', got "
+                f"{self.kill_signal!r}")
+        if not 0.0 <= self.data_error_rate <= 1.0:
+            raise ValueError(
+                f"data_error_rate must be in [0, 1], got "
+                f"{self.data_error_rate}")
+        if self.slow_step_every is not None and self.slow_step_every < 1:
+            raise ValueError(
+                f"slow_step_every must be >= 1, got {self.slow_step_every}")
+        if self.torn_truncate_bytes < 0:
+            raise ValueError(
+                f"torn_truncate_bytes must be >= 0, got "
+                f"{self.torn_truncate_bytes}")
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching inference engine knobs (``serving/``).
 
@@ -312,10 +382,29 @@ class ServeConfig:
     ring_size: int = 4096
     flush_every: int = 32
     seed: int = 0
+    # Graceful degradation (resilience round). Bounded queue depth: a
+    # submit that would exceed it is SHED with the typed QueueFullError
+    # instead of growing the queue (and every queued request's TTFT)
+    # without bound. None = unbounded (the pre-round behavior).
+    max_queue_depth: int | None = None
+    # Per-request deadlines. A request still queued past its TTFT
+    # deadline, or still decoding past its total deadline, is evicted
+    # with finish reason "timeout" (partial tokens returned) — overload
+    # degrades into bounded per-request latency, not collapse. None
+    # disables.
+    ttft_deadline_ms: float | None = None
+    deadline_ms: float | None = None
 
     def __post_init__(self):
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_queue_depth is not None and self.max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {self.max_queue_depth}")
+        for name in ("ttft_deadline_ms", "deadline_ms"):
+            v = getattr(self, name)
+            if v is not None and v <= 0:
+                raise ValueError(f"{name} must be > 0, got {v}")
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
@@ -465,6 +554,9 @@ class TrainConfig:
     # anomaly-triggered trace capture (observability/).
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig)
+    # Deterministic fault injection (resilience/chaos.py); inert by
+    # default — see ChaosConfig.active.
+    chaos: ChaosConfig = dataclasses.field(default_factory=ChaosConfig)
 
     def replace(self, **kw: Any) -> "TrainConfig":
         return dataclasses.replace(self, **kw)

@@ -22,8 +22,15 @@ from typing import Iterator, Sequence
 import numpy as np
 
 from distributed_training_tpu.data.pipeline import ShardedBatchIndexer
+from distributed_training_tpu.resilience.chaos import chaos_io_check
+from distributed_training_tpu.resilience.retry import RetryPolicy
 
 IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+# Transient-I/O retry for per-image decode (flaky NFS/FUSE reads on real
+# datasets; also where the chaos harness injects its one-shot faults).
+# Deterministic backoff — no jitter — so chaos runs replay exactly.
+_DECODE_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.02)
 
 
 def scan_imagefolder(root: str) -> tuple[list[str], np.ndarray, list[str]]:
@@ -54,14 +61,21 @@ def scan_imagefolder(root: str) -> tuple[list[str], np.ndarray, list[str]]:
 
 
 def _decode(path: str, size: int, randomize: bool, rng_seed: int) -> np.ndarray:
-    """Decode one image to f32 [size, size, 3] in [0, 1].
+    """Decode one image to f32 [size, size, 3] in [0, 1], retrying
+    transient I/O faults (``_DECODE_RETRY``; chaos injects here).
 
     randomize: resize shortest side to 1.15×size, random crop + horizontal
     flip (the ImageNet-standard recipe's crop geometry, deterministic in
     ``rng_seed``). Otherwise: same resize, center crop.
     """
+    return _DECODE_RETRY.call(_decode_once, path, size, randomize, rng_seed)
+
+
+def _decode_once(path: str, size: int, randomize: bool,
+                 rng_seed: int) -> np.ndarray:
     from PIL import Image
 
+    chaos_io_check("data", path)
     with Image.open(path) as im:
         im = im.convert("RGB")
         w, h = im.size

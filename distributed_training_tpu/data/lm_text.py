@@ -46,9 +46,21 @@ def byte_corpus(
     ``span`` selects a fractional byte range — train/eval draw from
     *disjoint* spans (e.g. (0, 0.9) vs (0.9, 1.0)) so held-out perplexity
     measures generalization, not window overlap with the training set.
+
+    The file read runs under the deterministic transient-I/O retry
+    (``resilience/retry.py``; the chaos harness injects here).
     """
-    with open(path, "rb") as f:
-        data = np.frombuffer(f.read(), dtype=np.uint8)
+    from distributed_training_tpu.resilience.chaos import chaos_io_check
+    from distributed_training_tpu.resilience.retry import RetryPolicy
+
+    def _read() -> bytes:
+        chaos_io_check("data", path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    data = np.frombuffer(
+        RetryPolicy(max_attempts=3, base_delay_s=0.02).call(_read),
+        dtype=np.uint8)
     lo, hi = int(data.size * span[0]), int(data.size * span[1])
     data = data[lo:hi]
     if data.size < seq_len + 2:

@@ -97,11 +97,16 @@ def build_lm_and_restore(
 
     epoch = resume
     if epoch < 0:
-        latest = ckpt_lib.latest_epoch(checkpoint)
+        # Newest VERIFIED save (resilience round): a serving replica must
+        # not die on a torn newest checkpoint when an older good one
+        # exists — same fallback the trainers' auto_resume applies.
+        latest = ckpt_lib.latest_valid_epoch(checkpoint, quarantine=False)
         epoch = -1 if latest is None else latest
     if epoch >= 0:
         try:
             state, _, _ = ckpt_lib.restore_checkpoint(checkpoint, epoch, state)
+        except ckpt_lib.CheckpointCorruptError:
+            raise  # typed verdict already names the dir and remedy
         except Exception as e:
             # The most common tree mismatch after round 5 is the head-bias
             # default flip: pre-round-5 checkpoints carry an lm_head bias

@@ -50,14 +50,19 @@ class TrainObservability:
     def __init__(self, cfg, *, step_flops: float | None = None,
                  n_devices: int = 1, clock=None, is_master: bool = True,
                  printer: Callable[[str], None] = print,
-                 dump_dir: str | None = None):
+                 dump_dir: str | None = None,
+                 extra_provider: Callable[[], dict] | None = None):
         """``cfg`` is a :class:`~distributed_training_tpu.config.
         ObservabilityConfig`; ``step_flops`` the analytic model FLOPs of
         one optimizer step (None → no MFU line); ``clock`` the trainer's
         WallClock for goodput attribution; ``dump_dir`` overrides
         ``cfg.dump_dir`` (the trainers resolve the None default to
-        ``<checkpoint dir>/flight``)."""
+        ``<checkpoint dir>/flight``); ``extra_provider`` supplies extra
+        top-level dump sections at dump time (the trainers pass their
+        resilience counters — saves committed/failed, I/O retries — so
+        forensics carry them)."""
         self.cfg = cfg
+        self.extra_provider = extra_provider
         self.dump_dir = dump_dir or cfg.dump_dir or "./flight"
         self.is_master = is_master
         self.printer = printer
@@ -233,7 +238,15 @@ class TrainObservability:
         if path is None:
             path = os.path.join(self.dump_dir, "flight.json")
         totals = self.clock.snapshot() if self.clock is not None else None
-        self.recorder.dump(path, reason=reason, phase_totals=totals)
+        extra = None
+        if self.extra_provider is not None:
+            try:
+                extra = self.extra_provider()
+            except Exception as e:  # forensics must not mask the dump
+                self.printer(f"[observability] extra dump section "
+                             f"failed: {e}")
+        self.recorder.dump(path, reason=reason, phase_totals=totals,
+                           extra=extra)
         return path
 
     def on_crash(self) -> None:

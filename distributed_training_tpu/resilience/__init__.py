@@ -1,0 +1,53 @@
+"""Resilience subsystem: the machinery that keeps a run alive.
+
+Rounds 1–8 built the happy path (preemption save + step-accurate
+resume, flight recorder, serving engine); this package is the layer
+that *proves* recovery works and keeps it working — the failure
+handling the reference repo lacks entirely (SURVEY.md §5):
+
+- :mod:`verify` — per-leaf/per-file checksum manifests and the atomic
+  ``COMMITTED`` marker that make every checkpoint save verifiable; the
+  validity oracle behind ``checkpoint.latest_valid_epoch``'s
+  newest-good fallback and ``prune_checkpoints``'s last-verified
+  retention.
+- :mod:`async_ckpt` — CheckFreq-style background persistence: the step
+  loop blocks only for the host-side snapshot, the write/verify/commit
+  run on a writer thread.
+- :mod:`retry` — one deterministic, typed exponential-backoff policy
+  for checkpoint I/O and data reads.
+- :mod:`chaos` — seeded, step-addressed fault injection (kill at step
+  k, torn checkpoint writes, transient data-I/O errors, slow steps) so
+  tier-1 tests exercise the recovery paths, not just real evictions.
+- :mod:`errors` — the typed failure vocabulary
+  (:class:`CheckpointCorruptError`, :class:`DrainingError`,
+  :class:`QueueFullError`) shared with the serving engine's graceful
+  drain / deadline / load-shedding paths.
+
+See docs/RESILIENCE.md for the failure model end to end.
+"""
+
+from distributed_training_tpu.resilience.async_ckpt import (  # noqa: F401
+    AsyncCheckpointWriter,
+    host_snapshot,
+)
+from distributed_training_tpu.resilience.chaos import (  # noqa: F401
+    ChaosIOError,
+    ChaosMonkey,
+    chaos_io_check,
+    tear_checkpoint,
+)
+from distributed_training_tpu.resilience.errors import (  # noqa: F401
+    CheckpointCorruptError,
+    DrainingError,
+    QueueFullError,
+)
+from distributed_training_tpu.resilience.retry import (  # noqa: F401
+    RetryPolicy,
+    total_retries,
+)
+from distributed_training_tpu.resilience.verify import (  # noqa: F401
+    checkpoint_is_valid,
+    quarantine_checkpoint,
+    verify_checkpoint,
+    write_manifest,
+)

@@ -1,0 +1,159 @@
+"""Verified async checkpointing: snapshot on the step loop, write behind.
+
+CheckFreq's split (Mohan et al., FAST'21), restated for JAX: a
+checkpoint has two phases with wildly different costs. The *snapshot*
+(device→host copy of the state) must be consistent with an exact step,
+so it runs synchronously between steps — but it is DMA-bound and cheap.
+The *persist* (orbax serialization + filesystem writes + checksum
+manifest + commit marker) is seconds of pure I/O with no consistency
+constraint at all — so it runs on a background writer thread while the
+step loop trains on.
+
+:class:`AsyncCheckpointWriter` implements that split over the existing
+``checkpoint.save_checkpoint`` (which already writes the manifest and
+atomic COMMITTED marker, so every async save is a *verified* save):
+
+- ``save()`` snapshots host-side (``jax.device_get`` of the state dict)
+  in the caller's thread, then enqueues the persist. The queue holds at
+  most one pending snapshot — a second ``save`` while one is in flight
+  blocks until the writer catches up, bounding host memory to one extra
+  state copy (backpressure, not unbounded buffering).
+- ``prune()`` enqueues behind the saves it must run after, so retention
+  decisions always see completed saves.
+- ``wait()`` drains the queue (the preemption path passes
+  ``sync=True`` — the process is about to die inside its SIGTERM grace
+  window, the save must be durable before returning); a persist failure
+  is recorded in ``counters`` / ``last_error`` and surfaces on the next
+  ``wait(raise_on_error=True)`` rather than killing the training step
+  that happened to dispatch it.
+
+Single-process only: a multihost snapshot needs per-host array gathers
+orbax coordinates itself; the trainers fall back to synchronous saves
+when ``jax.process_count() > 1``.
+"""
+
+from __future__ import annotations
+
+import queue as queue_lib
+import threading
+from typing import Any, Callable
+
+
+def host_snapshot(state: Any) -> Any:
+    """A host-side (numpy) state dict of ``state``, consistent with the
+    moment of the call — the only step-loop-blocking part of a save."""
+    import jax
+    from flax import serialization
+
+    return jax.device_get(serialization.to_state_dict(state))
+
+
+class AsyncCheckpointWriter:
+    """Serial background writer for verified checkpoint saves."""
+
+    _STOP = object()
+
+    def __init__(self, *, post_save: Callable[[str, int], None] | None = None,
+                 printer: Callable[[str], None] = print):
+        """``post_save(path, epoch)`` runs in the writer thread after each
+        completed save — the chaos harness's torn-write hook plugs in
+        here so injected tears land exactly where a real crash would."""
+        self._q: queue_lib.Queue = queue_lib.Queue(maxsize=1)
+        self._thread: threading.Thread | None = None
+        self._post_save = post_save
+        self._printer = printer
+        self._lock = threading.Lock()
+        self.last_error: BaseException | None = None
+        self.counters = {"saves_committed": 0, "saves_failed": 0}
+
+    # -- worker --------------------------------------------------------------
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._worker, name="ckpt-writer", daemon=True)
+            self._thread.start()
+
+    def _worker(self) -> None:
+        from distributed_training_tpu import checkpoint as ckpt_lib
+
+        while True:
+            task = self._q.get()
+            try:
+                if task is self._STOP:
+                    return
+                kind = task[0]
+                if kind == "save":
+                    _, directory, epoch, snapshot, kwargs = task
+                    path = ckpt_lib.save_checkpoint(
+                        directory, epoch, snapshot, **kwargs)
+                    with self._lock:
+                        self.counters["saves_committed"] += 1
+                    if self._post_save is not None:
+                        self._post_save(path, epoch)
+                else:  # prune
+                    _, directory, keep = task
+                    ckpt_lib.prune_checkpoints(directory, keep)
+            except BaseException as e:  # noqa: BLE001 - recorded, surfaced
+                with self._lock:
+                    if task is not self._STOP and task[0] == "save":
+                        self.counters["saves_failed"] += 1
+                    self.last_error = e
+                self._printer(f"[ckpt-writer] background save failed: {e}")
+            finally:
+                self._q.task_done()
+
+    # -- producer API --------------------------------------------------------
+    def save(self, directory: str, epoch: int, state: Any, *,
+             sync: bool = False, **kwargs: Any) -> None:
+        """Snapshot ``state`` now; persist it in the background (same
+        keyword surface as ``checkpoint.save_checkpoint``). ``sync=True``
+        additionally drains the queue and raises iff a save failed
+        DURING this drain — the preemption-save contract. A stale
+        failure from an earlier interval save (already counted and
+        printed) must not crash a preemption save that just succeeded.
+        """
+        snapshot = host_snapshot(state)
+        self._ensure_thread()
+        failed_before = self.counters["saves_failed"]
+        self._q.put(("save", directory, int(epoch), snapshot, kwargs))
+        if sync:
+            err = self._drain_error()
+            if self.counters["saves_failed"] > failed_before:
+                raise RuntimeError(
+                    f"checkpoint save of epoch {epoch} to {directory} "
+                    f"failed: {err}") from err
+            if err is not None:  # stale earlier failure: already counted
+                self._printer(f"[ckpt-writer] note: an earlier background "
+                              f"save had failed: {err}")
+
+    def prune(self, directory: str, keep: int) -> None:
+        """Enqueue retention pruning ordered after the pending saves."""
+        self._ensure_thread()
+        self._q.put(("prune", directory, int(keep)))
+
+    def _drain_error(self) -> BaseException | None:
+        """Join the queue; return-and-clear any recorded failure."""
+        if self._thread is not None:
+            self._q.join()
+        with self._lock:
+            err, self.last_error = self.last_error, None
+        return err
+
+    def wait(self, raise_on_error: bool = True) -> None:
+        """Block until every enqueued task completed; surface (once) any
+        recorded failure when ``raise_on_error``."""
+        err = self._drain_error()
+        if err is not None:
+            if raise_on_error:
+                raise RuntimeError(
+                    f"async checkpoint save failed: {err}") from err
+            self._printer(f"[ckpt-writer] swallowed background failure "
+                          f"({self.counters['saves_failed']} total): {err}")
+
+    def close(self, raise_on_error: bool = False) -> None:
+        """Drain and stop the writer thread (idempotent)."""
+        if self._thread is not None and self._thread.is_alive():
+            self.wait(raise_on_error=raise_on_error)
+            self._q.put(self._STOP)
+            self._thread.join(timeout=30)
+        self._thread = None

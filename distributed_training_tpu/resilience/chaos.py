@@ -1,0 +1,166 @@
+"""Deterministic chaos harness: seeded, step-addressed fault injection.
+
+Chaos engineering's core claim (Netflix's Chaos Monkey) is that recovery
+paths rot unless they are *exercised*; a TPU trainer's recovery paths —
+preemption save, auto-resume, torn-write fallback, transient-I/O retry —
+otherwise only run on real evictions, where nothing is reproducible.
+This harness makes every fault a first-class, deterministic test input:
+
+- **kill at step k** — SIGTERM (the cloud-TPU eviction signal; the
+  graceful ``PreemptionGuard`` path) or SIGKILL (hard death, no save —
+  exercises the fall-back-to-last-interval-save path) delivered from
+  inside the step loop at an exact global step.
+- **torn checkpoint write** — after the save of a chosen epoch lands,
+  truncate its largest array file and remove the ``COMMITTED`` marker:
+  byte-for-byte what a crash mid-write leaves behind, which
+  ``latest_valid_epoch`` must skip.
+- **transient data-I/O errors** — a seeded, per-key one-shot
+  :class:`ChaosIOError` raised from inside the data loaders' read path,
+  which the :class:`~distributed_training_tpu.resilience.retry.
+  RetryPolicy` must absorb.
+- **slow steps** — injected host-side stalls every N steps, visible as
+  p95 outliers in the flight recorder.
+
+Everything is a pure function of ``(ChaosConfig.seed, fault address)``:
+no wall-clock randomness, so a chaos run replays bit-identically —
+which is what lets the kill/resume test assert *bitwise* equality with
+the uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+import zlib
+
+from distributed_training_tpu.resilience.verify import (
+    COMMIT_NAME,
+    MANIFEST_NAME,
+)
+
+
+class ChaosIOError(OSError):
+    """An injected transient I/O fault (retryable by construction)."""
+
+
+def tear_checkpoint(path: str, truncate_bytes: int = 64) -> str:
+    """Turn a completed save at ``path`` into a torn write: truncate its
+    largest payload file to ``truncate_bytes`` and drop the COMMITTED
+    marker (a real crash dies before the marker, which is written last).
+    Returns the truncated file's path. Also used by the CI chaos smoke.
+    """
+    victims = []
+    for dirpath, _, files in os.walk(path):
+        for name in files:
+            if name in (MANIFEST_NAME, COMMIT_NAME):
+                continue
+            p = os.path.join(dirpath, name)
+            victims.append((-os.path.getsize(p), os.path.relpath(p, path), p))
+    if not victims:
+        raise FileNotFoundError(f"no checkpoint files to tear at {path}")
+    victims.sort()  # largest first, lexicographic tiebreak: deterministic
+    _, _, victim = victims[0]
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as fh:
+        fh.truncate(min(truncate_bytes, max(size - 1, 0)))
+    marker = os.path.join(path, COMMIT_NAME)
+    if os.path.exists(marker):
+        os.remove(marker)
+    return victim
+
+
+class ChaosMonkey:
+    """One run's fault injector, driven by the trainers' step loop.
+
+    Constructed from a :class:`~distributed_training_tpu.config.
+    ChaosConfig`; hooks are no-ops for faults the config leaves unset.
+    ``counters`` records every injected fault for the flight recorder's
+    resilience section.
+    """
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self._killed = False
+        self._torn = False
+        self._io_failed: set[str] = set()
+        self.counters = {"kills": 0, "torn_ckpts": 0,
+                         "io_faults": 0, "slow_steps": 0}
+
+    # -- step loop -----------------------------------------------------------
+    def on_step(self, step: int) -> None:
+        """Called after every optimizer step with the global step index."""
+        c = self.cfg
+        if (c.slow_step_every and c.slow_step_ms > 0
+                and step % c.slow_step_every == 0):
+            self.counters["slow_steps"] += 1
+            time.sleep(c.slow_step_ms / 1e3)
+        if c.kill_at_step is not None and step >= c.kill_at_step \
+                and not self._killed:
+            self._killed = True
+            self.counters["kills"] += 1
+            if c.kill_signal == "kill":
+                # Hard eviction: no grace window, no save. The resume
+                # must fall back to the last committed interval save.
+                os.kill(os.getpid(), signal.SIGKILL)
+            else:
+                # Graceful eviction: latched by PreemptionGuard, the
+                # trainer finishes the in-flight step and saves.
+                signal.raise_signal(signal.SIGTERM)
+
+    # -- checkpoint path -----------------------------------------------------
+    def after_checkpoint_save(self, path: str, epoch: int) -> None:
+        """Post-save hook (sync path and the async writer thread both
+        call it): tears the configured epoch's save exactly once."""
+        c = self.cfg
+        if c.torn_ckpt_epoch is not None and epoch == c.torn_ckpt_epoch \
+                and not self._torn:
+            self._torn = True
+            self.counters["torn_ckpts"] += 1
+            tear_checkpoint(path, c.torn_truncate_bytes)
+
+    # -- data I/O ------------------------------------------------------------
+    def io_check(self, kind: str, key: str) -> None:
+        """Raise a one-shot :class:`ChaosIOError` for ``key`` when the
+        seeded coin says so — once per key, so a retry always succeeds
+        (the injected faults are transient by construction)."""
+        c = self.cfg
+        if kind != "data" or c.data_error_rate <= 0:
+            return
+        full = f"{c.seed}:{kind}:{key}"
+        if full in self._io_failed:
+            return
+        if zlib.crc32(full.encode()) % 1_000_000 \
+                < int(c.data_error_rate * 1_000_000):
+            self._io_failed.add(full)
+            self.counters["io_faults"] += 1
+            raise ChaosIOError(
+                f"chaos-injected transient I/O error ({kind}: {key})")
+
+
+# -- process-global install point (data loaders poll it) ---------------------
+# The loaders (imagefolder decode threads, corpus reads) cannot be handed
+# a monkey through every constructor without threading chaos through the
+# whole data API; a module-level registration keeps the blast radius to
+# one `chaos_io_check` call in each read path, free when nothing is
+# installed. The trainers install for the duration of `fit()` only.
+_active: ChaosMonkey | None = None
+
+
+def install(monkey: ChaosMonkey | None) -> None:
+    global _active
+    _active = monkey
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def active_monkey() -> ChaosMonkey | None:
+    return _active
+
+
+def chaos_io_check(kind: str, key: str) -> None:
+    """Fault-injection point for I/O paths; no-op without a monkey."""
+    if _active is not None:
+        _active.io_check(kind, key)

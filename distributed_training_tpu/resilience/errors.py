@@ -1,0 +1,46 @@
+"""Typed failure-path errors for the resilience subsystem.
+
+Every recoverable failure this framework handles gets its own exception
+type, so callers (trainers, serving producers, tests, the CI chaos smoke)
+branch on *types* instead of string-matching the message of whatever
+library raised five frames down. The hierarchy is deliberately shallow:
+
+- :class:`CheckpointCorruptError` — a checkpoint directory that must not
+  be restored (torn write, checksum mismatch, never committed, empty).
+  Raised by ``checkpoint.restore_checkpoint`` / ``verify_checkpoint``
+  instead of the opaque orbax crash a partial save used to surface.
+- :class:`DrainingError` — admission is closed: the serving engine is
+  completing in-flight work before shutdown and rejects new requests.
+- :class:`QueueFullError` — bounded-queue load shedding: the request
+  queue is at ``max_queue_depth`` and sheds the submit instead of
+  growing without bound.
+"""
+
+from __future__ import annotations
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint directory failed validity verification.
+
+    Carries the offending ``path`` and a machine-readable ``reason``
+    slug (``"uncommitted"`` / ``"torn"`` / ``"empty"`` / ``"checksum"``)
+    alongside the human message; ``auto_resume`` catches this type to
+    fall back to the newest *good* save (``checkpoint.
+    latest_valid_epoch``) while an explicit ``--resume N`` surfaces it.
+    """
+
+    def __init__(self, message: str, *, path: str = "",
+                 reason: str = "corrupt"):
+        super().__init__(message)
+        self.path = path
+        self.reason = reason
+
+
+class DrainingError(RuntimeError):
+    """The serving engine is draining: admission is closed, in-flight
+    requests are being completed, and new submits are rejected."""
+
+
+class QueueFullError(RuntimeError):
+    """The bounded request queue is full; the submit was shed instead of
+    growing the queue (and its tail latency) without bound."""

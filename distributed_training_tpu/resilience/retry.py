@@ -1,0 +1,101 @@
+"""Deterministic retry with typed exponential backoff.
+
+Transient I/O faults (a flaky NFS read, an object-store 5xx behind a
+fuse mount, a preempted-neighbor filesystem hiccup) should cost a
+bounded retry, not a dead 30-hour run. :class:`RetryPolicy` is the one
+retry implementation for the framework — applied to checkpoint writes
+(``checkpoint.save_checkpoint``) and data reads
+(``data/imagefolder.py``, ``data/lm_text.py``) — with two deliberate
+properties:
+
+- **Deterministic.** The backoff sequence is a pure function of the
+  policy (no jitter, no wall-clock randomness), so chaos-injected
+  fault tests (``resilience/chaos.py``) replay bit-identically and the
+  tier-1 suite stays reproducible. Thundering-herd jitter is a
+  many-client concern; this framework's writers are one process per
+  host.
+- **Typed.** Only exceptions in ``retry_on`` are retried (default
+  ``OSError`` — the transient-I/O family, which chaos's injected
+  :class:`~distributed_training_tpu.resilience.chaos.ChaosIOError`
+  subclasses). A structural error (tree mismatch, bad config) must
+  surface on the first attempt, not after three pointless sleeps.
+
+A module-level counter (:func:`total_retries`) feeds the flight
+recorder's resilience section so retries are visible in forensics, not
+silently absorbed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Iterator
+
+_lock = threading.Lock()
+_total_retries = 0
+
+
+def total_retries() -> int:
+    """Process-wide count of retry *sleeps* taken (flight telemetry)."""
+    return _total_retries
+
+
+def _count_retry() -> None:
+    global _total_retries
+    with _lock:
+        _total_retries += 1
+
+
+def reset_retries() -> None:
+    """Zero the process-wide counter (test isolation)."""
+    global _total_retries
+    with _lock:
+        _total_retries = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-attempt exponential backoff; see the module docstring.
+
+    ``max_attempts`` counts total tries (1 = no retry). ``sleep`` is
+    injectable so tests assert the exact deterministic delay sequence
+    without waiting it out.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    max_delay_s: float = 2.0
+    retry_on: tuple = (OSError,)
+    sleep: Callable[[float], None] = time.sleep
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("delays must be >= 0")
+        if self.multiplier < 1.0:
+            raise ValueError(
+                f"multiplier must be >= 1, got {self.multiplier}")
+
+    def delays(self) -> Iterator[float]:
+        """The deterministic backoff sequence (one delay per retry)."""
+        d = self.base_delay_s
+        for _ in range(self.max_attempts - 1):
+            yield min(d, self.max_delay_s)
+            d *= self.multiplier
+
+    def call(self, fn: Callable[..., Any], *args: Any, **kwargs: Any) -> Any:
+        """Run ``fn`` under the policy; re-raises the final failure."""
+        delays = list(self.delays()) + [None]  # None = last attempt
+        for delay in delays:
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on:
+                if delay is None:
+                    raise
+                _count_retry()
+                self.sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover

@@ -1,0 +1,210 @@
+"""Checkpoint validity: checksum manifests + an atomic COMMITTED marker.
+
+CheckFreq's (Mohan et al., FAST'21) consistency insight, applied to the
+orbax save layout: a checkpoint is only as good as your ability to
+*prove* it restores, and the proof must be cheap enough to run on every
+resume. Each save gets two extra artifacts inside its ``epoch_N`` dir:
+
+- ``MANIFEST.json`` — a per-file ``{relpath: [size, crc32]}`` table over
+  everything orbax wrote (so a torn/truncated/bit-rotted file is caught
+  by a streaming CRC pass, no orbax deserialization needed), plus a
+  per-leaf ``{tree/path: [crc32, dtype, shape]}`` section computed from
+  the host-side arrays at save time — the content fingerprint of what
+  the training step actually produced, independent of the on-disk
+  encoding.
+- ``COMMITTED`` — an empty marker written LAST via tmp + atomic rename.
+  A crash at any earlier point (mid-array-write, mid-manifest) leaves
+  no marker, so scanners classify the save as uncommitted without
+  reading a byte of array data.
+
+:func:`verify_checkpoint` is the single validity oracle: committed +
+manifest-consistent ⇒ valid; manifest-less dirs from before this round
+are accepted when they carry a recognizable orbax structure (legacy
+saves must keep restoring) and rejected as corrupt when empty or
+structurally void. Everything downstream — ``restore_checkpoint``'s
+typed error, ``latest_valid_epoch``'s newest-good fallback scan,
+``prune_checkpoints``'s last-verified retention — is built on it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Any
+
+from distributed_training_tpu.resilience.errors import CheckpointCorruptError
+from distributed_training_tpu.resilience.retry import RetryPolicy
+
+MANIFEST_NAME = "MANIFEST.json"
+COMMIT_NAME = "COMMITTED"
+MANIFEST_VERSION = 1
+
+# Orbax entry files across the supported versions (0.7 ocdbt layout,
+# older aggregate-file layouts, newer metadata layouts): a manifest-less
+# dir carrying any of these is a restorable legacy save; one carrying
+# none of them is junk a restore would crash on.
+_ORBAX_MARKERS = ("_CHECKPOINT_METADATA", "_METADATA", "manifest.ocdbt",
+                  "checkpoint", "aggregate")
+
+_MANIFEST_IO_RETRY = RetryPolicy(max_attempts=3, base_delay_s=0.05)
+
+
+def _crc_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            buf = fh.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def _walk_files(root: str) -> dict[str, str]:
+    """{relpath: abspath} of every regular file under ``root``, manifest
+    artifacts excluded (they describe the save, they are not part of it)."""
+    out: dict[str, str] = {}
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            p = os.path.join(dirpath, name)
+            rel = os.path.relpath(p, root)
+            if rel in (MANIFEST_NAME, COMMIT_NAME):
+                continue
+            out[rel] = p
+    return out
+
+
+def leaf_checksums(tree: Any, prefix: str = "") -> dict[str, list]:
+    """``{path: [crc32, dtype, shape]}`` over a nested-dict state tree.
+
+    Leaves must be host-materializable (``np.asarray``); the callers
+    guard on single-process runs where that always holds.
+    """
+    import numpy as np
+
+    out: dict[str, list] = {}
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            out.update(leaf_checksums(tree[k], f"{prefix}{k}/"))
+        return out
+    arr = np.asarray(tree)
+    crc = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+    return {prefix.rstrip("/"): [crc, str(arr.dtype), list(arr.shape)]}
+
+
+def write_manifest(path: str, leaves: dict[str, list] | None = None) -> None:
+    """Manifest + atomic COMMITTED marker for a completed orbax save at
+    ``path``. Call only after the save fully returned — the marker's
+    meaning IS "everything before me is on disk"."""
+    files = {rel: [os.path.getsize(p), _crc_file(p)]
+             for rel, p in sorted(_walk_files(path).items())}
+
+    def _write():
+        manifest = {"manifest_version": MANIFEST_VERSION, "files": files,
+                    "leaves": leaves or {}}
+        tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh, indent=1, sort_keys=True)
+        os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+        tmp = os.path.join(path, COMMIT_NAME + ".tmp")
+        with open(tmp, "w") as fh:
+            fh.write("")  # presence is the contract, content is not
+        os.replace(tmp, os.path.join(path, COMMIT_NAME))
+
+    _MANIFEST_IO_RETRY.call(_write)
+
+
+def read_manifest(path: str) -> dict[str, Any] | None:
+    """The parsed manifest, or None when the save predates manifests."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        return None
+    try:
+        with open(mpath) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint manifest {mpath} is unreadable ({e}); the save "
+            f"is untrustworthy — quarantine the directory and resume "
+            f"from an earlier epoch", path=path, reason="torn") from e
+
+
+def is_committed(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, COMMIT_NAME))
+
+
+def verify_checkpoint(path: str) -> None:
+    """Raise :class:`CheckpointCorruptError` unless ``path`` is a save
+    this framework should restore. See the module docstring for the
+    validity states; returns None on success."""
+    if not os.path.isdir(path):
+        raise CheckpointCorruptError(
+            f"no checkpoint directory at {path}", path=path, reason="empty")
+    files = _walk_files(path)
+    manifest = read_manifest(path)
+    committed = is_committed(path)
+    if manifest is None and not committed:
+        # Legacy (pre-manifest) save: restorable iff it carries a
+        # recognizable orbax structure.
+        if any(m in files or os.path.isdir(os.path.join(path, m))
+               for m in _ORBAX_MARKERS):
+            return
+        raise CheckpointCorruptError(
+            f"checkpoint directory {path} is empty or structurally not a "
+            f"checkpoint (no orbax metadata, no manifest) — likely a save "
+            f"that died before writing anything. Remedy: delete the "
+            f"directory, or use auto_resume which skips it and falls back "
+            f"to the newest verified save",
+            path=path, reason="empty")
+    if not committed:
+        raise CheckpointCorruptError(
+            f"checkpoint at {path} was never committed (the save died "
+            f"before its atomic {COMMIT_NAME} marker — a torn write). "
+            f"Remedy: resume from an earlier epoch; auto_resume does this "
+            f"fallback automatically and quarantines the directory",
+            path=path, reason="uncommitted")
+    if manifest is None:
+        raise CheckpointCorruptError(
+            f"checkpoint at {path} carries a {COMMIT_NAME} marker but no "
+            f"{MANIFEST_NAME} — the save artifacts were tampered with or "
+            f"partially deleted. Remedy: resume from an earlier epoch",
+            path=path, reason="torn")
+    want = manifest.get("files", {})
+    for rel, (size, crc) in sorted(want.items()):
+        p = files.get(rel)
+        if p is None:
+            raise CheckpointCorruptError(
+                f"checkpoint at {path} is missing file {rel!r} listed in "
+                f"its manifest — a partial delete or torn write. Remedy: "
+                f"resume from an earlier epoch (auto_resume falls back "
+                f"automatically)", path=path, reason="torn")
+        if os.path.getsize(p) != size or _crc_file(p) != crc:
+            raise CheckpointCorruptError(
+                f"checkpoint at {path} fails checksum verification on "
+                f"{rel!r} (truncated or corrupted after commit). Remedy: "
+                f"resume from an earlier epoch (auto_resume falls back "
+                f"automatically)", path=path, reason="checksum")
+
+
+def checkpoint_is_valid(path: str) -> bool:
+    """Boolean form of :func:`verify_checkpoint`. An unreadable dir
+    (vanished mid-scan, transient I/O fault) counts as not-valid rather
+    than crashing the caller's scan."""
+    try:
+        verify_checkpoint(path)
+        return True
+    except (CheckpointCorruptError, OSError):
+        return False
+
+
+def quarantine_checkpoint(path: str) -> str:
+    """Rename a corrupt save to ``<path>.corrupt`` (suffix-numbered on
+    collision) so scans stop re-verifying it while forensics keep the
+    bytes; returns the quarantine path."""
+    dst = path + ".corrupt"
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{path}.corrupt{n}"
+    os.replace(path, dst)
+    return dst

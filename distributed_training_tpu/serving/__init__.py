@@ -18,12 +18,17 @@ Surfaces: ``gpt/jax_tpu/serve.py`` (interactive/file serving CLI) and
 ``tools/serve_bench.py`` (Poisson load generator). See docs/SERVING.md.
 """
 
+from distributed_training_tpu.resilience.errors import (  # noqa: F401
+    DrainingError,
+    QueueFullError,
+)
 from distributed_training_tpu.serving.engine import Engine  # noqa: F401
 from distributed_training_tpu.serving.metrics import ServeTelemetry  # noqa: F401
 from distributed_training_tpu.serving.queue import RequestQueue  # noqa: F401
 from distributed_training_tpu.serving.request import (  # noqa: F401
     FINISH_EOS,
     FINISH_LENGTH,
+    FINISH_TIMEOUT,
     ActiveSequence,
     FinishedRequest,
     Request,

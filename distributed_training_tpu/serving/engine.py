@@ -91,8 +91,12 @@ class Engine:
             temperature=cfg.temperature, top_k=cfg.top_k, top_p=cfg.top_p,
             eos_id=cfg.eos_id, pad_id=cfg.pad_id)
         self.queue = RequestQueue(
-            self.budget, default_max_new_tokens=cfg.max_new_tokens)
+            self.budget, default_max_new_tokens=cfg.max_new_tokens,
+            max_depth=cfg.max_queue_depth,
+            ttft_deadline_ms=cfg.ttft_deadline_ms,
+            deadline_ms=cfg.deadline_ms)
         self.scheduler = SlotScheduler(cfg.max_batch)
+        self._drained = False
         self.telemetry = ServeTelemetry(cfg.ring_size)
         self._base_rng = jax.random.PRNGKey(cfg.seed)
         self._iteration = 0
@@ -236,7 +240,15 @@ class Engine:
         it = self._iteration
         self._iteration += 1
         eos = self.sample_cfg.eos_id
+        deadlines = (self.cfg.ttft_deadline_ms is not None
+                     or self.cfg.deadline_ms is not None)
         finished: list[FinishedRequest] = []
+        # Deadline sweep BEFORE admission: a queued request already past
+        # its TTFT/total deadline must not consume a prefill — it
+        # completes with finish reason 'timeout' and zero tokens.
+        if deadlines:
+            for req in self.queue.pop_expired(time.perf_counter()):
+                finished.append(FinishedRequest.timed_out_in_queue(req))
 
         had_work = not self.idle
         if had_work:
@@ -259,7 +271,8 @@ class Engine:
             for seq in active_seqs:
                 seq.note_token(toks[seq.slot], t)
             self.telemetry.on_tokens(len(active_seqs), t)
-            finished.extend(self.scheduler.evict_finished(eos))
+            finished.extend(self.scheduler.evict_finished(
+                eos, now=t if deadlines else None))
 
         if had_work:
             self.telemetry.on_iteration(
@@ -288,6 +301,29 @@ class Engine:
                 break
         return out
 
+    def drain(self, max_iterations: int | None = None
+              ) -> list[FinishedRequest]:
+        """Graceful shutdown: close admission, then complete every
+        request already accepted (queued and slotted).
+
+        New submits raise the typed :class:`~distributed_training_tpu.
+        resilience.errors.DrainingError` the moment this is called (from
+        any thread); the returned completions include deadline evictions.
+        Idempotent — calling again just drains whatever arrived before
+        the close. The SIGTERM path in ``gpt/jax_tpu/serve.py`` and the
+        end of ``tools/serve_bench.py`` both end through here, so no
+        tail request is dropped from the SLA percentiles.
+        """
+        self.queue.close()
+        out = self.run(max_iterations)
+        self._drained = self.idle
+        return out
+
+    @property
+    def draining(self) -> bool:
+        """True once admission has been closed (drain started)."""
+        return self.queue.closed
+
     # -- telemetry surface ---------------------------------------------------
     def stats(self) -> dict[str, Any]:
         """SLA summary. ``queue_depth_max`` is the submit-time high-water
@@ -299,6 +335,13 @@ class Engine:
                                        self.queue.depth_max)
         stats["requests_submitted"] = self.queue.submitted
         stats["requests_rejected"] = self.queue.rejected
+        # Graceful-degradation counters (resilience round): load shed by
+        # the bounded queue, typed drain rejections, and whether the
+        # engine completed a drain (admission closed + everything
+        # accepted was finished).
+        stats["requests_shed"] = self.queue.shed
+        stats["requests_drain_rejected"] = self.queue.drain_rejected
+        stats["drained"] = bool(self._drained)
         return stats
 
     def reset_stats(self) -> None:

@@ -45,6 +45,7 @@ class ServeTelemetry:
         self.tpot_ms: list[float] = []
         self.tokens_emitted = 0
         self.requests_finished = 0
+        self.finish_reasons: dict[str, int] = {}
         self.queue_depth_max = 0
         # Busy time is a SUM of work segments, not first-work→last-token
         # wall clock: at low arrival rates the engine sits idle between
@@ -90,7 +91,10 @@ class ServeTelemetry:
 
     def on_finished(self, fin: FinishedRequest) -> None:
         self.requests_finished += 1
-        self.ttft_ms.append(fin.ttft_ms)
+        self.finish_reasons[fin.finish_reason] = \
+            self.finish_reasons.get(fin.finish_reason, 0) + 1
+        if fin.ttft_ms is not None:  # queue-side timeouts carry no sample
+            self.ttft_ms.append(fin.ttft_ms)
         if fin.tpot_ms is not None:
             self.tpot_ms.append(fin.tpot_ms)
 
@@ -115,6 +119,8 @@ class ServeTelemetry:
         if self._seg_t0 is not None and self._busy_t1 is not None:
             busy_s += max(self._busy_t1 - self._seg_t0, 0.0)
         tput = self.tokens_emitted / busy_s if busy_s > 0 else 0.0
+        from distributed_training_tpu.serving.request import FINISH_TIMEOUT
+
         return {
             "throughput_tok_s": tput,
             "ttft_p50_ms": pct(self.ttft_ms, 50),
@@ -123,6 +129,7 @@ class ServeTelemetry:
             "tpot_p95_ms": pct(self.tpot_ms, 95),
             "queue_depth_max": int(self.queue_depth_max),
             "requests_finished": self.requests_finished,
+            "requests_timed_out": self.finish_reasons.get(FINISH_TIMEOUT, 0),
             "tokens_emitted": self.tokens_emitted,
             "busy_seconds": busy_s,
         }

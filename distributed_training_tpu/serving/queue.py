@@ -1,13 +1,25 @@
 """Thread-safe arrival-ordered request queue with admission control.
 
 Producers (CLI readers, the bench load generator, RPC handlers) submit
-from any thread; the engine drains from its scheduling loop. Admission is
-checked at submit time against the engine's per-slot cache budget
-(:func:`~distributed_training_tpu.inference.sampler.cache_budget`): a
-request whose prompt + completion cannot ever fit a slot is rejected with
-the typed :class:`~distributed_training_tpu.inference.sampler.
-CacheBudgetError` immediately, instead of wedging the head of the queue
-forever (it would never become admissible).
+from any thread; the engine drains from its scheduling loop. Admission
+applies three typed guards at submit time, so a request that can never
+be served (or should not be) fails fast in the producer instead of
+wedging or bloating the queue:
+
+- **budget** — ``prompt_len + max_new_tokens`` must fit the per-slot
+  KV-cache budget (:func:`~distributed_training_tpu.inference.sampler.
+  cache_budget`); violations raise the typed :class:`~distributed_
+  training_tpu.inference.sampler.CacheBudgetError` (it would never
+  become admissible, so queueing it would wedge the queue head forever).
+- **depth** — an optional ``max_depth`` bounds the queue; a submit that
+  would exceed it is SHED with :class:`~distributed_training_tpu.
+  resilience.errors.QueueFullError` (every queued request's TTFT grows
+  with depth — past the SLA horizon, rejecting early beats accepting
+  work that is already doomed to time out).
+- **drain** — :meth:`close` flips admission off for graceful shutdown;
+  subsequent submits raise :class:`~distributed_training_tpu.resilience.
+  errors.DrainingError` while the engine finishes what it already
+  accepted.
 """
 
 from __future__ import annotations
@@ -19,38 +31,58 @@ import time
 import numpy as np
 
 from distributed_training_tpu.inference.sampler import CacheBudgetError
+from distributed_training_tpu.resilience.errors import (
+    DrainingError,
+    QueueFullError,
+)
 from distributed_training_tpu.serving.request import Request
 
 
 class RequestQueue:
-    """FIFO of :class:`Request` with a per-request length guard.
+    """FIFO of :class:`Request` with typed admission guards.
 
     ``budget`` is the per-slot KV-cache capacity in tokens; ``submit``
     enforces ``prompt_len + max_new_tokens <= budget``. ``depth_max``
-    tracks the high-water queue depth for SLA telemetry.
+    tracks the high-water queue depth for SLA telemetry; ``shed`` /
+    ``drain_rejected`` count the load-shedding and drain rejections.
+    ``ttft_deadline_ms`` / ``deadline_ms`` stamp every admitted request
+    with absolute deadlines (the engine evicts violators with finish
+    reason ``timeout``).
     """
 
-    def __init__(self, budget: int, default_max_new_tokens: int = 128):
+    def __init__(self, budget: int, default_max_new_tokens: int = 128,
+                 max_depth: int | None = None,
+                 ttft_deadline_ms: float | None = None,
+                 deadline_ms: float | None = None):
         if budget < 2:
             raise ValueError(f"budget must be >= 2, got {budget}")
+        if max_depth is not None and max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.budget = int(budget)
         self.default_max_new_tokens = int(default_max_new_tokens)
+        self.max_depth = max_depth
+        self.ttft_deadline_ms = ttft_deadline_ms
+        self.deadline_ms = deadline_ms
         self._lock = threading.Lock()
         self._q: collections.deque[Request] = collections.deque()
+        self._closed = False
         self._next_uid = 0
         self.depth_max = 0
         self.submitted = 0
         self.rejected = 0
+        self.shed = 0
+        self.drain_rejected = 0
 
     def submit(self, prompt, max_new_tokens: int | None = None,
                arrival_t: float | None = None) -> Request:
         """Enqueue one request; returns its admission record.
 
         Raises :class:`CacheBudgetError` when the request can never fit a
-        slot. ``arrival_t`` defaults to now (perf_counter) — the bench
-        passes its scheduled arrival so queueing delay is measured from
-        the intended arrival, not from when the host thread got around to
-        the submit call.
+        slot, :class:`QueueFullError` when the bounded queue is full, and
+        :class:`DrainingError` after :meth:`close`. ``arrival_t``
+        defaults to now (perf_counter) — the bench passes its scheduled
+        arrival so queueing delay is measured from the intended arrival,
+        not from when the host thread got around to the submit call.
         """
         tokens = np.ascontiguousarray(np.asarray(prompt).reshape(-1),
                                       dtype=np.int32)
@@ -67,32 +99,80 @@ class RequestQueue:
             raise CacheBudgetError(
                 f"prompt ({tokens.size}) + max_new_tokens ({mnt}) = "
                 f"{total} exceeds the KV cache (max_len={self.budget})")
+        arrival = (time.perf_counter()
+                   if arrival_t is None else float(arrival_t))
         with self._lock:
+            if self._closed:
+                self.drain_rejected += 1
+                raise DrainingError(
+                    "engine is draining: admission is closed while "
+                    "in-flight requests complete; submit to another "
+                    "replica or retry after restart")
+            if self.max_depth is not None and len(self._q) >= self.max_depth:
+                self.shed += 1
+                raise QueueFullError(
+                    f"request queue is at max_depth={self.max_depth}; "
+                    f"shedding load instead of growing the queue (and "
+                    f"every queued request's TTFT) without bound")
             req = Request(
                 uid=self._next_uid, prompt=tokens, max_new_tokens=mnt,
-                arrival_t=(time.perf_counter()
-                           if arrival_t is None else float(arrival_t)))
+                arrival_t=arrival,
+                ttft_deadline_t=(arrival + self.ttft_deadline_ms / 1e3
+                                 if self.ttft_deadline_ms else None),
+                deadline_t=(arrival + self.deadline_ms / 1e3
+                            if self.deadline_ms else None))
             self._next_uid += 1
             self._q.append(req)
             self.submitted += 1
             self.depth_max = max(self.depth_max, len(self._q))
         return req
 
+    def close(self) -> None:
+        """Close admission (idempotent): the graceful-drain gate. Queued
+        and slotted requests continue to completion; new submits raise
+        the typed :class:`DrainingError`."""
+        with self._lock:
+            self._closed = True
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
     def reset_counters(self) -> None:
         """Zero the telemetry counters (depth high-water, submitted,
-        rejected) without touching queued requests or the uid sequence —
-        the engine calls this from ``reset_stats`` so a compile warm-up
-        pass doesn't contaminate the measured SLA window."""
+        rejected, shed, drain_rejected) without touching queued requests
+        or the uid sequence — the engine calls this from ``reset_stats``
+        so a compile warm-up pass doesn't contaminate the measured SLA
+        window."""
         with self._lock:
             self.depth_max = len(self._q)
             self.submitted = 0
             self.rejected = 0
+            self.shed = 0
+            self.drain_rejected = 0
 
     def pop(self) -> Request | None:
         """Oldest queued request, or None when empty (never blocks — the
         engine polls at iteration boundaries, it does not park a thread)."""
         with self._lock:
             return self._q.popleft() if self._q else None
+
+    def pop_expired(self, now: float) -> list[Request]:
+        """Remove and return every queued request already past its TTFT
+        or total deadline — they will never make their SLA, so they must
+        not consume a prefill (the engine completes them with finish
+        reason ``timeout``)."""
+        with self._lock:
+            expired = [r for r in self._q
+                       if (r.ttft_deadline_t is not None
+                           and now >= r.ttft_deadline_t)
+                       or (r.deadline_t is not None and now >= r.deadline_t)]
+            if expired:
+                dead = set(id(r) for r in expired)
+                self._q = collections.deque(
+                    r for r in self._q if id(r) not in dead)
+        return expired
 
     def __len__(self) -> int:
         with self._lock:

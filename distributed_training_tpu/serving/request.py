@@ -17,19 +17,29 @@ import dataclasses
 
 import numpy as np
 
-# Why a sequence left its slot.
+# Why a sequence left its slot (or the queue).
 FINISH_EOS = "eos"        # emitted the configured eos_id
 FINISH_LENGTH = "length"  # hit its max_new_tokens budget
+FINISH_TIMEOUT = "timeout"  # missed its TTFT/total deadline (evicted)
 
 
 @dataclasses.dataclass(frozen=True)
 class Request:
-    """One admitted generation request (arrival-ordered by ``uid``)."""
+    """One admitted generation request (arrival-ordered by ``uid``).
+
+    ``ttft_deadline_t`` / ``deadline_t`` are absolute ``perf_counter``
+    deadlines (None = none): a request still queued past its TTFT
+    deadline, or still decoding past its total deadline, is evicted with
+    finish reason ``timeout`` instead of holding a slot or queue
+    position forever under overload.
+    """
 
     uid: int
     prompt: np.ndarray        # int32 [T], T >= 1
     max_new_tokens: int
     arrival_t: float          # perf_counter at submit
+    ttft_deadline_t: float | None = None
+    deadline_t: float | None = None
 
 
 @dataclasses.dataclass
@@ -48,27 +58,42 @@ class ActiveSequence:
             self.first_token_t = t
         self.last_token_t = t
 
-    def finish_reason(self, eos_id: int | None) -> str | None:
-        """None while the sequence should keep decoding."""
+    def finish_reason(self, eos_id: int | None,
+                      now: float | None = None) -> str | None:
+        """None while the sequence should keep decoding.
+
+        EOS and budget win over a deadline landing on the same token (a
+        naturally-finished request is not a timeout); ``now`` enables the
+        total-deadline check — callers without deadlines pass nothing.
+        """
         if eos_id is not None and self.tokens and self.tokens[-1] == eos_id:
             return FINISH_EOS
         if len(self.tokens) >= self.request.max_new_tokens:
             return FINISH_LENGTH
+        dl = self.request.deadline_t
+        if now is not None and dl is not None and now >= dl:
+            return FINISH_TIMEOUT
         return None
 
 
 @dataclasses.dataclass(frozen=True)
 class FinishedRequest:
-    """A completed request with its per-request SLA measurements."""
+    """A completed request with its per-request SLA measurements.
+
+    A queue-side deadline eviction completes with zero tokens and no
+    latency samples (``ttft_ms``/``first_token_t`` None): the request
+    never produced a first token, so it contributes to the timeout
+    counter, not to the TTFT percentiles.
+    """
 
     uid: int
     prompt: np.ndarray
-    tokens: np.ndarray        # int32 [n], n >= 1 (EOS included when hit)
-    finish_reason: str        # FINISH_EOS | FINISH_LENGTH
-    ttft_ms: float            # arrival → first emitted token
-    tpot_ms: float | None     # mean inter-token ms; None for 1-token outputs
+    tokens: np.ndarray        # int32 [n]; n >= 1 except queue timeouts
+    finish_reason: str        # FINISH_EOS | FINISH_LENGTH | FINISH_TIMEOUT
+    ttft_ms: float | None     # arrival → first emitted token
+    tpot_ms: float | None     # mean inter-token ms; None for <2 tokens
     arrival_t: float          # perf_counter timestamps (fairness audits)
-    first_token_t: float
+    first_token_t: float | None
 
     @staticmethod
     def from_active(seq: ActiveSequence, reason: str) -> "FinishedRequest":
@@ -85,4 +110,19 @@ class FinishedRequest:
             tpot_ms=tpot,
             arrival_t=seq.request.arrival_t,
             first_token_t=seq.first_token_t,
+        )
+
+    @staticmethod
+    def timed_out_in_queue(req: Request) -> "FinishedRequest":
+        """A request evicted from the queue past its deadline — it never
+        reached a slot, so it carries no tokens and no latency samples."""
+        return FinishedRequest(
+            uid=req.uid,
+            prompt=req.prompt,
+            tokens=np.zeros((0,), np.int32),
+            finish_reason=FINISH_TIMEOUT,
+            ttft_ms=None,
+            tpot_ms=None,
+            arrival_t=req.arrival_t,
+            first_token_t=None,
         )

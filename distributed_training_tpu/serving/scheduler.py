@@ -76,19 +76,24 @@ class SlotScheduler:
             seated.append(seq)
         return seated
 
-    def evict_finished(self, eos_id: int | None) -> list[FinishedRequest]:
+    def evict_finished(self, eos_id: int | None,
+                       now: float | None = None) -> list[FinishedRequest]:
         """Free every slot whose sequence has finished; returns results.
 
         Called after tokens land (post-prefill and post-decode-step): a
         one-token request or an instant EOS finishes without ever joining
-        a decode iteration.
+        a decode iteration. ``now`` additionally evicts slots past their
+        total deadline with finish reason ``timeout`` (partial tokens
+        returned) — a slot is serving capacity, and a request that
+        already missed its SLA must hand it to one that can still make
+        its own.
         """
         done: list[FinishedRequest] = []
         for slot in range(self.num_slots):
             seq = self._slots[slot]
             if seq is None:
                 continue
-            reason = seq.finish_reason(eos_id)
+            reason = seq.finish_reason(eos_id, now)
             if reason is not None:
                 done.append(FinishedRequest.from_active(seq, reason))
                 self._slots[slot] = None

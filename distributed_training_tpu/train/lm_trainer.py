@@ -72,6 +72,12 @@ from distributed_training_tpu.observability import (
     forward_flops,
     train_step_flops,
 )
+from distributed_training_tpu.resilience import retry as retry_lib
+from distributed_training_tpu.resilience import chaos as chaos_lib
+from distributed_training_tpu.resilience.async_ckpt import (
+    AsyncCheckpointWriter,
+)
+from distributed_training_tpu.resilience.chaos import ChaosMonkey
 from distributed_training_tpu.runtime.preemption import PreemptionGuard
 from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
 from distributed_training_tpu.utils.metrics_io import MetricsWriter
@@ -93,6 +99,8 @@ def restore_lm_checkpoint(directory: str, epoch: int, state, layout=None):
             directory, epoch, state, layout=layout)
     except FileNotFoundError:
         raise  # missing checkpoint: not a model-tree problem
+    except ckpt_lib.CheckpointCorruptError:
+        raise  # typed corruption verdict already names dir + remedy
     except Exception as e:
         if isinstance(e, ValueError) and "PERMUTED" in str(e):
             raise  # the layout guard's own refusal is already actionable
@@ -442,7 +450,19 @@ class LMTrainer:
             printer=self.coord.print,
             # Forensics default next to the run's durable artifacts.
             dump_dir=cfg.observability.dump_dir or os.path.join(
-                cfg.checkpoint.directory, "flight"))
+                cfg.checkpoint.directory, "flight"),
+            extra_provider=self._resilience_snapshot)
+        # Resilience: fault injection + background checkpoint writer
+        # (single-process only; multihost saves stay synchronous — see
+        # trainer.py for the rationale).
+        self.chaos = ChaosMonkey(cfg.chaos) if cfg.chaos.active else None
+        self._ckpt_writer = None
+        if cfg.checkpoint.async_save and jax.process_count() == 1:
+            self._ckpt_writer = AsyncCheckpointWriter(
+                post_save=(self.chaos.after_checkpoint_save
+                           if self.chaos else None),
+                printer=self.coord.print)
+        self._sync_saves = 0
         self._guard: PreemptionGuard | None = None
         self._global_step = 0
         self._epoch_step = 0
@@ -455,6 +475,39 @@ class LMTrainer:
             f"zero_stage={cfg.zero.stage} dtype={cfg.precision.dtype} "
             f"seq_len={lm.seq_len}"
             + (f" grad_accum={self.grad_accum}" if self.grad_accum > 1 else ""))
+
+    # -- resilience ---------------------------------------------------------
+    def _save_ckpt(self, epoch: int, *, sync: bool = False, **kw) -> None:
+        """One save through the configured path (async writer or sync
+        orbax); ``sync=True`` = the preemption durability contract."""
+        d = self.cfg.checkpoint.directory
+        kw.setdefault("layout", self._ckpt_layout())
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.save(d, epoch, self.state, sync=sync, **kw)
+            return
+        path = ckpt_lib.save_checkpoint(d, epoch, self.state, **kw)
+        self._sync_saves += 1
+        if self.chaos is not None:
+            self.chaos.after_checkpoint_save(path, epoch)
+
+    def _prune_ckpts(self) -> None:
+        d, keep = self.cfg.checkpoint.directory, self.cfg.checkpoint.keep
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.prune(d, keep)
+        else:
+            ckpt_lib.prune_checkpoints(d, keep)
+
+    def _resilience_snapshot(self) -> dict:
+        """Flight-dump resilience section (tools/flight_report.py)."""
+        c = {"io_retries": retry_lib.total_retries(),
+             "saves_committed": self._sync_saves, "saves_failed": 0}
+        if self._ckpt_writer is not None:
+            c["saves_committed"] += \
+                self._ckpt_writer.counters["saves_committed"]
+            c["saves_failed"] = self._ckpt_writer.counters["saves_failed"]
+        if self.chaos is not None:
+            c["chaos_faults"] = dict(self.chaos.counters)
+        return {"resilience": c}
 
     # -- data ---------------------------------------------------------------
     def make_loaders(self) -> tuple[TokenLoader, TokenLoader]:
@@ -534,6 +587,8 @@ class LMTrainer:
                 self._epoch_step += 1
                 fetched = self.meter.push(self._global_step, metrics)
                 self.obs.on_step(self._global_step)
+                if self.chaos is not None:
+                    self.chaos.on_step(self._global_step)
                 bar.update()
                 if fetched:
                     extras = self.obs.on_flush(
@@ -588,6 +643,8 @@ class LMTrainer:
 
     # -- full run -----------------------------------------------------------
     def fit(self) -> dict:
+        if self.chaos is not None:
+            chaos_lib.install(self.chaos)  # data loaders poll it
         try:
             result = self._fit()
             # Surfaces a deferred anomaly raise whose trace window the
@@ -600,6 +657,10 @@ class LMTrainer:
             self.obs.on_crash()  # flight record before the exception flies
             raise
         finally:
+            if self.chaos is not None:
+                chaos_lib.uninstall()
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.close(raise_on_error=False)
             self.obs.close(raise_pending=False)  # idempotent trace teardown
             self.metrics_writer.close()
 
@@ -651,10 +712,10 @@ class LMTrainer:
                         next_ep = epoch + 1 if done else epoch
                         estep = 0 if done else self._epoch_step
                         with self.clock.phase("ckpt"):
-                            ckpt_lib.save_checkpoint(
-                                cfg.checkpoint.directory, epoch, self.state,
-                                next_epoch=next_ep, epoch_step=estep,
-                                layout=self._ckpt_layout())
+                            # sync: durable before the grace window ends.
+                            self._save_ckpt(epoch, sync=True,
+                                            next_epoch=next_ep,
+                                            epoch_step=estep)
                         self.coord.print(
                             f"[lm_trainer] SIGTERM: saved preemption "
                             f"checkpoint (resumes at epoch {next_ep} "
@@ -668,12 +729,13 @@ class LMTrainer:
                 if cfg.checkpoint.interval and (
                         epoch + 1) % cfg.checkpoint.interval == 0:
                     with self.clock.phase("ckpt"):
-                        ckpt_lib.save_checkpoint(
-                            cfg.checkpoint.directory, epoch, self.state,
-                            layout=self._ckpt_layout())
-                        ckpt_lib.prune_checkpoints(
-                            cfg.checkpoint.directory, cfg.checkpoint.keep)
+                        self._save_ckpt(epoch)
+                        self._prune_ckpts()
         self._guard = None
+        if self._ckpt_writer is not None:
+            # Durable before fit() reports done (failures counted, not
+            # thrown over a successful run — see trainer.py).
+            self._ckpt_writer.wait(raise_on_error=False)
         return {"final_perplexity": ppl, "preempted": preempted,
                 "last_metrics": self.meter.last,
                 "steps": int(jax.device_get(self.state.step))}

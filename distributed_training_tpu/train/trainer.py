@@ -48,6 +48,12 @@ from distributed_training_tpu.observability import (
     forward_flops,
     train_step_flops,
 )
+from distributed_training_tpu.resilience import retry as retry_lib
+from distributed_training_tpu.resilience.async_ckpt import (
+    AsyncCheckpointWriter,
+)
+from distributed_training_tpu.resilience.chaos import ChaosMonkey
+from distributed_training_tpu.resilience import chaos as chaos_lib
 from distributed_training_tpu.runtime.preemption import PreemptionGuard
 from distributed_training_tpu.utils.logging import EpochBar, MetricMeter
 from distributed_training_tpu.utils.metrics_io import MetricsWriter
@@ -226,7 +232,19 @@ class Trainer:
             printer=self.coord.print,
             # Forensics default next to the run's durable artifacts.
             dump_dir=cfg.observability.dump_dir or os.path.join(
-                cfg.checkpoint.directory, "flight"))
+                cfg.checkpoint.directory, "flight"),
+            extra_provider=self._resilience_snapshot)
+        # Resilience: deterministic fault injection + the background
+        # checkpoint writer (single-process only — multihost snapshots
+        # need orbax's own per-host gathers, so those save synchronously).
+        self.chaos = ChaosMonkey(cfg.chaos) if cfg.chaos.active else None
+        self._ckpt_writer = None
+        if cfg.checkpoint.async_save and jax.process_count() == 1:
+            self._ckpt_writer = AsyncCheckpointWriter(
+                post_save=(self.chaos.after_checkpoint_save
+                           if self.chaos else None),
+                printer=self.coord.print)
+        self._sync_saves = 0
         self._guard: PreemptionGuard | None = None
         self._stats_refresh = None
         self._global_step = 0
@@ -237,6 +255,41 @@ class Trainer:
             f"plugin={cfg.plugin} zero_stage={cfg.zero.stage} "
             f"dtype={cfg.precision.dtype}"
             + (f" grad_accum={self.grad_accum}" if self.grad_accum > 1 else ""))
+
+    # -- resilience ---------------------------------------------------------
+    def _save_ckpt(self, epoch: int, *, sync: bool = False, **kw) -> None:
+        """One checkpoint save through the configured path: async writer
+        (snapshot now, persist in background) or synchronous orbax.
+        ``sync=True`` is the preemption contract — durable before return."""
+        d = self.cfg.checkpoint.directory
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.save(d, epoch, self.state, sync=sync, **kw)
+            return
+        path = ckpt_lib.save_checkpoint(d, epoch, self.state, **kw)
+        self._sync_saves += 1
+        if self.chaos is not None:
+            self.chaos.after_checkpoint_save(path, epoch)
+
+    def _prune_ckpts(self) -> None:
+        """Retention sweep, ordered after any in-flight async save."""
+        d, keep = self.cfg.checkpoint.directory, self.cfg.checkpoint.keep
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.prune(d, keep)
+        else:
+            ckpt_lib.prune_checkpoints(d, keep)
+
+    def _resilience_snapshot(self) -> dict:
+        """Extra flight-dump section: checkpoint durability + I/O retry
+        counters (rendered by tools/flight_report.py)."""
+        c = {"io_retries": retry_lib.total_retries(),
+             "saves_committed": self._sync_saves, "saves_failed": 0}
+        if self._ckpt_writer is not None:
+            c["saves_committed"] += \
+                self._ckpt_writer.counters["saves_committed"]
+            c["saves_failed"] = self._ckpt_writer.counters["saves_failed"]
+        if self.chaos is not None:
+            c["chaos_faults"] = dict(self.chaos.counters)
+        return {"resilience": c}
 
     # -- data ---------------------------------------------------------------
     def make_loaders(self):
@@ -294,6 +347,8 @@ class Trainer:
                 self._epoch_step += 1
                 fetched = self.meter.push(self._global_step, metrics)
                 self.obs.on_step(self._global_step)
+                if self.chaos is not None:
+                    self.chaos.on_step(self._global_step)
                 bar.update()
                 if fetched:
                     extras = self.obs.on_flush(
@@ -419,6 +474,10 @@ class Trainer:
 
     # -- full run -----------------------------------------------------------
     def fit(self) -> dict:
+        if self.chaos is not None:
+            # Data loaders poll the process-global chaos registration for
+            # transient-I/O injection; scoped to this fit only.
+            chaos_lib.install(self.chaos)
         try:
             result = self._fit()
             # Surfaces a deferred anomaly raise whose trace window the
@@ -434,6 +493,13 @@ class Trainer:
             self.obs.on_crash()
             raise
         finally:
+            if self.chaos is not None:
+                chaos_lib.uninstall()
+            if self._ckpt_writer is not None:
+                # Drain + stop the writer thread; a background save
+                # failure was already counted/printed — it must not mask
+                # this run's real outcome or exception.
+                self._ckpt_writer.close(raise_on_error=False)
             self.obs.close(raise_pending=False)  # idempotent trace teardown
             # Both exits (incl. preemption — the process is about to die in
             # its SIGTERM grace window — and the target_acc raise) must
@@ -480,9 +546,12 @@ class Trainer:
                         next_ep = epoch + 1 if done else epoch
                         estep = 0 if done else self._epoch_step
                         with self.clock.phase("ckpt"):
-                            ckpt_lib.save_checkpoint(
-                                cfg.checkpoint.directory, epoch, self.state,
-                                next_epoch=next_ep, epoch_step=estep)
+                            # sync: the process dies in its grace window
+                            # right after this — the save must be durable
+                            # (and verified) before returning.
+                            self._save_ckpt(epoch, sync=True,
+                                            next_epoch=next_ep,
+                                            epoch_step=estep)
                         self.coord.print(
                             f"[trainer] SIGTERM: saved preemption checkpoint "
                             f"(resumes at epoch {next_ep} step {estep})")
@@ -496,11 +565,14 @@ class Trainer:
                 if cfg.checkpoint.interval and (
                         epoch + 1) % cfg.checkpoint.interval == 0:
                     with self.clock.phase("ckpt"):
-                        ckpt_lib.save_checkpoint(
-                            cfg.checkpoint.directory, epoch, self.state)
-                        ckpt_lib.prune_checkpoints(
-                            cfg.checkpoint.directory, cfg.checkpoint.keep)
+                        self._save_ckpt(epoch)
+                        self._prune_ckpts()
         self._guard = None
+        if self._ckpt_writer is not None:
+            # The run's saves must be durable before fit() reports done;
+            # a background failure is surfaced as counters + a print, not
+            # as a crash of the (successful) training run.
+            self._ckpt_writer.wait(raise_on_error=False)
         if preempted:
             return {"final_acc": None, "preempted": True,
                     "last_metrics": self.meter.last,

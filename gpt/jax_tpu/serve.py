@@ -47,6 +47,20 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--prefill-bucket", type=int, default=64,
                         help="prompt lengths pad to a multiple of this "
                              "(bounds prefill compile count)")
+    # Graceful degradation (resilience round; docs/RESILIENCE.md).
+    parser.add_argument("--max-queue-depth", type=int, default=None,
+                        help="bounded admission: a submit beyond this "
+                             "queue depth is shed with a typed "
+                             "QueueFullError instead of growing TTFT "
+                             "without bound")
+    parser.add_argument("--ttft-deadline-ms", type=float, default=None,
+                        help="evict requests still queued past this "
+                             "time-to-first-token deadline (finish "
+                             "reason 'timeout')")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="evict requests still decoding past this "
+                             "total deadline (partial tokens returned, "
+                             "finish reason 'timeout')")
     parser.add_argument("--flight-dump", type=str, default=None,
                         help="write a flight-recorder JSON here at exit "
                              "(tools/flight_report.py renders it)")
@@ -96,7 +110,12 @@ def main() -> int:
         moe_kwargs_from_flags,
     )
     from distributed_training_tpu.inference.sampler import CacheBudgetError
-    from distributed_training_tpu.serving import Engine
+    from distributed_training_tpu.runtime.preemption import PreemptionGuard
+    from distributed_training_tpu.serving import (
+        DrainingError,
+        Engine,
+        QueueFullError,
+    )
 
     moe_kwargs = moe_kwargs_from_flags(
         enabled=args.moe, num_experts=args.num_experts,
@@ -130,6 +149,9 @@ def main() -> int:
         top_p=args.top_p,
         eos_id=args.eos_id,
         prefill_bucket=args.prefill_bucket,
+        max_queue_depth=args.max_queue_depth,
+        ttft_deadline_ms=args.ttft_deadline_ms,
+        deadline_ms=args.deadline_ms,
         seed=args.seed,
     ))
 
@@ -142,29 +164,49 @@ def main() -> int:
     if not lines:
         raise SystemExit("no prompts (stdin/--prompts-file was empty)")
 
+    # Graceful drain: SIGTERM latches (PreemptionGuard); the submit loop
+    # then closes admission — remaining prompts are rejected with the
+    # typed DrainingError — and the engine completes every request it
+    # already accepted before the SLA/flight dump is emitted. A second
+    # SIGTERM re-raises through the previous handler ("now" semantics).
     texts: dict[int, str] = {}
-    for text in lines:
-        tokens = np.frombuffer(text.encode("utf-8"), np.uint8)
-        if (tokens >= args.vocab_size).any():
-            print(f"[serve] SKIP (bytes outside vocab "
-                  f"{args.vocab_size}): {text!r}", file=sys.stderr)
-            continue
-        try:
-            req = engine.submit(tokens.astype(np.int32))
-        except CacheBudgetError as e:
-            print(f"[serve] REJECT {text!r}: {e}", file=sys.stderr)
-            continue
-        texts[req.uid] = text
+    with PreemptionGuard() as guard:
+        print("[serve] engine ready", file=sys.stderr, flush=True)
+        for text in lines:
+            if guard.triggered:
+                engine.queue.close()  # idempotent; typed rejects below
+            tokens = np.frombuffer(text.encode("utf-8"), np.uint8)
+            if (tokens >= args.vocab_size).any():
+                print(f"[serve] SKIP (bytes outside vocab "
+                      f"{args.vocab_size}): {text!r}", file=sys.stderr)
+                continue
+            try:
+                req = engine.submit(tokens.astype(np.int32))
+            except DrainingError as e:
+                print(f"[serve] DRAINING, reject {text!r}: {e}",
+                      file=sys.stderr)
+                continue
+            except (CacheBudgetError, QueueFullError) as e:
+                print(f"[serve] REJECT {text!r}: {e}", file=sys.stderr)
+                continue
+            texts[req.uid] = text
 
-    done = engine.run()
+        # One-shot CLI: no more submits are coming, so ending through
+        # drain() is free for the normal path and makes the SIGTERM path
+        # identical — close admission, finish in-flight, then report.
+        done = engine.drain()
+        if guard.triggered:
+            print(f"[serve] SIGTERM: drained {len(done)} in-flight "
+                  f"request(s), admission closed", file=sys.stderr)
 
     def decode_bytes(toks):
         return bytes(int(t) % 256 for t in toks).decode(
             "utf-8", errors="replace")
 
     for fin in sorted(done, key=lambda f: f.uid):
+        ttft = ("-" if fin.ttft_ms is None else f"{fin.ttft_ms:.1f} ms")
         print(f"[serve] #{fin.uid} ({fin.finish_reason}, "
-              f"ttft {fin.ttft_ms:.1f} ms): "
+              f"ttft {ttft}): "
               f"{texts[fin.uid]!r} -> {decode_bytes(fin.tokens)!r}")
 
     stats = engine.stats()

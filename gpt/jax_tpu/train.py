@@ -143,7 +143,55 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--anomaly-trace-steps", type=int, default=3,
                         help="profiler-trace steps captured after an "
                              "anomaly trigger (0 = no trace)")
+    add_chaos_arguments(parser)
     return parser.parse_args()
+
+
+def add_chaos_arguments(parser: argparse.ArgumentParser) -> None:
+    """Deterministic fault injection (resilience/chaos.py;
+    docs/RESILIENCE.md). All defaults inert. resnet/jax_tpu/train.py
+    mirrors this flag group inline (the backend dirs are deliberately
+    self-contained scripts, like the observability flags) — keep the
+    two in sync when adding knobs."""
+    parser.add_argument("--chaos-seed", type=int, default=0)
+    parser.add_argument("--chaos-kill-at-step", type=int, default=None,
+                        help="deliver --chaos-kill-signal from inside the "
+                             "step loop at this global step (simulated "
+                             "TPU eviction)")
+    parser.add_argument("--chaos-kill-signal", type=str, default="sigterm",
+                        choices=["sigterm", "kill"],
+                        help="sigterm = graceful grace-window eviction "
+                             "(preemption save); kill = SIGKILL, hard "
+                             "death with no save")
+    parser.add_argument("--chaos-torn-ckpt-epoch", type=int, default=None,
+                        help="after this epoch's checkpoint save lands, "
+                             "truncate it and drop its COMMITTED marker "
+                             "(torn write; auto-resume must fall back)")
+    parser.add_argument("--chaos-torn-bytes", type=int, default=64,
+                        help="bytes to leave in the torn file")
+    parser.add_argument("--chaos-data-error-rate", type=float, default=0.0,
+                        help="seeded per-key probability of a one-shot "
+                             "transient data-read error (the retry "
+                             "policy must absorb it)")
+    parser.add_argument("--chaos-slow-step-every", type=int, default=None,
+                        help="inject a host stall every N steps "
+                             "(straggler simulation)")
+    parser.add_argument("--chaos-slow-step-ms", type=float, default=50.0)
+
+
+def chaos_config_from_flags(args: argparse.Namespace):
+    from distributed_training_tpu.config import ChaosConfig
+
+    return ChaosConfig(
+        seed=args.chaos_seed,
+        kill_at_step=args.chaos_kill_at_step,
+        kill_signal=args.chaos_kill_signal,
+        torn_ckpt_epoch=args.chaos_torn_ckpt_epoch,
+        torn_truncate_bytes=args.chaos_torn_bytes,
+        data_error_rate=args.chaos_data_error_rate,
+        slow_step_every=args.chaos_slow_step_every,
+        slow_step_ms=args.chaos_slow_step_ms,
+    )
 
 
 def build_config(args: argparse.Namespace):
@@ -192,6 +240,7 @@ def build_config(args: argparse.Namespace):
             anomaly_action=args.anomaly_action,
             anomaly_trace_steps=args.anomaly_trace_steps,
         ),
+        chaos=chaos_config_from_flags(args),
         precision=dataclasses.replace(cfg.precision, dtype=args.dtype),
         zero=ZeroConfig(stage=args.stage),
         # expert gated on --moe: a dense run must keep the full data axis

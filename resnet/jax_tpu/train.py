@@ -173,6 +173,26 @@ def add_argument() -> argparse.Namespace:
                         choices=["raise", "skip"])
     parser.add_argument("--anomaly-trace-steps", type=int, default=3)
 
+    # Chaos harness (resilience/chaos.py; docs/RESILIENCE.md) — mirrors
+    # gpt/jax_tpu/train.py::add_chaos_arguments (backend dirs are
+    # self-contained scripts; keep in sync). All defaults inert.
+    parser.add_argument("--chaos-seed", type=int, default=0)
+    parser.add_argument("--chaos-kill-at-step", type=int, default=None,
+                        help="deliver --chaos-kill-signal at this global "
+                             "step (simulated TPU eviction)")
+    parser.add_argument("--chaos-kill-signal", type=str, default="sigterm",
+                        choices=["sigterm", "kill"])
+    parser.add_argument("--chaos-torn-ckpt-epoch", type=int, default=None,
+                        help="tear this epoch's save after it lands "
+                             "(truncate + drop COMMITTED; auto-resume "
+                             "must fall back)")
+    parser.add_argument("--chaos-torn-bytes", type=int, default=64)
+    parser.add_argument("--chaos-data-error-rate", type=float, default=0.0,
+                        help="seeded one-shot transient data-read faults "
+                             "(the retry policy must absorb them)")
+    parser.add_argument("--chaos-slow-step-every", type=int, default=None)
+    parser.add_argument("--chaos-slow-step-ms", type=float, default=50.0)
+
     return parser.parse_args()
 
 
@@ -229,6 +249,7 @@ def default_ds_config(dtype: str, stage: int, batch_size: int) -> dict:
 
 def build_config(args: argparse.Namespace):
     from distributed_training_tpu.config import (
+        ChaosConfig,
         CheckpointConfig,
         DataConfig,
         MoEConfig,
@@ -285,6 +306,16 @@ def build_config(args: argparse.Namespace):
             anomaly_detection=args.anomaly_detection,
             anomaly_action=args.anomaly_action,
             anomaly_trace_steps=args.anomaly_trace_steps,
+        ),
+        chaos=ChaosConfig(
+            seed=args.chaos_seed,
+            kill_at_step=args.chaos_kill_at_step,
+            kill_signal=args.chaos_kill_signal,
+            torn_ckpt_epoch=args.chaos_torn_ckpt_epoch,
+            torn_truncate_bytes=args.chaos_torn_bytes,
+            data_error_rate=args.chaos_data_error_rate,
+            slow_step_every=args.chaos_slow_step_every,
+            slow_step_ms=args.chaos_slow_step_ms,
         ),
         checkpoint=CheckpointConfig(
             directory=args.checkpoint,

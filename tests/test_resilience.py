@@ -1,0 +1,496 @@
+"""Resilience subsystem: verified checkpoints, chaos, retry, async saves.
+
+The load-bearing claims, in order of importance:
+
+1. **Crash-resume bitwise equivalence** — an uninterrupted run and a
+   chaos-killed-at-step-k + auto-resumed run produce bit-identical
+   params AND optimizer state, on both the image and LM trainers (the
+   fast 1-epoch in-process variants live here; the 2-epoch subprocess
+   drives are marked ``slow``).
+2. **Last-good fallback** — a torn/uncommitted newest checkpoint is
+   skipped by ``auto_resume`` (quarantined with the typed
+   ``CheckpointCorruptError`` path) and ``prune_checkpoints`` provably
+   retains the last verified save.
+3. **Verified saves** — every ``save_checkpoint`` writes a checksum
+   manifest + atomic COMMITTED marker; truncation, marker loss, and
+   empty dirs are each classified with the typed error.
+4. **Deterministic chaos / retry** — injected transient I/O faults are
+   absorbed by the retry policy; the backoff sequence has no wall-clock
+   randomness.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_tpu import checkpoint as ckpt_lib
+from distributed_training_tpu.config import (
+    ChaosConfig,
+    CheckpointConfig,
+    DataConfig,
+    LMConfig,
+    TrainConfig,
+)
+from distributed_training_tpu.resilience import (
+    AsyncCheckpointWriter,
+    ChaosIOError,
+    ChaosMonkey,
+    CheckpointCorruptError,
+    RetryPolicy,
+    tear_checkpoint,
+    verify_checkpoint,
+)
+from distributed_training_tpu.resilience import chaos as chaos_lib
+from distributed_training_tpu.resilience import retry as retry_lib
+from distributed_training_tpu.resilience.verify import COMMIT_NAME
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _np_state():
+    """A tiny plain-dict state (save/restore treats it as a state dict)."""
+    return {"params": {"w": np.arange(64, dtype=np.float32),
+                       "b": np.ones((4, 4), np.float32)},
+            "opt": {"mu": np.zeros(64, np.float32)}}
+
+
+class TestRetryPolicy:
+    def test_deterministic_backoff_and_success_after_transients(self):
+        slept = []
+        pol = RetryPolicy(max_attempts=4, base_delay_s=0.1, multiplier=2.0,
+                          max_delay_s=0.25, sleep=slept.append)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        before = retry_lib.total_retries()
+        assert pol.call(flaky) == "ok"
+        assert slept == [0.1, 0.2]  # exact, no jitter
+        assert list(pol.delays()) == [0.1, 0.2, 0.25]  # max_delay clamps
+        assert retry_lib.total_retries() == before + 2
+
+    def test_exhausted_attempts_reraise_and_typed_filter(self):
+        pol = RetryPolicy(max_attempts=2, sleep=lambda _: None)
+        with pytest.raises(OSError):
+            pol.call(lambda: (_ for _ in ()).throw(OSError("always")))
+        # Non-retry_on exceptions surface on the FIRST attempt.
+        calls = []
+
+        def structural():
+            calls.append(1)
+            raise ValueError("tree mismatch")
+
+        with pytest.raises(ValueError):
+            pol.call(structural)
+        assert len(calls) == 1
+
+
+class TestVerifiedSaves:
+    def test_save_writes_manifest_and_verifies(self, tmp_path):
+        path = ckpt_lib.save_checkpoint(str(tmp_path), 0, _np_state())
+        assert os.path.isfile(os.path.join(path, "MANIFEST.json"))
+        assert os.path.isfile(os.path.join(path, COMMIT_NAME))
+        verify_checkpoint(path)  # no raise
+        manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+        # Per-leaf content checksums recorded (single-process save).
+        assert any(k.startswith("state/params/") for k in manifest["leaves"])
+
+    def test_truncation_fails_checksum(self, tmp_path):
+        path = ckpt_lib.save_checkpoint(str(tmp_path), 0, _np_state())
+        # Bitrot with the marker intact: checksum must catch it.
+        victim = max(
+            (os.path.join(dp, f) for dp, _, fs in os.walk(path) for f in fs
+             if f not in ("MANIFEST.json", COMMIT_NAME)),
+            key=os.path.getsize)
+        with open(victim, "r+b") as fh:
+            fh.truncate(max(os.path.getsize(victim) - 8, 0))
+        with pytest.raises(CheckpointCorruptError) as ei:
+            verify_checkpoint(path)
+        assert ei.value.reason in ("checksum", "torn")
+        assert path in str(ei.value)
+
+    def test_missing_marker_is_uncommitted(self, tmp_path):
+        path = ckpt_lib.save_checkpoint(str(tmp_path), 0, _np_state())
+        os.remove(os.path.join(path, COMMIT_NAME))
+        with pytest.raises(CheckpointCorruptError) as ei:
+            ckpt_lib.restore_checkpoint(str(tmp_path), 0, _np_state())
+        assert ei.value.reason == "uncommitted"
+
+    def test_empty_dir_restores_typed_not_orbax_crash(self, tmp_path):
+        """Satellite bugfix: a partial/empty epoch_N dir used to surface
+        a raw orbax exception; it must name the dir and the remedy."""
+        os.makedirs(tmp_path / "epoch_0")
+        with pytest.raises(CheckpointCorruptError, match="auto_resume"):
+            ckpt_lib.restore_checkpoint(str(tmp_path), 0, _np_state())
+
+    def test_legacy_manifestless_save_still_verifies(self, tmp_path):
+        """Pre-resilience saves (plain orbax, no manifest/marker) must
+        keep restoring — they are valid, just unverifiable."""
+        import orbax.checkpoint as ocp
+
+        ocp.PyTreeCheckpointer().save(
+            str(tmp_path / "epoch_1"),
+            {"state": _np_state(), "meta": {"epoch": np.int32(1)}})
+        verify_checkpoint(str(tmp_path / "epoch_1"))  # no raise
+        assert ckpt_lib.latest_valid_epoch(str(tmp_path)) == 1
+
+
+class TestLastGoodFallback:
+    def test_latest_valid_epoch_skips_and_quarantines(self, tmp_path):
+        for e in range(3):
+            ckpt_lib.save_checkpoint(str(tmp_path), e, _np_state())
+        tear_checkpoint(str(tmp_path / "epoch_2"))
+        with pytest.warns(UserWarning, match="quarantined"):
+            assert ckpt_lib.latest_valid_epoch(str(tmp_path)) == 1
+        assert os.path.isdir(tmp_path / "epoch_2.corrupt")
+        # The quarantined dir no longer matches epoch_N: later scans are
+        # clean and latest_epoch agrees.
+        assert ckpt_lib.latest_epoch(str(tmp_path)) == 1
+
+    def test_resolve_resume_falls_back(self, tmp_path):
+        for e in range(2):
+            ckpt_lib.save_checkpoint(str(tmp_path), e, _np_state())
+        os.remove(tmp_path / "epoch_1" / COMMIT_NAME)
+        cfg = CheckpointConfig(directory=str(tmp_path), auto_resume=True)
+        with pytest.warns(UserWarning):
+            assert ckpt_lib.resolve_resume(cfg) == 0
+        # An EXPLICIT resume of a bad epoch must surface the typed error,
+        # not silently fall back — the user named that save.
+        ckpt_lib.save_checkpoint(str(tmp_path), 5, _np_state())
+        os.remove(tmp_path / "epoch_5" / COMMIT_NAME)
+        with pytest.raises(CheckpointCorruptError):
+            ckpt_lib.restore_checkpoint(str(tmp_path), 5, _np_state())
+
+    def test_prune_retains_last_verified(self, tmp_path):
+        for e in range(4):
+            ckpt_lib.save_checkpoint(str(tmp_path), e, _np_state())
+        # Newest two are bad: the last VERIFIED save is epoch 1.
+        tear_checkpoint(str(tmp_path / "epoch_3"))
+        os.remove(tmp_path / "epoch_2" / COMMIT_NAME)
+        ckpt_lib.prune_checkpoints(str(tmp_path), keep=1)
+        left = sorted(d for d in os.listdir(tmp_path)
+                      if d.startswith("epoch_"))
+        # keep=1 retains the newest (epoch_3, torn) by age — AND epoch_1,
+        # the last verified save, which must never be deleted.
+        assert "epoch_1" in left and "epoch_0" not in left
+        assert ckpt_lib.latest_valid_epoch(
+            str(tmp_path), quarantine=False) == 1
+
+
+class TestAsyncCheckpointWriter:
+    def test_background_save_round_trips_verified(self, tmp_path):
+        state = _np_state()
+        w = AsyncCheckpointWriter(printer=lambda *_: None)
+        w.save(str(tmp_path), 0, state)
+        w.wait()
+        assert w.counters == {"saves_committed": 1, "saves_failed": 0}
+        verify_checkpoint(str(tmp_path / "epoch_0"))
+        restored, start, _ = ckpt_lib.restore_checkpoint(
+            str(tmp_path), 0, state)
+        np.testing.assert_array_equal(restored["params"]["w"],
+                                      state["params"]["w"])
+        assert start == 1
+        w.close()
+
+    def test_failure_counted_and_surfaced_on_wait(self, tmp_path,
+                                                  monkeypatch):
+        def boom(*a, **kw):
+            raise OSError("disk on fire")
+
+        monkeypatch.setattr(ckpt_lib, "save_checkpoint", boom)
+        w = AsyncCheckpointWriter(printer=lambda *_: None)
+        w.save(str(tmp_path), 0, _np_state())
+        with pytest.raises(RuntimeError, match="disk on fire"):
+            w.wait(raise_on_error=True)
+        assert w.counters["saves_failed"] == 1
+        w.close()  # close after a failure must not raise
+
+    def test_post_save_hook_runs_in_writer(self, tmp_path):
+        """The chaos torn-write hook rides post_save: the tear happens
+        after the background persist, exactly where a crash would."""
+        monkey = ChaosMonkey(ChaosConfig(torn_ckpt_epoch=0))
+        w = AsyncCheckpointWriter(post_save=monkey.after_checkpoint_save,
+                                  printer=lambda *_: None)
+        w.save(str(tmp_path), 0, _np_state())
+        w.wait()
+        w.close()
+        assert monkey.counters["torn_ckpts"] == 1
+        with pytest.raises(CheckpointCorruptError):
+            verify_checkpoint(str(tmp_path / "epoch_0"))
+
+
+class TestChaosHarness:
+    def test_io_faults_are_seeded_and_one_shot(self):
+        monkey = ChaosMonkey(ChaosConfig(data_error_rate=1.0, seed=7))
+        with pytest.raises(ChaosIOError):
+            monkey.io_check("data", "some/file")
+        monkey.io_check("data", "some/file")  # transient: second try passes
+        assert monkey.counters["io_faults"] == 1
+        # rate 0 injects nothing.
+        ChaosMonkey(ChaosConfig(data_error_rate=0.0)).io_check("data", "x")
+
+    def test_injected_data_fault_absorbed_by_retry(self, tmp_path):
+        """End to end through a real read path: byte_corpus under a
+        100%% one-shot fault rate succeeds via the retry policy."""
+        from distributed_training_tpu.data.lm_text import byte_corpus
+
+        corpus = tmp_path / "corpus.txt"
+        corpus.write_bytes(bytes(range(256)) * 8)
+        monkey = ChaosMonkey(ChaosConfig(data_error_rate=1.0))
+        before = retry_lib.total_retries()
+        chaos_lib.install(monkey)
+        try:
+            toks = byte_corpus(str(corpus), n=4, seq_len=16)
+        finally:
+            chaos_lib.uninstall()
+        assert toks.shape == (4, 17)
+        assert monkey.counters["io_faults"] == 1
+        assert retry_lib.total_retries() == before + 1
+
+    def test_sigterm_kill_latches_preemption_guard(self):
+        from distributed_training_tpu.runtime.preemption import (
+            PreemptionGuard,
+        )
+
+        monkey = ChaosMonkey(ChaosConfig(kill_at_step=3))
+        with PreemptionGuard() as guard:
+            monkey.on_step(2)
+            assert not guard.triggered
+            monkey.on_step(3)
+            assert guard.triggered
+            monkey.on_step(4)  # one-shot: no second signal (would re-raise)
+        assert monkey.counters["kills"] == 1
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="kill_signal"):
+            ChaosConfig(kill_signal="nuke")
+        with pytest.raises(ValueError, match="data_error_rate"):
+            ChaosConfig(data_error_rate=1.5)
+        assert not ChaosConfig().active
+        assert ChaosConfig(kill_at_step=1).active
+
+
+class TestPreemptionGuardDoubleSignal:
+    def test_second_sigterm_with_default_disposition_terminates(self):
+        """The untested re-raise branch (runtime/preemption.py): a second
+        SIGTERM under a SIG_DFL previous handler resets the disposition
+        and re-raises — the process dies by SIGTERM. Subprocess, module
+        loaded by path (no package/jax import: fast)."""
+        code = (
+            "import importlib.util, signal, sys\n"
+            "spec = importlib.util.spec_from_file_location("
+            "'preemption', sys.argv[1])\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_DFL)\n"
+            "with m.PreemptionGuard() as g:\n"
+            "    signal.raise_signal(signal.SIGTERM)\n"
+            "    assert g.triggered\n"
+            "    print('latched', flush=True)\n"
+            "    signal.raise_signal(signal.SIGTERM)\n"
+            "print('survived')\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code,
+             os.path.join(REPO, "distributed_training_tpu", "runtime",
+                          "preemption.py")],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == -signal.SIGTERM, (out.returncode,
+                                                   out.stderr[-500:])
+        assert "latched" in out.stdout and "survived" not in out.stdout
+
+
+# -- crash-resume bitwise equivalence (the headline proof) -------------------
+def _img_cfg(ckpt_dir, **overrides):
+    # augment="normalize_only": RNG-free input transform. pad_crop_flip's
+    # augment RNG stream deliberately RESTARTS on resume (data order is
+    # what resume guarantees — data/pipeline.py::iter_from), so the
+    # bitwise state-machinery pin runs on the deterministic augment path.
+    base = dict(
+        model="resnet_micro",
+        num_epochs=1,
+        log_interval=2,
+        eval_every=0,
+        data=DataConfig(dataset="synthetic_cifar", batch_size=4,
+                        augment="normalize_only",
+                        max_steps_per_epoch=4, prefetch=0),
+        checkpoint=CheckpointConfig(directory=str(ckpt_dir), interval=0,
+                                    auto_resume=True),
+    )
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
+def _lm_cfg(ckpt_dir, **overrides):
+    base = dict(
+        model="transformer_lm",
+        num_epochs=1,
+        log_interval=2,
+        eval_every=0,
+        data=DataConfig(batch_size=2, max_steps_per_epoch=4, prefetch=0),
+        lm=LMConfig(seq_len=16, vocab_size=32, num_layers=1, num_heads=2,
+                    hidden_dim=32, max_len=32, train_sequences=128,
+                    eval_sequences=16),
+        checkpoint=CheckpointConfig(directory=str(ckpt_dir), interval=0,
+                                    auto_resume=True),
+    )
+    base.update(overrides)
+    return TrainConfig(**base)
+
+
+def _assert_states_bitwise_equal(a, b):
+    for leaf_a, leaf_b in zip(jax.tree.leaves(a.params),
+                              jax.tree.leaves(b.params)):
+        np.testing.assert_array_equal(np.asarray(leaf_a),
+                                      np.asarray(leaf_b))
+    for leaf_a, leaf_b in zip(jax.tree.leaves(a.opt_state),
+                              jax.tree.leaves(b.opt_state)):
+        np.testing.assert_array_equal(np.asarray(leaf_a),
+                                      np.asarray(leaf_b))
+    assert int(a.step) == int(b.step)
+
+
+class TestCrashResumeBitwise:
+    """1-epoch fast variants (tier-1); the 2-epoch CLI subprocess drives
+    are in TestCrashResumeSubprocess (slow)."""
+
+    def test_image_trainer_kill_resume_bitwise(self, mesh, tmp_path):
+        from distributed_training_tpu.train.trainer import Trainer
+
+        baseline = Trainer(_img_cfg(tmp_path / "base"), mesh=mesh)
+        assert baseline.fit()["preempted"] is False
+
+        killed = Trainer(
+            _img_cfg(tmp_path / "chaos",
+                     chaos=ChaosConfig(kill_at_step=2)), mesh=mesh)
+        r = killed.fit()
+        assert r["preempted"] is True and r["steps"] == 2
+
+        resumed = Trainer(_img_cfg(tmp_path / "chaos"), mesh=mesh)
+        r2 = resumed.fit()
+        assert r2["preempted"] is False and r2["steps"] == 4
+        _assert_states_bitwise_equal(resumed.state, baseline.state)
+
+    def test_lm_trainer_kill_resume_bitwise(self, mesh, tmp_path):
+        from distributed_training_tpu.train.lm_trainer import LMTrainer
+
+        baseline = LMTrainer(_lm_cfg(tmp_path / "base"), mesh=mesh)
+        assert baseline.fit()["preempted"] is False
+
+        killed = LMTrainer(
+            _lm_cfg(tmp_path / "chaos",
+                    chaos=ChaosConfig(kill_at_step=2)), mesh=mesh)
+        r = killed.fit()
+        assert r["preempted"] is True and r["steps"] == 2
+
+        resumed = LMTrainer(_lm_cfg(tmp_path / "chaos"), mesh=mesh)
+        r2 = resumed.fit()
+        assert r2["preempted"] is False and r2["steps"] == 4
+        _assert_states_bitwise_equal(resumed.state, baseline.state)
+
+    def test_torn_newest_save_auto_resume_falls_back(self, mesh, tmp_path):
+        """The torn-write drill end to end THROUGH the trainer: chaos
+        tears epoch 1's save (via the async writer's post_save hook);
+        auto-resume quarantines it, falls back to epoch 0, and completes
+        — silently costing one epoch, not the run."""
+        from distributed_training_tpu.train.trainer import Trainer
+
+        cfg = _img_cfg(tmp_path / "ckpt", num_epochs=2).replace(
+            checkpoint=CheckpointConfig(
+                directory=str(tmp_path / "ckpt"), interval=1,
+                auto_resume=True),
+            chaos=ChaosConfig(torn_ckpt_epoch=1))
+        tr = Trainer(cfg, mesh=mesh)
+        assert tr.fit()["preempted"] is False
+        assert tr.chaos.counters["torn_ckpts"] == 1
+
+        with pytest.warns(UserWarning, match="quarantined"):
+            resumed = Trainer(cfg.replace(chaos=ChaosConfig()), mesh=mesh)
+            r = resumed.fit()
+        # Fallback resumed from epoch_0 (start_epoch 1): epoch 1 re-ran.
+        assert r["preempted"] is False and r["steps"] == 8
+        assert os.path.isdir(tmp_path / "ckpt" / "epoch_1.corrupt")
+        # The flight dump carries the resilience counters end to end.
+        path = resumed.obs.dump()
+        snap = json.load(open(path))
+        res = snap["resilience"]
+        assert res["saves_committed"] >= 1 and "io_retries" in res
+        from conftest import load_cli_module
+
+        report = load_cli_module("tools/flight_report.py")
+        text = report.render(report.summarize(snap))
+        assert "resilience: saves committed" in text
+
+
+_CLI_ENV = dict(
+    PYTHONPATH=REPO,
+    JAX_PLATFORMS="cpu",
+    XLA_FLAGS="--xla_force_host_platform_device_count=8",
+)
+
+
+def _run_cli(script, args, timeout=600):
+    env = dict(os.environ)
+    env.update(_CLI_ENV)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, *script.split("/"))] + args,
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    return out
+
+
+def _manifest_leaves(ckpt_dir, epoch):
+    """The per-leaf content checksums of a save — comparing two saves'
+    leaf tables IS a bitwise comparison, with no orbax read."""
+    manifest = json.load(
+        open(os.path.join(ckpt_dir, f"epoch_{epoch}", "MANIFEST.json")))
+    return {k: v for k, v in manifest["leaves"].items()
+            if k.startswith(("state/params/", "state/opt_state/"))}
+
+
+@pytest.mark.slow
+class TestCrashResumeSubprocess:
+    """The acceptance drill at full strength: 2-epoch CLI runs in real
+    subprocesses, chaos-killed at step k, auto-resumed, and compared
+    bitwise (params + opt state via the saves' per-leaf checksums)."""
+
+    def test_lm_cli_kill_resume_bitwise(self, tmp_path):
+        args = ["-e", "2", "--steps-per-epoch", "4", "-b", "4",
+                "--seq-len", "16", "--num-layers", "1", "--num-heads", "2",
+                "--hidden-dim", "32", "--max-len", "32",
+                "--log-interval", "2", "-i", "2", "--auto-resume"]
+        base = str(tmp_path / "base")
+        _run_cli("gpt/jax_tpu/train.py", args + ["-c", base])
+        chaos = str(tmp_path / "chaos")
+        out = _run_cli("gpt/jax_tpu/train.py",
+                       args + ["-c", chaos, "--chaos-kill-at-step", "3"])
+        assert "'preempted': True" in out.stdout
+        out = _run_cli("gpt/jax_tpu/train.py", args + ["-c", chaos])
+        assert "'preempted': False" in out.stdout
+        assert _manifest_leaves(chaos, 1) == _manifest_leaves(base, 1)
+
+    def test_image_cli_kill_resume_bitwise(self, tmp_path):
+        # deepspeed plugin: normalize_only augment (RNG-free) — see
+        # _img_cfg for why the bitwise pin avoids pad_crop_flip.
+        args = ["-p", "deepspeed", "--model", "resnet_micro",
+                "--dataset", "synthetic_cifar",
+                "--steps-per-epoch", "4", "-b", "32", "-e", "2", "-i", "2",
+                "--log-interval", "2", "--auto-resume"]
+        base = str(tmp_path / "base")
+        _run_cli("resnet/jax_tpu/train.py", args + ["-c", base])
+        chaos = str(tmp_path / "chaos")
+        out = _run_cli("resnet/jax_tpu/train.py",
+                       args + ["-c", chaos, "--chaos-kill-at-step", "3"])
+        assert "'preempted': True" in out.stdout
+        out = _run_cli("resnet/jax_tpu/train.py", args + ["-c", chaos])
+        assert "'preempted': False" in out.stdout
+        assert _manifest_leaves(chaos, 1) == _manifest_leaves(base, 1)

@@ -23,6 +23,7 @@ module-scoped fixtures shared across the assertion classes.
 """
 
 import json
+import time
 
 import jax
 import numpy as np
@@ -40,7 +41,10 @@ from distributed_training_tpu.models import get_model
 from distributed_training_tpu.serving import (
     FINISH_EOS,
     FINISH_LENGTH,
+    FINISH_TIMEOUT,
+    DrainingError,
     Engine,
+    QueueFullError,
     RequestQueue,
     SlotScheduler,
 )
@@ -248,6 +252,107 @@ class TestAdmissionControl:
             eng.submit(np.zeros((0,), np.int32))
 
 
+class TestGracefulDegradation:
+    """Resilience round (docs/RESILIENCE.md): drain semantics, bounded
+    admission, and per-request deadlines."""
+
+    def test_drain_completes_inflight_and_rejects_new(self, lm, prompts):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=1, max_new_tokens=3, prefill_bucket=8))
+        for p in prompts[:3]:
+            eng.submit(p)
+        done = eng.drain()
+        # Everything accepted before the close completes (3 requests
+        # through 1 slot: queued ones drain too, not just the slot).
+        assert len(done) == 3 and eng.idle and eng.draining
+        with pytest.raises(DrainingError, match="draining"):
+            eng.submit(prompts[0])
+        stats = eng.stats()
+        assert stats["drained"] is True
+        assert stats["requests_drain_rejected"] == 1
+        assert stats["requests_finished"] == 3
+        # drain() is idempotent: nothing new can arrive, second call is [].
+        assert eng.drain() == []
+
+    def test_bounded_queue_sheds_typed(self, lm, prompts):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=1, max_new_tokens=2, max_queue_depth=1,
+            prefill_bucket=8))
+        eng.submit(prompts[0])  # queued (no iteration has run)
+        with pytest.raises(QueueFullError, match="max_depth"):
+            eng.submit(prompts[1])
+        assert eng.stats()["requests_shed"] == 1
+        # The accepted request is unharmed by the shed.
+        assert len(eng.run()) == 1
+
+    def test_queue_deadline_evicts_with_timeout(self, lm, prompts,
+                                                tmp_path):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=1, max_new_tokens=3, prefill_bucket=8,
+            ttft_deadline_ms=50.0))
+        # Arrival backdated past the TTFT deadline: the engine must
+        # evict it from the queue with reason 'timeout' and zero tokens
+        # instead of spending a prefill on a request that already
+        # missed its SLA.
+        eng.submit(prompts[0], arrival_t=time.perf_counter() - 1.0)
+        eng.submit(prompts[1])  # fresh: must be served normally
+        done = eng.run()
+        by_reason = {f.finish_reason: f for f in done}
+        timed_out = by_reason[FINISH_TIMEOUT]
+        assert timed_out.tokens.size == 0
+        assert timed_out.ttft_ms is None and timed_out.first_token_t is None
+        assert by_reason[FINISH_LENGTH].tokens.size == 3
+        stats = eng.stats()
+        assert stats["requests_timed_out"] == 1
+        assert stats["requests_finished"] == 2
+        # Timeout telemetry reaches the flight dump as strict JSON.
+        path = str(tmp_path / "timeout_flight.json")
+        snap = eng.dump_flight(path)
+        assert snap["serving"]["requests_timed_out"] == 1
+        json.load(open(path))
+
+    def test_slot_deadline_eviction_unit(self):
+        """Total-deadline slot eviction, host-side (deterministic): a
+        decoding sequence past deadline_t leaves with reason 'timeout'
+        and its partial tokens; EOS/length on the same token win."""
+        from distributed_training_tpu.serving.request import (
+            ActiveSequence,
+            Request,
+        )
+
+        def seq(deadline_t, tokens, max_new=8):
+            req = Request(uid=0, prompt=np.array([1], np.int32),
+                          max_new_tokens=max_new, arrival_t=0.0,
+                          deadline_t=deadline_t)
+            s = ActiveSequence(request=req, slot=0)
+            for i, t in enumerate(tokens):
+                s.note_token(t, t=float(i))
+            return s
+
+        assert seq(5.0, [3, 4]).finish_reason(None, now=4.0) is None
+        assert seq(5.0, [3, 4]).finish_reason(None, now=5.0) \
+            == FINISH_TIMEOUT
+        # Natural completion on the deadline token is NOT a timeout.
+        assert seq(5.0, [3, 7]).finish_reason(7, now=6.0) == FINISH_EOS
+        assert seq(5.0, [3, 4], max_new=2).finish_reason(None, now=6.0) \
+            == FINISH_LENGTH
+        # The scheduler frees the slot and returns the partial tokens.
+        sched = SlotScheduler(1)
+        q = RequestQueue(budget=32, default_max_new_tokens=4,
+                         deadline_ms=1.0)
+        q.submit(np.array([1, 2], np.int32),
+                 arrival_t=time.perf_counter() - 1.0)
+        seated = sched.admit(q)
+        seated[0].note_token(9, t=time.perf_counter())
+        done = sched.evict_finished(None, now=time.perf_counter())
+        assert [f.finish_reason for f in done] == [FINISH_TIMEOUT]
+        assert done[0].tokens.tolist() == [9]
+        assert sched.num_active == 0
+
+
 class TestTelemetry:
     def test_stats_fields_flight_dump_and_report(self, batched_greedy,
                                                  tmp_path):
@@ -300,6 +405,64 @@ class TestServeBenchCli:
             assert key in stats, key
         assert stats["throughput_tok_s"] > 0
         assert stats["requests_finished"] == 6
+
+
+@pytest.mark.slow
+class TestServeCliSigterm:
+    def test_sigterm_drains_and_emits_valid_dump(self, tmp_path):
+        """Acceptance: serve.py under SIGTERM completes every in-flight
+        request, rejects late ones with the typed DrainingError, and
+        still emits the SLA JSON line plus a loadable flight dump."""
+        import os
+        import signal as signal_mod
+        import subprocess
+        import sys
+        import time as time_mod
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        pfile = tmp_path / "prompts.txt"
+        pfile.write_text("".join(f"prompt {i}\n" for i in range(4)))
+        dump = tmp_path / "drain_flight.json"
+        stderr_path = tmp_path / "serve.stderr"
+        env = dict(os.environ)
+        env.update(PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+        with open(stderr_path, "w") as errfh:
+            proc = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(repo, "gpt", "jax_tpu", "serve.py"),
+                 "-c", str(tmp_path / "nockpt"),
+                 "--prompts-file", str(pfile),
+                 "--num-layers", "1", "--num-heads", "2",
+                 "--hidden-dim", "32", "--model-max-len", "128",
+                 "--max-new-tokens", "64", "--max-batch", "2",
+                 "--prefill-bucket", "16", "--json",
+                 "--flight-dump", str(dump)],
+                stdout=subprocess.PIPE, stderr=errfh, text=True, env=env)
+            # SIGTERM only once the guard is installed ("engine ready"):
+            # earlier, the default disposition would just kill the
+            # process and test nothing.
+            deadline = time_mod.time() + 240
+            while time_mod.time() < deadline:
+                if "engine ready" in open(stderr_path).read():
+                    break
+                time_mod.sleep(0.2)
+                assert proc.poll() is None, open(stderr_path).read()[-2000:]
+            else:
+                proc.kill()
+                raise AssertionError("serve.py never reported ready")
+            time_mod.sleep(0.3)
+            proc.send_signal(signal_mod.SIGTERM)
+            out, _ = proc.communicate(timeout=240)
+        assert proc.returncode == 0, open(stderr_path).read()[-2000:]
+        stats = json.loads(
+            [ln for ln in out.splitlines() if ln.strip()][-1])
+        assert stats["drained"] is True
+        # Every prompt either completed before the drain or was rejected
+        # with the typed error after it — none vanished.
+        assert stats["requests_finished"] \
+            + stats["requests_drain_rejected"] == 4
+        snap = json.load(open(dump))  # strict JSON, serving section intact
+        assert snap["serving"]["drained"] is True
 
 
 class TestServeCli:

@@ -63,6 +63,10 @@ def summarize(snap: dict) -> dict:
     # per-iteration decode latency.
     if snap.get("serving"):
         out["serving"] = snap["serving"]
+    # Resilience counters (trainers: saves committed/failed, I/O
+    # retries, chaos faults — resilience/; docs/RESILIENCE.md).
+    if snap.get("resilience"):
+        out["resilience"] = snap["resilience"]
     return out
 
 
@@ -106,12 +110,30 @@ def render(summary: dict) -> str:
     if srv:
         add(f"  serving: {srv['requests_finished']} requests  "
             f"{srv['tokens_emitted']} tokens  "
-            f"{srv['throughput_tok_s']:.1f} tok/s")
+            f"{srv['throughput_tok_s']:.1f} tok/s"
+            + ("  [drained]" if srv.get("drained") else ""))
         add(f"    ttft p50 {srv['ttft_p50_ms']:.1f} ms  "
             f"p95 {srv['ttft_p95_ms']:.1f} ms  |  "
             f"tpot p50 {srv['tpot_p50_ms']:.2f} ms  "
             f"p95 {srv['tpot_p95_ms']:.2f} ms  |  "
             f"queue depth max {srv['queue_depth_max']}")
+        degraded = {k: srv.get(k, 0) for k in (
+            "requests_timed_out", "requests_shed",
+            "requests_drain_rejected")}
+        if any(degraded.values()):
+            add(f"    degradation: timed out {degraded['requests_timed_out']}"
+                f"  shed {degraded['requests_shed']}"
+                f"  drain-rejected {degraded['requests_drain_rejected']}")
+    res = summary.get("resilience")
+    if res:
+        add(f"  resilience: saves committed {res.get('saves_committed', 0)}"
+            f" / failed {res.get('saves_failed', 0)}  "
+            f"io retries {res.get('io_retries', 0)}")
+        faults = res.get("chaos_faults")
+        if faults:
+            body = "  ".join(f"{k} {v}" for k, v in sorted(faults.items())
+                             if v)
+            add(f"    chaos faults: {body or 'none fired'}")
     if summary["anomalies"]:
         add("  ANOMALIES:")
         for a in summary["anomalies"]:

@@ -126,7 +126,7 @@ def main() -> int:
     t0 = time.perf_counter()
     submitted = 0
     finished = 0
-    while finished < n:
+    while submitted < n:
         now = time.perf_counter() - t0
         while submitted < n and arrivals[submitted] <= now:
             engine.submit(load[submitted],
@@ -138,6 +138,11 @@ def main() -> int:
             time.sleep(min(arrivals[submitted] - now, 0.05))
             continue
         finished += len(engine.step())
+    # End through a graceful drain: admission closes and every accepted
+    # request completes and is COUNTED before the SLA line is emitted —
+    # a hard stop here used to drop tail requests from the percentiles.
+    finished += len(engine.drain())
+    assert finished == n, f"drained {finished} of {n} requests"
 
     stats = engine.stats()
     stats["requests"] = n
