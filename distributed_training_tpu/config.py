@@ -224,6 +224,34 @@ class DataConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    """Span-level event tracing (``observability/trace.py``).
+
+    Off by default: every integration point holds ``trace=None`` when
+    disabled, so no span body executes and the hot loop is byte-identical
+    to the untraced code (the transfer-guard test pins that). Enabled, a
+    run exports a Chrome/Perfetto ``trace_event`` JSON timeline with one
+    track per component (train phases, the async checkpoint writer, chaos
+    injections, one track per serving decode slot);
+    ``tools/trace_report.py`` summarizes it headlessly.
+    """
+
+    enabled: bool = False
+    # Where the trace JSON lands. None — the default — resolves next to
+    # the flight forensics (``<dump_dir>/trace``) in the trainers; the
+    # serving CLIs default it to ``./trace``.
+    dir: str | None = None
+    # Event-buffer bound: past it, events are dropped and counted in the
+    # exported metadata (a forensic trace must never OOM its host).
+    max_events: int = 500_000
+
+    def __post_init__(self):
+        if self.max_events < 1:
+            raise ValueError(
+                f"max_events must be >= 1, got {self.max_events}")
+
+
+@dataclasses.dataclass(frozen=True)
 class ObservabilityConfig:
     """Flight instruments (``observability/``): MFU accounting, the
     flight recorder, device-memory telemetry, anomaly-triggered forensics.
@@ -272,6 +300,19 @@ class ObservabilityConfig:
     anomaly_action: str = "raise"  # raise | skip
     anomaly_trace_steps: int = 3
     grad_norm_spike_factor: float = 10.0
+    # Cross-host step-time skew + straggler attribution at meter-flush
+    # boundaries (observability/aggregate.py): per-host payloads are
+    # all-gathered (replicated — no stranded barrier; every host flushes
+    # at the same deterministic step) and the worst (host, step) cell is
+    # named in flight dumps. Single-process runs fall back to a
+    # within-host baseline (which step stalled). Requires the flight
+    # recorder.
+    straggler_attribution: bool = True
+    # Recent steps each host contributes to the skew window (fixed shape
+    # is what makes the payload all-gatherable).
+    straggler_window: int = 256
+    # Span-level Perfetto tracing (off by default; see TraceConfig).
+    trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
 
     def __post_init__(self):
         if self.anomaly_action not in ("raise", "skip"):
@@ -282,6 +323,10 @@ class ObservabilityConfig:
             raise ValueError(
                 f"anomaly_trace_steps must be >= 0, got "
                 f"{self.anomaly_trace_steps}")
+        if self.straggler_window < 2:
+            raise ValueError(
+                f"straggler_window must be >= 2, got "
+                f"{self.straggler_window}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -318,6 +363,10 @@ class ChaosConfig:
     # step (straggler simulation; shows up as flight-recorder p95).
     slow_step_every: int | None = None
     slow_step_ms: float = 50.0
+    # Restrict the slow-step injection to ONE host (process index) of a
+    # multihost run — the straggler-attribution drill needs exactly one
+    # slow host to pin (observability/aggregate.py). None = every host.
+    slow_step_host: int | None = None
 
     @property
     def active(self) -> bool:
@@ -338,6 +387,9 @@ class ChaosConfig:
         if self.slow_step_every is not None and self.slow_step_every < 1:
             raise ValueError(
                 f"slow_step_every must be >= 1, got {self.slow_step_every}")
+        if self.slow_step_host is not None and self.slow_step_host < 0:
+            raise ValueError(
+                f"slow_step_host must be >= 0, got {self.slow_step_host}")
         if self.torn_truncate_bytes < 0:
             raise ValueError(
                 f"torn_truncate_bytes must be >= 0, got "

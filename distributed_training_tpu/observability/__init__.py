@@ -23,6 +23,16 @@ hardware-utilization and forensics layer a production trainer needs:
   trainers (and bench) drive; it owns the no-new-syncs contract: every
   input it reads is either a host timestamp or a value the meter already
   fetched.
+- :mod:`trace` — span-level event tracing exported as Chrome/Perfetto
+  ``trace_event`` JSON: train phases, the async checkpoint writer's own
+  track, chaos injections, and per-slot serving request lifecycles on
+  one timeline (``tools/trace_report.py`` summarizes it).
+- :mod:`aggregate` — cross-host flight aggregation at flush boundaries:
+  per-host step-time skew and straggler attribution (the worst
+  (host, step) cell named in flight dumps).
+- :mod:`histogram` — fixed-bucket SLO histograms (TTFT/TPOT/step time),
+  mergeable and Prometheus-exportable via
+  ``tools/flight_report.py --prometheus``.
 
 The serving engine (``serving/metrics.py``) rides the same flight
 recorder for its SLA telemetry: decode iterations are recorded as steps
@@ -35,9 +45,19 @@ from distributed_training_tpu.observability.anomaly import (  # noqa: F401
     AnomalyDetector,
     AnomalyError,
 )
+from distributed_training_tpu.observability.aggregate import (  # noqa: F401
+    summarize_hosts,
+)
 from distributed_training_tpu.observability.flight_recorder import (  # noqa: F401
     FlightRecorder,
     percentile,
+)
+from distributed_training_tpu.observability.histogram import (  # noqa: F401
+    FixedHistogram,
+)
+from distributed_training_tpu.observability.trace import (  # noqa: F401
+    TraceSession,
+    load_trace,
 )
 from distributed_training_tpu.observability.flops import (  # noqa: F401
     device_peak_flops,

@@ -27,6 +27,8 @@ import os
 import time
 from typing import Any
 
+from distributed_training_tpu.observability.histogram import FixedHistogram
+
 FORMAT_VERSION = 1
 
 
@@ -60,16 +62,27 @@ class FlightRecorder:
         self._fhead = 0
         self._fcount = 0
         self._last_step: int | None = None
+        self._last_t: float | None = None
         self._gaps: set[int] = set()  # steps whose NEXT delta is not a step
         self.anomalies: list[dict[str, Any]] = []
+        # Fixed-bucket SLO histogram over the SAME gap-excluded deltas the
+        # percentiles use — but unbounded by the ring: every step of the
+        # run is counted, so a long run's tail is not forgotten when the
+        # ring wraps (observability/histogram.py).
+        self.step_hist = FixedHistogram()
 
     # -- recording (hot path: one list write, no device touch) --------------
     def record_step(self, step: int, t: float | None = None) -> None:
-        self._steps[self._head] = (int(step), time.perf_counter()
-                                   if t is None else float(t))
+        step = int(step)
+        t = time.perf_counter() if t is None else float(t)
+        if (self._last_t is not None and step == self._last_step + 1
+                and self._last_step not in self._gaps):
+            self.step_hist.observe((t - self._last_t) * 1e3)
+        self._steps[self._head] = (step, t)
         self._head = (self._head + 1) % self.ring_size
         self._count += 1
-        self._last_step = int(step)
+        self._last_step = step
+        self._last_t = t
 
     def mark_gap(self) -> None:
         """Declare that non-step work (epoch boundary: eval, checkpoint,
@@ -120,6 +133,17 @@ class FlightRecorder:
         return min(self._count, self.ring_size)
 
     # -- derived stats -------------------------------------------------------
+    def step_deltas_ms(self) -> list[tuple[int, float]]:
+        """``(step, delta_ms)`` per consecutive recorded step pair, the
+        delta attributed to the LATER step — the step-identity-aligned
+        series the cross-host aggregator intersects on
+        (``observability/aggregate.py``). Gap-following and non-adjacent
+        pairs are excluded exactly as in :meth:`step_times_ms`."""
+        s = self.steps
+        return [(n1, (t1 - t0) * 1e3)
+                for (n0, t0), (n1, t1) in zip(s, s[1:])
+                if n1 == n0 + 1 and n0 not in self._gaps]
+
     def step_times_ms(self) -> list[float]:
         """Wall-time deltas between CONSECUTIVE recorded steps, in ms.
 
@@ -129,10 +153,7 @@ class FlightRecorder:
         non-adjacent step numbers and marked gaps are dropped so the
         percentiles describe steady-state steps only.
         """
-        s = self.steps
-        return [(t1 - t0) * 1e3
-                for (n0, t0), (n1, t1) in zip(s, s[1:])
-                if n1 == n0 + 1 and n0 not in self._gaps]
+        return [dt for _, dt in self.step_deltas_ms()]
 
     def step_time_stats(self) -> dict[str, float]:
         """``{p50, p95, max}`` step-time ms over the ring; {} when fewer
@@ -180,6 +201,10 @@ class FlightRecorder:
             "anomalies": self.anomalies,
             "step_time_stats": self.step_time_stats(),
         }
+        if self.step_hist.total:
+            # Run-lifetime fixed-bucket step-time histogram (SLO view,
+            # Prometheus-exportable via tools/flight_report.py).
+            snap["histograms"] = {"step_time_ms": self.step_hist.to_dict()}
         if phase_totals:
             snap["wall_clock"] = self.goodput(phase_totals)
         if extra:

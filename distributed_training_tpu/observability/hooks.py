@@ -42,6 +42,7 @@ from distributed_training_tpu.observability.flops import (
 from distributed_training_tpu.observability.memory import (
     device_memory_metrics,
 )
+from distributed_training_tpu.observability import aggregate as aggregate_lib
 
 
 class TrainObservability:
@@ -51,7 +52,9 @@ class TrainObservability:
                  n_devices: int = 1, clock=None, is_master: bool = True,
                  printer: Callable[[str], None] = print,
                  dump_dir: str | None = None,
-                 extra_provider: Callable[[], dict] | None = None):
+                 extra_provider: Callable[[], dict] | None = None,
+                 trace=None, trace_path: str | None = None,
+                 num_processes: int = 1):
         """``cfg`` is a :class:`~distributed_training_tpu.config.
         ObservabilityConfig`; ``step_flops`` the analytic model FLOPs of
         one optimizer step (None → no MFU line); ``clock`` the trainer's
@@ -60,13 +63,24 @@ class TrainObservability:
         ``<checkpoint dir>/flight``); ``extra_provider`` supplies extra
         top-level dump sections at dump time (the trainers pass their
         resilience counters — saves committed/failed, I/O retries — so
-        forensics carry them)."""
+        forensics carry them). ``trace``/``trace_path`` hand over the
+        run's TraceSession: :meth:`close` (and the crash path) write it
+        to ``trace_path``. ``num_processes`` drives the cross-host
+        straggler aggregation at flush boundaries — the all-gather is
+        collective, so EVERY process must construct its observability
+        with the same value and flush at the same steps (the meter's
+        deterministic interval guarantees that)."""
         self.cfg = cfg
         self.extra_provider = extra_provider
         self.dump_dir = dump_dir or cfg.dump_dir or "./flight"
         self.is_master = is_master
         self.printer = printer
         self.clock = clock
+        self.trace = trace
+        self.trace_path = trace_path
+        self.num_processes = int(num_processes)
+        self._host_summary: dict | None = None
+        self._trace_saved = False
         self.n_devices = n_devices
         self.step_flops = step_flops if cfg.mfu else None
         self.peak_flops = (cfg.peak_flops if cfg.peak_flops
@@ -137,6 +151,17 @@ class TrainObservability:
             extras.update(device_memory_metrics())
         if self.recorder is not None:
             self.recorder.record_flush(step, {**flushed, **extras})
+            if self.cfg.straggler_attribution:
+                # Cross-host skew exchange. The flush boundary is the one
+                # point where every host is provably at the same step
+                # (the meter's interval is deterministic), so the
+                # all-gather cannot strand; the replicated summary is
+                # CACHED here and only read at dump time — dumps stay
+                # collective-free (master-only dumps can't deadlock).
+                self._host_summary = aggregate_lib.aggregate(
+                    self.recorder, self.clock,
+                    num_processes=self.num_processes,
+                    window=self.cfg.straggler_window)
         if self.detector is not None and not self._fired:
             reasons = self.detector.check(flushed)
             if reasons:
@@ -245,9 +270,28 @@ class TrainObservability:
             except Exception as e:  # forensics must not mask the dump
                 self.printer(f"[observability] extra dump section "
                              f"failed: {e}")
+        if self._host_summary is not None:
+            # Latest flush-boundary skew/straggler view (cached — no
+            # collective here; see on_flush).
+            extra = {**(extra or {}), "hosts": self._host_summary}
         self.recorder.dump(path, reason=reason, phase_totals=totals,
                            extra=extra)
         return path
+
+    def save_trace(self) -> str | None:
+        """Write the run's Perfetto trace to ``trace_path`` (idempotent;
+        returns the path, or None when tracing is off)."""
+        if self.trace is None or self.trace_path is None:
+            return None
+        if not self._trace_saved:
+            self.trace.save(self.trace_path)
+            # Latched only AFTER a successful write: a failed crash-path
+            # save (disk full, unwritable dir) must leave the close-path
+            # retry armed, not permanently suppressed.
+            self._trace_saved = True
+            self.printer(f"[observability] trace: {self.trace_path} "
+                         f"({len(self.trace)} events)")
+        return self.trace_path
 
     def on_crash(self) -> None:
         """Crash-path dump; swallows its own errors (the original
@@ -262,12 +306,21 @@ class TrainObservability:
             self.printer(f"[observability] crash flight record: {path}")
         except Exception as e:
             self.printer(f"[observability] crash dump failed: {e}")
+        try:
+            self.save_trace()  # the timeline UP TO the crash
+        except Exception as e:
+            self.printer(f"[observability] crash trace save failed: {e}")
 
     def close(self, raise_pending: bool = True) -> None:
-        """Idempotent teardown: stop a dangling anomaly trace; surface a
-        deferred raise whose trace window the run's end cut short."""
+        """Idempotent teardown: stop a dangling anomaly trace; write the
+        span trace; surface a deferred raise whose trace window the
+        run's end cut short."""
         self._trace_left = 0
         self._stop_trace()
+        try:
+            self.save_trace()
+        except Exception as e:  # teardown must not mask the run's outcome
+            self.printer(f"[observability] trace save failed: {e}")
         if raise_pending and self._pending_raise is not None:
             err, self._pending_raise = self._pending_raise, None
             raise err

@@ -1,0 +1,261 @@
+"""Span-level event tracing, exported as Chrome/Perfetto trace JSON.
+
+The flight recorder answers "how fast on this host, on average" —
+percentiles over a ring of per-step timestamps. It cannot answer "*what*
+was the trainer doing at 14:03:07.2, and what was the checkpoint writer
+doing at the same instant" — the timeline question every production
+straggler/overlap diagnosis starts from (MegaScale runs on exactly this
+kind of cross-component trace). This module is that timeline:
+
+- :class:`TraceSession` buffers events in host memory (a bounded list of
+  small dicts; no device interaction anywhere) and exports the standard
+  Chrome ``trace_event`` JSON object format, which Perfetto / chrome://
+  tracing open directly.
+- **Tracks** are (pid, tid) lanes: pid is the host (process index), tid a
+  named lane within it ("train", "ckpt-writer", "slot 3", ...). Track
+  names are emitted as ``M``-phase metadata so the viewer labels them.
+- **Spans** are complete events (``ph: "X"`` with ``ts``+``dur``) — one
+  event per span instead of a B/E pair, so a crash mid-span loses only
+  that span, never unbalances the file.
+- **Instant events** (``ph: "i"``) mark point faults (chaos injections,
+  request arrivals, finish reasons); **counter samples** (``ph: "C"``)
+  plot series like queue depth.
+
+Overhead contract: tracing is OFF by default and every integration point
+holds ``trace: TraceSession | None`` — when None, no span body runs and
+the hot loop is byte-identical to the pre-trace code (the transfer-guard
+test keeps pinning that). When ON, a span costs two ``perf_counter``
+reads and one lock-guarded list append.
+
+Clock: all timestamps are ``time.perf_counter()`` seconds, the SAME
+clock the flight recorder and serving telemetry use — so a latency
+derived from trace attrs equals the telemetry's number exactly (pinned
+by tests/test_trace.py). Exported ``ts`` are microseconds relative to
+the session epoch (Chrome's unit).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any
+
+# One JSON object per file (not the bare-array variant): carries the
+# displayTimeUnit + metadata alongside the events.
+TRACE_FORMAT = "chrome-trace-events"
+
+
+class TraceSession:
+    """In-memory span/event buffer for one process, one file per dump.
+
+    >>> tr = TraceSession(pid=0, process_name="host0 train")
+    >>> with tr.span("step", track="train", step=12):
+    ...     ...
+    >>> tr.instant("chaos.slow_step", track="train", step=12)
+    >>> tr.counter("queue_depth", 3, track="engine")
+    >>> tr.save("trace.json")
+
+    Thread-safe: the checkpoint writer thread and data-loader threads
+    append concurrently with the step loop (one lock around the buffer).
+    The buffer is bounded by ``max_events``: once full, new events are
+    dropped and counted (``dropped_events`` in the exported metadata) —
+    a forensic trace must never OOM the host it is diagnosing.
+    """
+
+    def __init__(self, *, pid: int = 0, process_name: str | None = None,
+                 max_events: int = 500_000):
+        if max_events < 1:
+            raise ValueError(f"max_events must be >= 1, got {max_events}")
+        self.pid = int(pid)
+        self.process_name = process_name or f"process {pid}"
+        self.max_events = int(max_events)
+        self._t0 = time.perf_counter()
+        self._wall_t0 = time.time()
+        self._lock = threading.Lock()
+        self._events: list[dict[str, Any]] = []
+        self._tracks: dict[str, int] = {}
+        self._dropped = 0
+
+    # -- clock ---------------------------------------------------------------
+    def now(self) -> float:
+        """The session's clock (``perf_counter`` seconds) — integration
+        points that already hold a timestamp from the same clock pass it
+        straight through instead of re-reading."""
+        return time.perf_counter()
+
+    def _ts(self, t: float) -> float:
+        """perf_counter seconds → Chrome µs (relative to session epoch)."""
+        return (t - self._t0) * 1e6
+
+    # -- tracks --------------------------------------------------------------
+    def track(self, name: str) -> int:
+        """The tid for ``name`` (registered on first use)."""
+        with self._lock:
+            tid = self._tracks.get(name)
+            if tid is None:
+                tid = len(self._tracks)
+                self._tracks[name] = tid
+            return tid
+
+    # -- emission ------------------------------------------------------------
+    def _append(self, ev: dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    def complete(self, name: str, t_start: float, t_end: float, *,
+                 track: str = "main", **attrs: Any) -> None:
+        """One complete span from explicit ``perf_counter`` endpoints —
+        for retroactive spans whose start predates the emission point
+        (e.g. a request's queueing span, emitted when it seats)."""
+        ev: dict[str, Any] = {
+            "name": name, "ph": "X", "ts": self._ts(t_start),
+            "dur": max((t_end - t_start) * 1e6, 0.0),
+            "pid": self.pid, "tid": self.track(track),
+        }
+        if attrs:
+            ev["args"] = attrs
+        self._append(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, track: str = "main", **attrs: Any):
+        """Context manager: one complete span around the body."""
+        t_start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.complete(name, t_start, time.perf_counter(),
+                          track=track, **attrs)
+
+    def instant(self, name: str, *, track: str = "main",
+                t: float | None = None, **attrs: Any) -> None:
+        ev: dict[str, Any] = {
+            "name": name, "ph": "i",
+            "ts": self._ts(time.perf_counter() if t is None else t),
+            "pid": self.pid, "tid": self.track(track), "s": "t",
+        }
+        if attrs:
+            ev["args"] = attrs
+        self._append(ev)
+
+    def counter(self, name: str, value: float, *, track: str = "counters",
+                t: float | None = None) -> None:
+        self._append({
+            "name": name, "ph": "C",
+            "ts": self._ts(time.perf_counter() if t is None else t),
+            "pid": self.pid, "tid": self.track(track),
+            "args": {name: float(value)},
+        })
+
+    # -- export --------------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def to_json(self) -> dict[str, Any]:
+        """The Chrome trace object. Events are sorted by ``ts`` so every
+        (pid, tid) subsequence is timestamp-monotonic — a validity
+        property tests (and some viewers) rely on."""
+        with self._lock:
+            events = sorted(self._events, key=lambda e: e["ts"])
+            tracks = dict(self._tracks)
+            dropped = self._dropped
+        meta: list[dict[str, Any]] = [{
+            "name": "process_name", "ph": "M", "pid": self.pid, "tid": 0,
+            "ts": 0.0, "args": {"name": self.process_name},
+        }]
+        for name, tid in sorted(tracks.items(), key=lambda kv: kv[1]):
+            meta.append({
+                "name": "thread_name", "ph": "M", "pid": self.pid,
+                "tid": tid, "ts": 0.0, "args": {"name": name},
+            })
+        return {
+            "traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "format": TRACE_FORMAT,
+                "wall_time_origin": self._wall_t0,
+                "dropped_events": dropped,
+            },
+        }
+
+    def save(self, path: str) -> str:
+        """Write the trace to ``path`` (dirs created, atomic replace so a
+        crash mid-write never leaves a torn file); returns ``path``."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(self.to_json(), fh, allow_nan=False)
+        os.replace(tmp, path)
+        return path
+
+
+def session_for_run(cfg, *, default_dir: str, component: str = "train"
+                    ) -> tuple["TraceSession | None", str | None]:
+    """``(session, output_path)`` from a :class:`~distributed_training_
+    tpu.config.TraceConfig` — ``(None, None)`` when disabled, which is
+    what keeps every integration point span-free by default.
+
+    The pid is the jax process index (one trace file per host; a
+    multihost run names them ``trace_p<idx>.json`` so hosts never race
+    on one file); ``cfg.dir=None`` resolves under ``default_dir`` (the
+    trainers pass their flight-forensics dir).
+    """
+    if not cfg.enabled:
+        return None, None
+    import jax
+
+    pidx = jax.process_index()
+    session = TraceSession(pid=pidx,
+                           process_name=f"host {pidx} {component}",
+                           max_events=cfg.max_events)
+    d = cfg.dir or os.path.join(default_dir, "trace")
+    fname = ("trace.json" if jax.process_count() == 1
+             else f"trace_p{pidx}.json")
+    return session, os.path.join(d, fname)
+
+
+def session_for_cli(enabled: bool, trace_dir: str, component: str
+                    ) -> tuple["TraceSession | None", str | None]:
+    """``(session, output_path)`` for the serving CLIs' ``--trace`` /
+    ``--trace-dir`` flags — the flag-shaped twin of
+    :func:`session_for_run` (which takes the trainers' TraceConfig).
+    Routes through :class:`~distributed_training_tpu.config.TraceConfig`
+    so its validation and ``max_events`` default apply to serving traces
+    too; the file is named ``<component>_trace.json``.
+    """
+    if not enabled:
+        return None, None
+    from distributed_training_tpu.config import TraceConfig
+
+    cfg = TraceConfig(enabled=True, dir=trace_dir)
+    session = TraceSession(process_name=component,
+                           max_events=cfg.max_events)
+    return session, os.path.join(cfg.dir, f"{component}_trace.json")
+
+
+def load_trace(path: str) -> dict[str, Any]:
+    """Load + structurally validate a trace file written by
+    :meth:`TraceSession.save` (or any Chrome trace object). Raises
+    ``ValueError`` naming the first malformed event (path-free — the
+    report tool prefixes the path in its one-line error)."""
+    with open(path) as fh:
+        obj = json.load(fh)
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        raise ValueError("not a Chrome trace object "
+                         "(missing 'traceEvents')")
+    events = obj["traceEvents"]
+    if not isinstance(events, list):
+        raise ValueError("'traceEvents' is not a list")
+    for i, ev in enumerate(events):
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            if key not in ev:
+                raise ValueError(
+                    f"event {i} missing required key {key!r}: {ev}")
+    return obj

@@ -54,14 +54,20 @@ class AsyncCheckpointWriter:
     _STOP = object()
 
     def __init__(self, *, post_save: Callable[[str, int], None] | None = None,
-                 printer: Callable[[str], None] = print):
+                 printer: Callable[[str], None] = print, trace=None):
         """``post_save(path, epoch)`` runs in the writer thread after each
         completed save — the chaos harness's torn-write hook plugs in
-        here so injected tears land exactly where a real crash would."""
+        here so injected tears land exactly where a real crash would.
+        ``trace`` (a :class:`~distributed_training_tpu.observability.
+        trace.TraceSession`, or None) gives the writer thread its OWN
+        'ckpt-writer' track, so the persist's overlap with the training
+        steps is visible on the timeline — the whole point of the
+        CheckFreq split."""
         self._q: queue_lib.Queue = queue_lib.Queue(maxsize=1)
         self._thread: threading.Thread | None = None
         self._post_save = post_save
         self._printer = printer
+        self._trace = trace
         self._lock = threading.Lock()
         self.last_error: BaseException | None = None
         self.counters = {"saves_committed": 0, "saves_failed": 0}
@@ -84,15 +90,27 @@ class AsyncCheckpointWriter:
                 kind = task[0]
                 if kind == "save":
                     _, directory, epoch, snapshot, kwargs = task
-                    path = ckpt_lib.save_checkpoint(
-                        directory, epoch, snapshot, **kwargs)
+                    if self._trace is not None:
+                        with self._trace.span("ckpt.persist",
+                                              track="ckpt-writer",
+                                              epoch=int(epoch)):
+                            path = ckpt_lib.save_checkpoint(
+                                directory, epoch, snapshot, **kwargs)
+                    else:
+                        path = ckpt_lib.save_checkpoint(
+                            directory, epoch, snapshot, **kwargs)
                     with self._lock:
                         self.counters["saves_committed"] += 1
                     if self._post_save is not None:
                         self._post_save(path, epoch)
                 else:  # prune
                     _, directory, keep = task
-                    ckpt_lib.prune_checkpoints(directory, keep)
+                    if self._trace is not None:
+                        with self._trace.span("ckpt.prune",
+                                              track="ckpt-writer"):
+                            ckpt_lib.prune_checkpoints(directory, keep)
+                    else:
+                        ckpt_lib.prune_checkpoints(directory, keep)
             except BaseException as e:  # noqa: BLE001 - recorded, surfaced
                 with self._lock:
                     if task is not self._STOP and task[0] == "save":

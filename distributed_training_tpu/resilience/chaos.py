@@ -76,29 +76,43 @@ class ChaosMonkey:
     Constructed from a :class:`~distributed_training_tpu.config.
     ChaosConfig`; hooks are no-ops for faults the config leaves unset.
     ``counters`` records every injected fault for the flight recorder's
-    resilience section.
+    resilience section. ``process_index`` scopes host-addressed faults
+    (``slow_step_host``) in multihost runs; ``trace`` (a TraceSession or
+    None) marks every injection as an instant event, so the timeline
+    shows exactly where a fault landed.
     """
 
-    def __init__(self, cfg):
+    def __init__(self, cfg, *, process_index: int = 0, trace=None):
         self.cfg = cfg
+        self.process_index = int(process_index)
+        self.trace = trace
         self._killed = False
         self._torn = False
         self._io_failed: set[str] = set()
         self.counters = {"kills": 0, "torn_ckpts": 0,
                          "io_faults": 0, "slow_steps": 0}
 
+    def _mark(self, name: str, **attrs) -> None:
+        if self.trace is not None:
+            self.trace.instant(name, track="chaos", **attrs)
+
     # -- step loop -----------------------------------------------------------
     def on_step(self, step: int) -> None:
         """Called after every optimizer step with the global step index."""
         c = self.cfg
         if (c.slow_step_every and c.slow_step_ms > 0
-                and step % c.slow_step_every == 0):
+                and step % c.slow_step_every == 0
+                and (c.slow_step_host is None
+                     or c.slow_step_host == self.process_index)):
             self.counters["slow_steps"] += 1
+            self._mark("chaos.slow_step", step=int(step),
+                       ms=float(c.slow_step_ms))
             time.sleep(c.slow_step_ms / 1e3)
         if c.kill_at_step is not None and step >= c.kill_at_step \
                 and not self._killed:
             self._killed = True
             self.counters["kills"] += 1
+            self._mark("chaos.kill", step=int(step), sig=c.kill_signal)
             if c.kill_signal == "kill":
                 # Hard eviction: no grace window, no save. The resume
                 # must fall back to the last committed interval save.
@@ -117,6 +131,7 @@ class ChaosMonkey:
                 and not self._torn:
             self._torn = True
             self.counters["torn_ckpts"] += 1
+            self._mark("chaos.torn_ckpt", epoch=int(epoch))
             tear_checkpoint(path, c.torn_truncate_bytes)
 
     # -- data I/O ------------------------------------------------------------
@@ -134,6 +149,7 @@ class ChaosMonkey:
                 < int(c.data_error_rate * 1_000_000):
             self._io_failed.add(full)
             self.counters["io_faults"] += 1
+            self._mark("chaos.io_fault", key=key)  # loader threads: safe
             raise ChaosIOError(
                 f"chaos-injected transient I/O error ({kind}: {key})")
 

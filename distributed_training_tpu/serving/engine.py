@@ -72,11 +72,23 @@ class Engine:
 
     Thread model: ``submit`` is safe from any thread (the queue locks);
     ``step``/``run`` belong to one serving thread.
+
+    ``trace`` (an :class:`~distributed_training_tpu.observability.trace.
+    TraceSession`, or None = off) draws the engine on a Perfetto
+    timeline: per-iteration prefill/decode spans on an 'engine' track, a
+    queue-depth counter series, admission marks on a 'queue' track, and
+    — the Orca view — one track PER DECODE SLOT carrying each request's
+    queued → prefill → decode lifecycle spans and finish marks. All
+    timestamps come from the same ``perf_counter`` clock as
+    :class:`ServeTelemetry`, so span-derived latencies equal the SLA
+    numbers exactly (pinned by tests/test_trace.py).
     """
 
-    def __init__(self, model: Any, params: Any, cfg: ServeConfig):
+    def __init__(self, model: Any, params: Any, cfg: ServeConfig, *,
+                 trace=None):
         check_unsharded(model)
         self.cfg = cfg
+        self.trace = trace
         self.budget = cache_budget(model, cfg.max_len)
         if self.budget < 2:
             raise ValueError(
@@ -94,7 +106,7 @@ class Engine:
             self.budget, default_max_new_tokens=cfg.max_new_tokens,
             max_depth=cfg.max_queue_depth,
             ttft_deadline_ms=cfg.ttft_deadline_ms,
-            deadline_ms=cfg.deadline_ms)
+            deadline_ms=cfg.deadline_ms, trace=trace)
         self.scheduler = SlotScheduler(cfg.max_batch)
         self._drained = False
         self.telemetry = ServeTelemetry(cfg.ring_size)
@@ -231,6 +243,19 @@ class Engine:
         t = time.perf_counter()
         seq.note_token(first, t)
         self.telemetry.on_tokens(1, t)
+        if self.trace is not None:
+            track = f"slot {seq.slot}"
+            # arrival→seated is queueing, seated→first token is prefill;
+            # the raw clock values ride along so the trace-derived TTFT
+            # is (t_first_token - t_arrival)*1e3 — bitwise the same
+            # arithmetic ServeTelemetry performs.
+            self.trace.complete("queued", req.arrival_t, seq.seated_t,
+                                track=track, uid=req.uid)
+            self.trace.complete("prefill", seq.seated_t, t, track=track,
+                                uid=req.uid, prompt_len=int(n))
+            self.trace.instant("first_token", track=track, t=t,
+                               uid=req.uid, t_arrival=req.arrival_t,
+                               t_first_token=t)
 
     def step(self) -> list[FinishedRequest]:
         """One engine iteration: admit+prefill, decode, evict.
@@ -261,6 +286,7 @@ class Engine:
 
         active_seqs = self.scheduler.active()
         if active_seqs:
+            t_decode = time.perf_counter()
             mask = self.scheduler.active_mask()
             self._cache, nxt, self._pos = self._decode(
                 self.params, self._cache, self._tok, self._pos,
@@ -271,22 +297,49 @@ class Engine:
             for seq in active_seqs:
                 seq.note_token(toks[seq.slot], t)
             self.telemetry.on_tokens(len(active_seqs), t)
+            if self.trace is not None:
+                self.trace.complete("decode", t_decode, t, track="engine",
+                                    iteration=it,
+                                    active=len(active_seqs))
             finished.extend(self.scheduler.evict_finished(
                 eos, now=t if deadlines else None))
 
         if had_work:
             self.telemetry.on_iteration(
                 it, queue_depth=len(self.queue), active=len(active_seqs))
+            if self.trace is not None:
+                self.trace.counter("queue_depth", len(self.queue))
             if self.idle:  # drained: close the busy segment at last token
                 self.telemetry.end_work()
         else:
             self.telemetry.on_idle()
         for fin in finished:
             self.telemetry.on_finished(fin)
+            if self.trace is not None:
+                self._trace_finish(fin)
         if self._iteration % self.cfg.flush_every == 0:
             self.telemetry.flush(it, len(self.queue),
                                  self.scheduler.num_active)
         return finished
+
+    def _trace_finish(self, fin: FinishedRequest) -> None:
+        """One request's terminal trace events: the decode span (first →
+        last token on its slot track) and a finish mark carrying the
+        reason. Queue-side timeouts never held a slot — they mark on the
+        'queue' track instead."""
+        if fin.slot is None:
+            self.trace.instant("request.timeout", track="queue",
+                               uid=fin.uid)
+            return
+        track = f"slot {fin.slot}"
+        if (fin.first_token_t is not None and fin.last_token_t is not None
+                and fin.tokens.size > 1):
+            self.trace.complete("decode", fin.first_token_t,
+                                fin.last_token_t, track=track,
+                                uid=fin.uid, tokens=int(fin.tokens.size))
+        self.trace.instant(f"finish:{fin.finish_reason}", track=track,
+                           t=fin.last_token_t, uid=fin.uid,
+                           tokens=int(fin.tokens.size))
 
     def run(self, max_iterations: int | None = None
             ) -> list[FinishedRequest]:

@@ -33,16 +33,28 @@ from distributed_training_tpu.observability.flight_recorder import (
     FlightRecorder,
     percentile,
 )
+from distributed_training_tpu.observability.histogram import FixedHistogram
 from distributed_training_tpu.serving.request import FinishedRequest
 
 
 class ServeTelemetry:
-    """Per-request SLA accounting + flight-recorder ring for one engine."""
+    """Per-request SLA accounting + flight-recorder ring for one engine.
+
+    Latency samples feed BOTH views: exact lists for the sample
+    percentiles (bounded by request count per stats window), and
+    fixed-bucket :class:`FixedHistogram`\\ s — the SLO view, mergeable
+    across windows/replicas and exported in Prometheus shape by
+    ``tools/flight_report.py --prometheus``. The histogram-derived
+    p50/p95/p99 ride the stats dict as ``*_hist_*`` keys so a scraper
+    and the bench SLA line agree on the same bucket-resolution numbers.
+    """
 
     def __init__(self, ring_size: int = 4096):
         self.recorder = FlightRecorder(ring_size)
         self.ttft_ms: list[float] = []
         self.tpot_ms: list[float] = []
+        self.ttft_hist = FixedHistogram()
+        self.tpot_hist = FixedHistogram()
         self.tokens_emitted = 0
         self.requests_finished = 0
         self.finish_reasons: dict[str, int] = {}
@@ -95,8 +107,10 @@ class ServeTelemetry:
             self.finish_reasons.get(fin.finish_reason, 0) + 1
         if fin.ttft_ms is not None:  # queue-side timeouts carry no sample
             self.ttft_ms.append(fin.ttft_ms)
+            self.ttft_hist.observe(fin.ttft_ms)
         if fin.tpot_ms is not None:
             self.tpot_ms.append(fin.tpot_ms)
+            self.tpot_hist.observe(fin.tpot_ms)
 
     def flush(self, iteration: int, queue_depth: int, active: int) -> None:
         self.recorder.record_flush(iteration, {
@@ -127,6 +141,14 @@ class ServeTelemetry:
             "ttft_p95_ms": pct(self.ttft_ms, 95),
             "tpot_p50_ms": pct(self.tpot_ms, 50),
             "tpot_p95_ms": pct(self.tpot_ms, 95),
+            # Fixed-bucket (SLO) percentiles — bucket-resolution, but
+            # mergeable and what a Prometheus scrape would report.
+            "ttft_hist_p50_ms": self.ttft_hist.quantile(0.50),
+            "ttft_hist_p95_ms": self.ttft_hist.quantile(0.95),
+            "ttft_hist_p99_ms": self.ttft_hist.quantile(0.99),
+            "tpot_hist_p50_ms": self.tpot_hist.quantile(0.50),
+            "tpot_hist_p95_ms": self.tpot_hist.quantile(0.95),
+            "tpot_hist_p99_ms": self.tpot_hist.quantile(0.99),
             "queue_depth_max": int(self.queue_depth_max),
             "requests_finished": self.requests_finished,
             "requests_timed_out": self.finish_reasons.get(FINISH_TIMEOUT, 0),
@@ -138,7 +160,14 @@ class ServeTelemetry:
              stats: dict[str, Any] | None = None) -> dict[str, Any]:
         """Flight-recorder-compatible JSON dump with a ``serving`` extra
         section (``tools/flight_report.py`` renders it). ``stats`` lets
-        the engine pass its merged summary (queue counters included)."""
+        the engine pass its merged summary (queue counters included);
+        the full TTFT/TPOT bucket counts ride a ``histograms`` subkey
+        (the recorder's own decode-iteration histogram is already in the
+        snapshot's top-level ``histograms``)."""
+        serving = dict(stats if stats is not None else self.stats())
+        serving["histograms"] = {
+            "ttft_ms": self.ttft_hist.to_dict(),
+            "tpot_ms": self.tpot_hist.to_dict(),
+        }
         return self.recorder.dump(
-            path, reason=reason,
-            extra={"serving": stats if stats is not None else self.stats()})
+            path, reason=reason, extra={"serving": serving})

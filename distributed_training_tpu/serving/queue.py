@@ -48,12 +48,18 @@ class RequestQueue:
     ``ttft_deadline_ms`` / ``deadline_ms`` stamp every admitted request
     with absolute deadlines (the engine evicts violators with finish
     reason ``timeout``).
+
+    ``trace`` (a TraceSession or None) marks every admission decision on
+    the timeline's 'queue' track: arrivals as instants (at the request's
+    ARRIVAL time, so queueing spans line up), sheds/drain rejections as
+    instants at the rejection.
     """
 
     def __init__(self, budget: int, default_max_new_tokens: int = 128,
                  max_depth: int | None = None,
                  ttft_deadline_ms: float | None = None,
-                 deadline_ms: float | None = None):
+                 deadline_ms: float | None = None,
+                 trace=None):
         if budget < 2:
             raise ValueError(f"budget must be >= 2, got {budget}")
         if max_depth is not None and max_depth < 1:
@@ -63,6 +69,7 @@ class RequestQueue:
         self.max_depth = max_depth
         self.ttft_deadline_ms = ttft_deadline_ms
         self.deadline_ms = deadline_ms
+        self.trace = trace
         self._lock = threading.Lock()
         self._q: collections.deque[Request] = collections.deque()
         self._closed = False
@@ -104,12 +111,18 @@ class RequestQueue:
         with self._lock:
             if self._closed:
                 self.drain_rejected += 1
+                if self.trace is not None:
+                    self.trace.instant("request.drain_rejected",
+                                       track="queue")
                 raise DrainingError(
                     "engine is draining: admission is closed while "
                     "in-flight requests complete; submit to another "
                     "replica or retry after restart")
             if self.max_depth is not None and len(self._q) >= self.max_depth:
                 self.shed += 1
+                if self.trace is not None:
+                    self.trace.instant("request.shed", track="queue",
+                                       depth=len(self._q))
                 raise QueueFullError(
                     f"request queue is at max_depth={self.max_depth}; "
                     f"shedding load instead of growing the queue (and "
@@ -125,6 +138,10 @@ class RequestQueue:
             self._q.append(req)
             self.submitted += 1
             self.depth_max = max(self.depth_max, len(self._q))
+            if self.trace is not None:
+                self.trace.instant("request.arrival", track="queue",
+                                   t=arrival, uid=req.uid,
+                                   prompt_len=int(tokens.size))
         return req
 
     def close(self) -> None:

@@ -49,6 +49,10 @@ class ActiveSequence:
     request: Request
     slot: int
     tokens: list = dataclasses.field(default_factory=list)  # emitted ids
+    # When the scheduler seated the request into its slot (perf_counter):
+    # arrival→seated is the queueing span, seated→first token the prefill
+    # span on the trace timeline (serving/engine.py).
+    seated_t: float | None = None
     first_token_t: float | None = None
     last_token_t: float | None = None
 
@@ -94,6 +98,11 @@ class FinishedRequest:
     tpot_ms: float | None     # mean inter-token ms; None for <2 tokens
     arrival_t: float          # perf_counter timestamps (fairness audits)
     first_token_t: float | None
+    # Trace-timeline fields (None for queue-side timeouts): the slot the
+    # request decoded in and its last token's landing time — the engine
+    # closes the slot track's decode span from these at eviction.
+    last_token_t: float | None = None
+    slot: int | None = None
 
     @staticmethod
     def from_active(seq: ActiveSequence, reason: str) -> "FinishedRequest":
@@ -110,6 +119,8 @@ class FinishedRequest:
             tpot_ms=tpot,
             arrival_t=seq.request.arrival_t,
             first_token_t=seq.first_token_t,
+            last_token_t=seq.last_token_t,
+            slot=seq.slot,
         )
 
     @staticmethod

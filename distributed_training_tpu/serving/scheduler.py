@@ -18,6 +18,8 @@ is host-side Python; no jax imports.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from distributed_training_tpu.serving.request import (
@@ -71,7 +73,10 @@ class SlotScheduler:
             req: Request | None = queue.pop()
             if req is None:
                 break
-            seq = ActiveSequence(request=req, slot=slot)
+            # seated_t closes the request's queueing interval (arrival →
+            # seat); the engine's trace emits it as the 'queued' span.
+            seq = ActiveSequence(request=req, slot=slot,
+                                 seated_t=time.perf_counter())
             self._slots[slot] = seq
             seated.append(seq)
         return seated

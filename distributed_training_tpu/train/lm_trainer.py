@@ -72,6 +72,7 @@ from distributed_training_tpu.observability import (
     forward_flops,
     train_step_flops,
 )
+from distributed_training_tpu.observability import trace as trace_lib
 from distributed_training_tpu.resilience import retry as retry_lib
 from distributed_training_tpu.resilience import chaos as chaos_lib
 from distributed_training_tpu.resilience.async_ckpt import (
@@ -430,10 +431,19 @@ class LMTrainer:
             self._eval_fn = jax.jit(eval_loss)
 
         self.meter = MetricMeter(cfg.log_interval)
-        # Always-on when the flight recorder is (goodput attribution); the
-        # per-epoch report print stays gated on wall_clock_breakdown.
+        # Forensics default next to the run's durable artifacts.
+        obs_dump_dir = cfg.observability.dump_dir or os.path.join(
+            cfg.checkpoint.directory, "flight")
+        # Span tracing (off by default → trace is None and every
+        # integration point below stays span-free; observability/trace.py).
+        self.trace, trace_path = trace_lib.session_for_run(
+            cfg.observability.trace, default_dir=obs_dump_dir)
+        # Always-on when the flight recorder (or the span trace) is
+        # (goodput attribution); the per-epoch report print stays gated
+        # on wall_clock_breakdown.
         self.clock = WallClock(
-            cfg.wall_clock_breakdown or cfg.observability.flight_recorder)
+            cfg.wall_clock_breakdown or cfg.observability.flight_recorder
+            or self.trace is not None, trace=self.trace)
         self.metrics_writer = MetricsWriter(
             cfg.tensorboard_dir, cfg.metrics_jsonl,
             enabled=self.coord.is_master())
@@ -448,20 +458,23 @@ class LMTrainer:
             n_devices=int(self.mesh.devices.size),
             clock=self.clock, is_master=self.coord.is_master(),
             printer=self.coord.print,
-            # Forensics default next to the run's durable artifacts.
-            dump_dir=cfg.observability.dump_dir or os.path.join(
-                cfg.checkpoint.directory, "flight"),
-            extra_provider=self._resilience_snapshot)
+            dump_dir=obs_dump_dir,
+            extra_provider=self._resilience_snapshot,
+            trace=self.trace, trace_path=trace_path,
+            num_processes=jax.process_count())
         # Resilience: fault injection + background checkpoint writer
         # (single-process only; multihost saves stay synchronous — see
         # trainer.py for the rationale).
-        self.chaos = ChaosMonkey(cfg.chaos) if cfg.chaos.active else None
+        self.chaos = (ChaosMonkey(cfg.chaos,
+                                  process_index=jax.process_index(),
+                                  trace=self.trace)
+                      if cfg.chaos.active else None)
         self._ckpt_writer = None
         if cfg.checkpoint.async_save and jax.process_count() == 1:
             self._ckpt_writer = AsyncCheckpointWriter(
                 post_save=(self.chaos.after_checkpoint_save
                            if self.chaos else None),
-                printer=self.coord.print)
+                printer=self.coord.print, trace=self.trace)
         self._sync_saves = 0
         self._guard: PreemptionGuard | None = None
         self._global_step = 0
@@ -586,9 +599,13 @@ class LMTrainer:
                 self._global_step += 1
                 self._epoch_step += 1
                 fetched = self.meter.push(self._global_step, metrics)
-                self.obs.on_step(self._global_step)
+                # Chaos BEFORE the recorder's timestamp: an injected
+                # slow-step stall then lands in THIS step's wall delta
+                # (like a real straggler's would), so the cross-host
+                # aggregation attributes the injected step itself.
                 if self.chaos is not None:
                     self.chaos.on_step(self._global_step)
+                self.obs.on_step(self._global_step)
                 bar.update()
                 if fetched:
                     extras = self.obs.on_flush(

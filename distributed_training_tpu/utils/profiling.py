@@ -43,15 +43,25 @@ class WallClock:
     not double-counted under 'eval'), so the totals partition the tracked
     wall-time — which is what lets the flight recorder's goodput read
     them as fractions that sum to 1 (``observability/flight_recorder.py``).
+
+    With a ``trace`` session attached, every phase additionally emits one
+    complete span (entry → exit, INCLUSIVE of nested phases — the
+    timeline wants the enclosing extent; exclusivity is the totals'
+    concern) onto ``track``, which is how both trainers get their
+    step/eval/ckpt Perfetto tracks without touching a single phase call
+    site (``observability/trace.py``).
     """
 
-    def __init__(self, enabled: bool = False):
+    def __init__(self, enabled: bool = False, *, trace=None,
+                 track: str = "train"):
         self.enabled = enabled
+        self.trace = trace
+        self.track = track
         self.totals: dict[str, float] = defaultdict(float)
         # Run-lifetime totals: ``report()`` clears ``totals`` per epoch,
         # but the flight recorder's goodput wants the whole run.
         self.lifetime: dict[str, float] = defaultdict(float)
-        self._stack: list[list] = []  # [name, segment_start] frames
+        self._stack: list[list] = []  # [name, segment_start, entry] frames
 
     def _accrue(self, name: str, dt: float) -> None:
         self.totals[name] += dt
@@ -66,7 +76,7 @@ class WallClock:
         if self._stack:  # pause the outer phase
             outer = self._stack[-1]
             self._accrue(outer[0], now - outer[1])
-        self._stack.append([name, now])
+        self._stack.append([name, now, now])
         try:
             yield
         finally:
@@ -75,6 +85,9 @@ class WallClock:
             self._accrue(frame[0], now - frame[1])
             if self._stack:  # resume the outer phase's segment
                 self._stack[-1][1] = now
+            if self.trace is not None:
+                self.trace.complete(frame[0], frame[2], now,
+                                    track=self.track)
 
     def snapshot(self) -> dict[str, float]:
         """Run-lifetime phase totals, never cleared (the flight

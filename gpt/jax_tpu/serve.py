@@ -64,6 +64,14 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--flight-dump", type=str, default=None,
                         help="write a flight-recorder JSON here at exit "
                              "(tools/flight_report.py renders it)")
+    parser.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                        default=False,
+                        help="span-level Perfetto trace: one track per "
+                             "decode slot with each request's queued/"
+                             "prefill/decode lifecycle (open in "
+                             "ui.perfetto.dev or tools/trace_report.py)")
+    parser.add_argument("--trace-dir", type=str, default="./trace",
+                        help="trace output directory")
     parser.add_argument("--json", action="store_true", default=False,
                         help="emit the SLA stats as one JSON line")
     # Model flags (mirror training; generate.py contract).
@@ -140,6 +148,13 @@ def main() -> int:
         printer=lambda msg: print(f"[serve] {msg}", file=sys.stderr),
     )
 
+    from distributed_training_tpu.observability.trace import (
+        session_for_cli,
+    )
+
+    trace, trace_path = session_for_cli(args.trace, args.trace_dir,
+                                        "serve")
+
     engine = Engine(model, params, ServeConfig(
         max_batch=args.max_batch,
         max_len=args.max_len,
@@ -153,7 +168,7 @@ def main() -> int:
         ttft_deadline_ms=args.ttft_deadline_ms,
         deadline_ms=args.deadline_ms,
         seed=args.seed,
-    ))
+    ), trace=trace)
 
     if args.prompts_file:
         with open(args.prompts_file) as fh:
@@ -227,6 +242,10 @@ def main() -> int:
     if args.flight_dump:
         engine.dump_flight(args.flight_dump)
         print(f"[serve] flight record: {args.flight_dump}", file=sys.stderr)
+    if trace is not None:
+        trace.save(trace_path)
+        print(f"[serve] trace: {trace_path} ({len(trace)} events)",
+              file=sys.stderr)
     return 0
 
 

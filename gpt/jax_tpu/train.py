@@ -127,6 +127,16 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--flight-dir", type=str, default=None,
                         help="where anomaly/crash forensics land (flight "
                              "JSON, offending batch, HLO, profiler trace)")
+    parser.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                        default=False,
+                        help="span-level Perfetto trace: step/eval/ckpt "
+                             "phases, the async checkpoint writer's own "
+                             "track, chaos injections — written at run "
+                             "end (open in ui.perfetto.dev, or summarize "
+                             "with tools/trace_report.py)")
+    parser.add_argument("--trace-dir", type=str, default=None,
+                        help="trace output directory (default: "
+                             "<flight dir>/trace)")
     parser.add_argument("--grad-norm-metric", action="store_true",
                         default=False,
                         help="global L2 grad norm as an on-device step "
@@ -177,6 +187,11 @@ def add_chaos_arguments(parser: argparse.ArgumentParser) -> None:
                         help="inject a host stall every N steps "
                              "(straggler simulation)")
     parser.add_argument("--chaos-slow-step-ms", type=float, default=50.0)
+    parser.add_argument("--chaos-slow-step-host", type=int, default=None,
+                        help="restrict the slow-step injection to this "
+                             "process index (multihost straggler drill: "
+                             "one slow host for the flight aggregation "
+                             "to attribute); default: every host")
 
 
 def chaos_config_from_flags(args: argparse.Namespace):
@@ -191,6 +206,7 @@ def chaos_config_from_flags(args: argparse.Namespace):
         data_error_rate=args.chaos_data_error_rate,
         slow_step_every=args.chaos_slow_step_every,
         slow_step_ms=args.chaos_slow_step_ms,
+        slow_step_host=args.chaos_slow_step_host,
     )
 
 
@@ -202,6 +218,7 @@ def build_config(args: argparse.Namespace):
         MeshSpec,
         MoEConfig,
         ObservabilityConfig,
+        TraceConfig,
         TrainConfig,
         ZeroConfig,
     )
@@ -239,6 +256,7 @@ def build_config(args: argparse.Namespace):
             anomaly_detection=args.anomaly_detection,
             anomaly_action=args.anomaly_action,
             anomaly_trace_steps=args.anomaly_trace_steps,
+            trace=TraceConfig(enabled=args.trace, dir=args.trace_dir),
         ),
         chaos=chaos_config_from_flags(args),
         precision=dataclasses.replace(cfg.precision, dtype=args.dtype),

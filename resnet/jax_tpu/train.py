@@ -161,6 +161,14 @@ def add_argument() -> argparse.Namespace:
                              "dumped on anomaly/crash)")
     parser.add_argument("--flight-dir", type=str, default=None,
                         help="anomaly/crash forensics directory")
+    parser.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                        default=False,
+                        help="span-level Perfetto trace (step/eval/ckpt "
+                             "phases, ckpt-writer track, chaos marks); "
+                             "summarize with tools/trace_report.py")
+    parser.add_argument("--trace-dir", type=str, default=None,
+                        help="trace output directory (default: "
+                             "<flight dir>/trace)")
     parser.add_argument("--grad-norm-metric", action="store_true",
                         default=False,
                         help="global L2 grad norm as an on-device metric")
@@ -192,6 +200,9 @@ def add_argument() -> argparse.Namespace:
                              "(the retry policy must absorb them)")
     parser.add_argument("--chaos-slow-step-every", type=int, default=None)
     parser.add_argument("--chaos-slow-step-ms", type=float, default=50.0)
+    parser.add_argument("--chaos-slow-step-host", type=int, default=None,
+                        help="restrict slow-step injection to this "
+                             "process index (straggler drill)")
 
     return parser.parse_args()
 
@@ -254,6 +265,7 @@ def build_config(args: argparse.Namespace):
         DataConfig,
         MoEConfig,
         ObservabilityConfig,
+        TraceConfig,
         TrainConfig,
         from_ds_config,
     )
@@ -306,6 +318,7 @@ def build_config(args: argparse.Namespace):
             anomaly_detection=args.anomaly_detection,
             anomaly_action=args.anomaly_action,
             anomaly_trace_steps=args.anomaly_trace_steps,
+            trace=TraceConfig(enabled=args.trace, dir=args.trace_dir),
         ),
         chaos=ChaosConfig(
             seed=args.chaos_seed,
@@ -316,6 +329,7 @@ def build_config(args: argparse.Namespace):
             data_error_rate=args.chaos_data_error_rate,
             slow_step_every=args.chaos_slow_step_every,
             slow_step_ms=args.chaos_slow_step_ms,
+            slow_step_host=args.chaos_slow_step_host,
         ),
         checkpoint=CheckpointConfig(
             directory=args.checkpoint,

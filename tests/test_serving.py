@@ -401,10 +401,15 @@ class TestServeBenchCli:
         line = capsys.readouterr().out.strip().splitlines()[-1]
         stats = json.loads(line)
         for key in ("throughput_tok_s", "ttft_p50_ms", "ttft_p95_ms",
-                    "tpot_p50_ms", "tpot_p95_ms", "queue_depth_max"):
+                    "tpot_p50_ms", "tpot_p95_ms", "queue_depth_max",
+                    # histogram-derived (fixed-bucket) SLO percentiles
+                    "ttft_hist_p50_ms", "ttft_hist_p95_ms",
+                    "ttft_hist_p99_ms", "tpot_hist_p50_ms",
+                    "tpot_hist_p95_ms", "tpot_hist_p99_ms"):
             assert key in stats, key
         assert stats["throughput_tok_s"] > 0
         assert stats["requests_finished"] == 6
+        assert stats["ttft_hist_p99_ms"] >= stats["ttft_hist_p50_ms"] > 0
 
 
 @pytest.mark.slow

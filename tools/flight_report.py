@@ -58,6 +58,12 @@ def summarize(snap: dict) -> dict:
     if flushes:
         out["last_flush"] = flushes[-1]
     out["anomalies"] = snap.get("anomalies") or []
+    # Cross-host aggregation (observability/aggregate.py): step-time
+    # skew + straggler attribution, cached at the last flush boundary.
+    if snap.get("hosts"):
+        out["hosts"] = snap["hosts"]
+    if snap.get("histograms"):
+        out["histograms"] = snap["histograms"]
     # Serving-engine dumps (serving/metrics.py) carry an SLA section;
     # steps there are decode iterations, so step_time_* above is
     # per-iteration decode latency.
@@ -124,6 +130,27 @@ def render(summary: dict) -> str:
             add(f"    degradation: timed out {degraded['requests_timed_out']}"
                 f"  shed {degraded['requests_shed']}"
                 f"  drain-rejected {degraded['requests_drain_rejected']}")
+    hosts = summary.get("hosts")
+    if hosts:
+        line = f"  hosts: {hosts['num_hosts']}"
+        if "median_step_ms" in hosts:
+            line += (f"  median step {hosts['median_step_ms']:.2f} ms "
+                     f"over {hosts['common_steps']} common steps")
+        add(line)
+        strag = hosts.get("straggler")
+        if strag:
+            add(f"    straggler: host {strag['host']} step "
+                f"{strag['step']}  (+{strag['excess_ms']:.1f} ms, "
+                f"score {strag['score']:.2f})")
+        for ph in hosts.get("per_host", []):
+            if "step_time_mean_ms" not in ph:
+                continue
+            add(f"    host {ph['process_index']}: mean "
+                f"{ph['step_time_mean_ms']:.2f} ms  max "
+                f"{ph['step_time_max_ms']:.2f} ms  excess mean "
+                f"{ph['mean_excess_ms']:+.2f} / max "
+                f"{ph['max_excess_ms']:+.2f} ms (step "
+                f"{ph['max_excess_step']})")
     res = summary.get("resilience")
     if res:
         add(f"  resilience: saves committed {res.get('saves_committed', 0)}"
@@ -143,6 +170,80 @@ def render(summary: dict) -> str:
     return "\n".join(lines)
 
 
+def _prom_hist(lines: list, name: str, hist: dict, help_text: str) -> None:
+    """One Prometheus histogram family from a FixedHistogram dict."""
+    lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} histogram")
+    acc = 0
+    bounds = list(hist["bounds"]) + ["+Inf"]
+    for bound, count in zip(bounds, hist["counts"]):
+        acc += count
+        le = bound if isinstance(bound, str) else f"{bound:g}"
+        lines.append(f'{name}_bucket{{le="{le}"}} {acc}')
+    lines.append(f"{name}_sum {hist['sum']:g}")
+    lines.append(f"{name}_count {hist['count']}")
+
+
+def _prom_gauge(lines: list, name: str, value, help_text: str = "",
+                labels: str = "") -> None:
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return  # non-finite metrics arrive as strings; a scrape skips them
+    if help_text:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} gauge")
+    lines.append(f"{name}{labels} {value:g}")
+
+
+def prometheus_lines(snap: dict) -> list:
+    """The dump as Prometheus text exposition — the bridge from flight
+    forensics to a scraper: ``flight_report.py --prometheus dump.json``
+    can feed a node_exporter textfile collector or a push gateway."""
+    lines: list = []
+    _prom_gauge(lines, "flight_steps_recorded_total",
+                snap.get("steps_recorded_total", 0),
+                "Steps recorded over the run")
+    for k, v in (snap.get("step_time_stats") or {}).items():
+        _prom_gauge(lines, f"flight_{k}", v, "Ring-window step time")
+    wc = snap.get("wall_clock") or {}
+    if wc:
+        _prom_gauge(lines, "flight_goodput", wc.get("goodput"),
+                    "Step share of tracked wall-time")
+        phases = wc.get("phase_seconds") or {}
+        if phases:
+            lines.append("# HELP flight_phase_seconds Wall-clock phase "
+                         "totals")
+            lines.append("# TYPE flight_phase_seconds gauge")
+            for ph, v in sorted(phases.items()):
+                _prom_gauge(lines, "flight_phase_seconds", v,
+                            labels=f'{{phase="{ph}"}}')
+    for name, hist in (snap.get("histograms") or {}).items():
+        _prom_hist(lines, f"flight_{name}", hist,
+                   "Fixed-bucket run-lifetime histogram")
+    srv = snap.get("serving") or {}
+    for k, v in srv.items():
+        if k == "histograms":
+            continue
+        _prom_gauge(lines, f"serving_{k}", v, "Serving SLA summary field")
+    for name, hist in (srv.get("histograms") or {}).items():
+        _prom_hist(lines, f"serving_{name}", hist,
+                   "Fixed-bucket serving latency histogram")
+    hosts = snap.get("hosts") or {}
+    strag = hosts.get("straggler")
+    if strag:
+        _prom_gauge(lines, "flight_straggler_host", strag["host"],
+                    "Attributed straggler process index")
+        _prom_gauge(lines, "flight_straggler_step", strag["step"],
+                    "Attributed straggler step")
+        _prom_gauge(lines, "flight_straggler_excess_ms",
+                    strag["excess_ms"], "Straggler excess over baseline")
+    res = snap.get("resilience") or {}
+    for k in ("saves_committed", "saves_failed", "io_retries"):
+        if k in res:
+            _prom_gauge(lines, f"resilience_{k}", res[k],
+                        "Resilience counter")
+    return lines
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="summarize a flight-recorder JSON dump")
@@ -150,13 +251,25 @@ def main(argv=None) -> int:
                                  "TrainObservability.dump()")
     ap.add_argument("--json", action="store_true", default=False,
                     help="emit the summary as one JSON object")
+    ap.add_argument("--prometheus", action="store_true", default=False,
+                    help="emit the dump as Prometheus text exposition "
+                         "(gauges + histogram families) for a scraper")
     args = ap.parse_args(argv)
-    snap = FlightRecorder.load(args.path)
-    summary = summarize(snap)
-    if args.json:
-        print(json.dumps(summary))
-    else:
-        print(render(summary))
+    try:
+        snap = FlightRecorder.load(args.path)
+        if args.prometheus:
+            out = "\n".join(prometheus_lines(snap))
+        elif args.json:
+            out = json.dumps(summarize(snap))
+        else:
+            out = render(summarize(snap))
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        # A malformed/truncated dump is an expected operational input
+        # (the crash it documents may have torn it): one actionable line
+        # on stderr + a nonzero exit, never a traceback.
+        print(f"flight_report: error: {args.path}: {e}", file=sys.stderr)
+        return 2
+    print(out)
     return 0
 
 

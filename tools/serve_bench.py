@@ -7,7 +7,13 @@ random-token prompts against a random-weight GPT, and prints ONE
 strict-JSON line with the SLA summary:
 
     {"throughput_tok_s": ..., "ttft_p50_ms": ..., "ttft_p95_ms": ...,
-     "tpot_p50_ms": ..., "tpot_p95_ms": ..., "queue_depth_max": ..., ...}
+     "tpot_p50_ms": ..., "tpot_p95_ms": ..., "ttft_hist_p50_ms": ...,
+     "ttft_hist_p95_ms": ..., "ttft_hist_p99_ms": ...,
+     "tpot_hist_p50_ms": ..., ..., "queue_depth_max": ..., ...}
+
+(The `*_hist_*` percentiles are derived from the fixed-bucket SLO
+histograms in serving/metrics.py — bucket-resolution, mergeable, the
+numbers a Prometheus scrape of the flight dump would report.)
 
 Same contract as bench.py's JSON lines: machine-readable, last line of
 stdout, parseable by ``json.loads`` (the CI smoke step asserts exactly
@@ -55,6 +61,13 @@ def add_argument() -> argparse.Namespace:
                    help="skip the compile warm-up pass (its compile time "
                         "then lands in the measured TTFT tail)")
     p.add_argument("--flight-dump", type=str, default=None)
+    p.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="span-level Perfetto trace of the measured "
+                        "window: one track per decode slot with request "
+                        "lifecycles (tools/trace_report.py summarizes)")
+    p.add_argument("--trace-dir", type=str, default="./trace",
+                   help="trace output directory")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args()
 
@@ -88,11 +101,18 @@ def main() -> int:
     params = model.init(jax.random.PRNGKey(args.seed),
                         np.zeros((1, 8), np.int32))["params"]
 
+    from distributed_training_tpu.observability.trace import (
+        session_for_cli,
+    )
+
+    trace, trace_path = session_for_cli(args.trace, args.trace_dir,
+                                        "serve_bench")
+
     engine = Engine(model, params, ServeConfig(
         max_batch=args.max_batch, max_len=args.max_len,
         max_new_tokens=args.max_new_tokens,
         temperature=args.temperature, eos_id=args.eos_id,
-        prefill_bucket=args.prefill_bucket, seed=args.seed))
+        prefill_bucket=args.prefill_bucket, seed=args.seed), trace=trace)
 
     rng = np.random.RandomState(args.seed)
 
@@ -151,6 +171,10 @@ def main() -> int:
     if args.flight_dump:
         engine.dump_flight(args.flight_dump, reason="serve_bench")
         print(f"[serve_bench] flight record: {args.flight_dump}",
+              file=sys.stderr)
+    if trace is not None:
+        trace.save(trace_path)
+        print(f"[serve_bench] trace: {trace_path} ({len(trace)} events)",
               file=sys.stderr)
     print(json.dumps(stats, allow_nan=False))
     return 0
