@@ -313,8 +313,24 @@ class ObservabilityConfig:
     straggler_window: int = 256
     # Span-level Perfetto tracing (off by default; see TraceConfig).
     trace: TraceConfig = dataclasses.field(default_factory=TraceConfig)
+    # Live telemetry plane (observability/exporter.py): serve /metrics
+    # (Prometheus text), /healthz (liveness + run phase) and /vars
+    # (strict-JSON flight snapshot) from a background thread while the
+    # run is alive. None — the default — binds nothing; 0 binds an
+    # ephemeral port (tests). Master process only on multihost. The
+    # scrape handler reads the same cached host-side summaries the
+    # flight dump reads — never a device value, never a collective.
+    metrics_port: int | None = None
+    # Exporter bind address. Loopback by default: exposing telemetry
+    # beyond the host is an explicit operator decision ("0.0.0.0").
+    metrics_host: str = "127.0.0.1"
 
     def __post_init__(self):
+        if self.metrics_port is not None and not (
+                0 <= self.metrics_port <= 65535):
+            raise ValueError(
+                f"metrics_port must be in [0, 65535], got "
+                f"{self.metrics_port}")
         if self.anomaly_action not in ("raise", "skip"):
             raise ValueError(
                 f"anomaly_action must be 'raise' or 'skip', got "

@@ -33,6 +33,15 @@ hardware-utilization and forensics layer a production trainer needs:
 - :mod:`histogram` — fixed-bucket SLO histograms (TTFT/TPOT/step time),
   mergeable and Prometheus-exportable via
   ``tools/flight_report.py --prometheus``.
+- :mod:`prometheus` — THE Prometheus text exposition (gauges +
+  cumulative-``le`` histogram families) of a flight snapshot, shared by
+  the report tool and the live exporter so the two agree
+  family-for-family.
+- :mod:`exporter` — the live telemetry plane: an in-process
+  ``/metrics`` + ``/healthz`` + ``/vars`` HTTP endpoint (stdlib
+  ``http.server`` background thread) scrapeable while a trainer or the
+  serving engine is alive; attach via ``ObservabilityConfig.
+  metrics_port`` / ``--metrics-port``.
 
 The serving engine (``serving/metrics.py``) rides the same flight
 recorder for its SLA telemetry: decode iterations are recorded as steps
@@ -48,9 +57,16 @@ from distributed_training_tpu.observability.anomaly import (  # noqa: F401
 from distributed_training_tpu.observability.aggregate import (  # noqa: F401
     summarize_hosts,
 )
+# NOTE: observability.exporter is deliberately NOT re-exported here:
+# every attachment point imports it lazily inside its metrics_port
+# guard, so a run with the exporter off never loads http.server.
 from distributed_training_tpu.observability.flight_recorder import (  # noqa: F401
     FlightRecorder,
     percentile,
+)
+from distributed_training_tpu.observability.prometheus import (  # noqa: F401
+    prometheus_lines,
+    prometheus_text,
 )
 from distributed_training_tpu.observability.histogram import (  # noqa: F401
     FixedHistogram,

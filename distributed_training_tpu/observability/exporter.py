@@ -1,0 +1,185 @@
+"""Live telemetry plane: an in-process HTTP endpoint for scrapers.
+
+Every observability surface before this round was post-mortem — flight
+dumps, trace JSON, ``flight_report.py --prometheus`` all require the
+run to have written a file. A production trainer or serving engine must
+be scrapeable *while alive*: Prometheus polls ``/metrics``, a load
+balancer polls ``/healthz``, an on-call curls ``/vars`` for the full
+picture. This module is that plane, on stdlib ``http.server`` only:
+
+- ``GET /metrics`` — Prometheus text exposition of the live flight
+  snapshot, rendered by the SAME :func:`~distributed_training_tpu.
+  observability.prometheus.prometheus_lines` the report tool uses, so a
+  live scrape and ``flight_report.py --prometheus`` of the same run
+  agree family-for-family.
+- ``GET /healthz`` — one small JSON object: liveness, the current run
+  phase (train step / eval / serving / draining / drained), uptime,
+  scrape count. 200 means "process alive and responding"; phase carries
+  the rest.
+- ``GET /vars`` — the full flight snapshot as strict JSON (the same
+  dict a flight dump would write, minus the disk I/O).
+
+**Scrape-safety contract.** The handler thread only ever calls the
+``snapshot_provider`` the owner registered, and every provider in this
+codebase reads host-side state the hot loop already materialized: ring
+buffers of timestamps, flush dicts, cached cross-host summaries, queue
+counters. A scrape never touches a device, never triggers a collective,
+and never blocks the step/decode loop (worst case it reads a value one
+iteration stale). Serving is a daemon thread — a hung scraper cannot
+keep the process alive.
+
+Attachment points: :class:`~distributed_training_tpu.observability.
+hooks.TrainObservability` owns one when ``ObservabilityConfig.
+metrics_port`` is set (both trainers, master process only), and the
+serving CLIs (``gpt/jax_tpu/serve.py``, ``tools/serve_bench.py``)
+attach one to :meth:`Engine.flight_snapshot` via ``--metrics-port``.
+Off by default: no port, no thread, no import cost.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+from distributed_training_tpu.observability.prometheus import (
+    TEXT_CONTENT_TYPE,
+    prometheus_text,
+)
+
+
+class MetricsExporter:
+    """One background HTTP server exposing a live flight snapshot.
+
+    >>> exp = MetricsExporter(lambda: obs.scrape_snapshot(), port=9090)
+    >>> exp.start()
+    >>> # ... run; scrapers poll http://127.0.0.1:9090/metrics ...
+    >>> exp.close()
+
+    ``snapshot_provider`` returns the flight-snapshot dict (the
+    :meth:`FlightRecorder.snapshot` shape, extra sections included);
+    ``phase_provider`` returns the current run-phase string for
+    ``/healthz``. Both are called on the handler thread — they must
+    read cached host-side state only (see the module docstring).
+
+    ``port=0`` binds an ephemeral port (tests); the resolved port is
+    :attr:`port`. A port already in use raises ``OSError`` at
+    construction — loudly, before the run starts, not at first scrape.
+    ``host`` defaults to loopback: exposing telemetry beyond the host
+    is a deliberate operator decision (``0.0.0.0``), not a default.
+    """
+
+    def __init__(self, snapshot_provider: Callable[[], dict], *,
+                 port: int, host: str = "127.0.0.1",
+                 phase_provider: Callable[[], str] | None = None):
+        self._provider = snapshot_provider
+        self._phase = phase_provider or (lambda: "running")
+        self._t0 = time.perf_counter()
+        self.scrapes = 0  # /metrics GETs served (rides /healthz)
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # One scrape per poll interval: default request logging would
+            # turn stderr into a heartbeat log.
+            def log_message(self, *args: Any) -> None:
+                pass
+
+            def do_GET(self) -> None:
+                exporter._handle(self)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        # daemon_threads: a scraper that stops reading mid-response must
+        # not block process exit.
+        self._server.daemon_threads = True
+        self.host = self._server.server_address[0]
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
+            name="metrics-exporter", daemon=True)
+        self._started = False
+        self._closed = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MetricsExporter":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop serving and release the port (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._started:
+            self._server.shutdown()
+            self._thread.join(timeout=5.0)
+        self._server.server_close()
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    # -- request handling ----------------------------------------------------
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0]
+        try:
+            if path == "/metrics":
+                self.scrapes += 1
+                body = prometheus_text(self._provider())
+                ctype = TEXT_CONTENT_TYPE
+            elif path == "/healthz":
+                body = json.dumps({
+                    "status": "ok",
+                    "phase": str(self._phase()),
+                    "uptime_seconds": time.perf_counter() - self._t0,
+                    "scrapes": self.scrapes,
+                }, allow_nan=False) + "\n"
+                ctype = "application/json"
+            elif path == "/vars":
+                # The full snapshot, strict JSON (the provider's dict is
+                # already sanitized the way flight dumps are: non-finite
+                # metrics ride as 'nan'/'inf' strings).
+                body = json.dumps(self._provider(), allow_nan=False) + "\n"
+                ctype = "application/json"
+            else:
+                self._send(req, 404, "application/json",
+                           '{"error": "not found", "endpoints": '
+                           '["/metrics", "/healthz", "/vars"]}\n')
+                return
+        except Exception as e:  # a bad snapshot must not kill the server
+            self._send(req, 500, "text/plain; charset=utf-8",
+                       f"snapshot failed: {type(e).__name__}: {e}\n")
+            return
+        self._send(req, 200, ctype, body)
+
+    @staticmethod
+    def _send(req: BaseHTTPRequestHandler, code: int, ctype: str,
+              body: str) -> None:
+        data = body.encode("utf-8")
+        try:
+            req.send_response(code)
+            req.send_header("Content-Type", ctype)
+            req.send_header("Content-Length", str(len(data)))
+            req.end_headers()
+            req.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper hung up mid-response; nothing to clean up
+
+
+def attach_engine(engine, port: int, *, component: str = "serve",
+                  host: str = "127.0.0.1",
+                  printer: Callable[[str], None] = print
+                  ) -> MetricsExporter:
+    """Attach a started exporter to a serving ``Engine`` — the one
+    wiring both serving CLIs (``serve.py``, ``serve_bench.py``) share:
+    snapshots from ``engine.flight_snapshot`` (never flushes, never
+    syncs), /healthz phase from ``engine.phase``
+    (serving → draining → drained)."""
+    exporter = MetricsExporter(
+        engine.flight_snapshot, port=port, host=host,
+        phase_provider=lambda: engine.phase).start()
+    printer(f"[{component}] live metrics: {exporter.url('')} "
+            f"(/metrics /healthz /vars)")
+    return exporter

@@ -78,6 +78,10 @@ class TrainObservability:
         self.clock = clock
         self.trace = trace
         self.trace_path = trace_path
+        # Coarse run phase for /healthz (the clock's live phase wins
+        # while a phase context is open); trainers advance it via
+        # on_epoch/close.
+        self.phase = "init"
         self.num_processes = int(num_processes)
         self._host_summary: dict | None = None
         self._trace_saved = False
@@ -96,6 +100,34 @@ class TrainObservability:
         self._pending_raise: AnomalyError | None = None
         self._fired = False
         self._crash_dumped = False
+        # Live telemetry plane (observability/exporter.py): a background
+        # /metrics//healthz//vars endpoint over scrape_snapshot().
+        # Master-only — secondary hosts hold no flushed metrics anyway —
+        # and bound at construction so a taken port fails the run START,
+        # not the first scrape.
+        self.exporter = None
+        if cfg.metrics_port is not None and is_master:
+            from distributed_training_tpu.observability.exporter import (
+                MetricsExporter,
+            )
+
+            self.exporter = MetricsExporter(
+                self.scrape_snapshot, port=cfg.metrics_port,
+                host=cfg.metrics_host,
+                phase_provider=self._live_phase).start()
+            self.printer(f"[observability] live metrics: "
+                         f"{self.exporter.url('')} "
+                         f"(/metrics /healthz /vars)")
+
+    def _live_phase(self) -> str:
+        """The /healthz phase: the clock's currently-open phase (step /
+        data / eval / ckpt — read without locking; phases are strings
+        swapped atomically under the GIL) or the coarse run phase."""
+        if self.clock is not None:
+            ph = self.clock.current_phase
+            if ph:
+                return ph
+        return self.phase
 
     def on_epoch(self) -> None:
         """Epoch boundary: the eval/ckpt/reshuffle pause before the next
@@ -103,6 +135,7 @@ class TrainObservability:
         consecutive across epochs, so the recorder can't infer it), nor
         into the next flush's FLOPs rate — drop the MFU anchor so
         :meth:`on_step` re-anchors at the first step of the new epoch."""
+        self.phase = "train"
         if self.recorder is not None:
             self.recorder.mark_gap()
         self._rate_anchor = None
@@ -253,15 +286,12 @@ class TrainObservability:
         self._tracing = False
 
     # -- dumps / lifecycle ---------------------------------------------------
-    def dump(self, path: str | None = None,
-             reason: str = "on-demand") -> str | None:
-        """Write the flight record to ``path`` (default
-        ``dump_dir/flight.json``); returns the path, or None when the
-        recorder is off."""
-        if self.recorder is None:
-            return None
-        if path is None:
-            path = os.path.join(self.dump_dir, "flight.json")
+    def _dump_sections(self) -> tuple[dict | None, dict | None]:
+        """``(phase_totals, extra)`` shared by disk dumps and live
+        scrapes: lifetime clock totals, the trainers' extra sections
+        (resilience counters), the flush-cached cross-host summary.
+        Every value is host-side and already materialized — reading them
+        from the exporter's handler thread triggers nothing."""
         totals = self.clock.snapshot() if self.clock is not None else None
         extra = None
         if self.extra_provider is not None:
@@ -274,6 +304,34 @@ class TrainObservability:
             # Latest flush-boundary skew/straggler view (cached — no
             # collective here; see on_flush).
             extra = {**(extra or {}), "hosts": self._host_summary}
+        return totals, extra
+
+    def scrape_snapshot(self) -> dict:
+        """The live flight snapshot a ``/metrics``/``/vars`` scrape
+        serves: composed exactly like :meth:`dump`'s record but never
+        touching disk. With the flight recorder off, a minimal snapshot
+        (goodput + extra sections only) keeps the endpoint alive."""
+        totals, extra = self._dump_sections()
+        if self.recorder is not None:
+            return self.recorder.snapshot(reason="scrape",
+                                          phase_totals=totals, extra=extra)
+        snap: dict = {"reason": "scrape", "steps_recorded_total": 0}
+        if totals:
+            snap["wall_clock"] = FlightRecorder.goodput(totals)
+        if extra:
+            snap.update(extra)
+        return snap
+
+    def dump(self, path: str | None = None,
+             reason: str = "on-demand") -> str | None:
+        """Write the flight record to ``path`` (default
+        ``dump_dir/flight.json``); returns the path, or None when the
+        recorder is off."""
+        if self.recorder is None:
+            return None
+        if path is None:
+            path = os.path.join(self.dump_dir, "flight.json")
+        totals, extra = self._dump_sections()
         self.recorder.dump(path, reason=reason, phase_totals=totals,
                            extra=extra)
         return path
@@ -312,9 +370,12 @@ class TrainObservability:
             self.printer(f"[observability] crash trace save failed: {e}")
 
     def close(self, raise_pending: bool = True) -> None:
-        """Idempotent teardown: stop a dangling anomaly trace; write the
-        span trace; surface a deferred raise whose trace window the
-        run's end cut short."""
+        """Idempotent teardown: stop the live exporter and a dangling
+        anomaly trace; write the span trace; surface a deferred raise
+        whose trace window the run's end cut short."""
+        self.phase = "done"
+        if self.exporter is not None:
+            self.exporter.close()
         self._trace_left = 0
         self._stop_trace()
         try:

@@ -12,7 +12,10 @@ restated for XLA's static-shape world:
 - :mod:`engine` — the compiled prefill/scatter/decode trio over a
   slot-axis KV-cache pytree, and the admit→prefill→decode→evict loop.
 - :mod:`metrics` — TTFT/TPOT/throughput/queue-depth SLA telemetry through
-  the round-7 flight recorder.
+  the round-7 flight recorder, plus KV/slot utilization accounting
+  (reserved-vs-written cache positions, queue-wait vs prefill breakdown,
+  admission-blocked time) — live-scrapeable via ``--metrics-port``
+  (``observability/exporter.py``).
 
 Surfaces: ``gpt/jax_tpu/serve.py`` (interactive/file serving CLI) and
 ``tools/serve_bench.py`` (Poisson load generator). See docs/SERVING.md.

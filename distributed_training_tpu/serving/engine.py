@@ -243,6 +243,11 @@ class Engine:
         t = time.perf_counter()
         seq.note_token(first, t)
         self.telemetry.on_tokens(1, t)
+        # Admission-latency breakdown: queueing (arrival → seat) vs
+        # prefill compute (seat → first token) — the same endpoints the
+        # trace spans below carry, so the two views agree bitwise.
+        self.telemetry.on_admitted((seq.seated_t - req.arrival_t) * 1e3,
+                                   (t - seq.seated_t) * 1e3)
         if self.trace is not None:
             track = f"slot {seq.slot}"
             # arrival→seated is queueing, seated→first token is prefill;
@@ -283,6 +288,13 @@ class Engine:
         # Prefill-time completions: a 1-token budget or an instant EOS
         # never joins a decode iteration.
         finished.extend(self.scheduler.evict_finished(eos))
+        # Head-of-line blocking: requests still queued with every slot
+        # busy wait out the whole iteration (admission is boundary-only)
+        # — bill the rest of this iteration as admission-blocked time.
+        blocked_t0 = (time.perf_counter()
+                      if len(self.queue) > 0
+                      and self.scheduler.num_active == self.cfg.max_batch
+                      else None)
 
         active_seqs = self.scheduler.active()
         if active_seqs:
@@ -297,10 +309,23 @@ class Engine:
             for seq in active_seqs:
                 seq.note_token(toks[seq.slot], t)
             self.telemetry.on_tokens(len(active_seqs), t)
+            # KV utilization, host-side only: a slot's occupied cache
+            # positions equal prompt + decode-written tokens — the
+            # device cache_index reconstructed without a device read;
+            # every active slot reserves the full per-slot budget.
+            written = sum(s.request.prompt.size + len(s.tokens) - 1
+                          for s in active_seqs)
+            self.telemetry.on_kv(
+                reserved=len(active_seqs) * self.budget, written=written,
+                active=len(active_seqs), slots=self.cfg.max_batch)
+            if blocked_t0 is not None:
+                self.telemetry.on_admission_blocked(t - blocked_t0)
             if self.trace is not None:
                 self.trace.complete("decode", t_decode, t, track="engine",
                                     iteration=it,
                                     active=len(active_seqs))
+                self.trace.counter("active_slots", len(active_seqs))
+                self.trace.counter("kv_written_tokens", written)
             finished.extend(self.scheduler.evict_finished(
                 eos, now=t if deadlines else None))
 
@@ -377,6 +402,16 @@ class Engine:
         """True once admission has been closed (drain started)."""
         return self.queue.closed
 
+    @property
+    def phase(self) -> str:
+        """Coarse lifecycle phase for the /healthz endpoint:
+        serving → draining → drained (idle = alive, nothing queued)."""
+        if self._drained:
+            return "drained"
+        if self.queue.closed:
+            return "draining"
+        return "idle" if self.idle else "serving"
+
     # -- telemetry surface ---------------------------------------------------
     def stats(self) -> dict[str, Any]:
         """SLA summary. ``queue_depth_max`` is the submit-time high-water
@@ -403,6 +438,15 @@ class Engine:
         self.telemetry = ServeTelemetry(self.cfg.ring_size)
         self.queue.reset_counters()
         self._iteration = 0
+
+    def flight_snapshot(self, *, reason: str = "scrape") -> dict[str, Any]:
+        """The live flight snapshot a /metrics scrape serves — same
+        composition as :meth:`dump_flight` but no disk write and NO
+        flush (a scrape observes, it must not mutate the flush ring).
+        Every input is host-side state this thread already owns or
+        lock-guarded queue counters — scrape-safe from the exporter's
+        handler thread while the serving loop runs."""
+        return self.telemetry.snapshot(reason=reason, stats=self.stats())
 
     def dump_flight(self, path: str, *,
                     reason: str = "serving") -> dict[str, Any]:

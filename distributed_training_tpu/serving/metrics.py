@@ -14,6 +14,25 @@ inference on-call actually pages on:
   serving capacity.
 - **queue_depth_max**: admission high-water mark.
 
+Utilization accounting (the evidence layer for the paged-KV ROADMAP
+item): today every slot reserves the full ``max_len`` cache budget and
+admission runs one batch-1 prefill per request — this module *measures*
+what that costs instead of asserting it:
+
+- **kv_reserved_vs_written**: per decode iteration, KV positions
+  *reserved* (active slots × per-slot budget) vs *actually written*
+  (each slot's live cache write head) — summed over the run, their
+  ratio is the ``max_len`` over-reservation factor a paged allocator
+  would reclaim.
+- **slot_occupancy_mean**: active slots / total slots per iteration —
+  how much of the decode batch the arrival process actually fills.
+- **queue wait vs prefill compute**: per request, arrival→seated
+  (queueing) and seated→first-token (prefill compute) separately, as
+  sample percentiles AND fixed-bucket histograms — the breakdown that
+  shows whether admission latency is load or serialization.
+- **admission_blocked_s**: wall-time with requests queued while every
+  slot was busy — the head-of-line blocking chunked prefill removes.
+
 The engine drives the same two touch points the trainers use
 (``observability/hooks.py`` shape): :meth:`on_iteration` per decode
 iteration (one host timestamp into the :class:`FlightRecorder` ring — so
@@ -55,6 +74,18 @@ class ServeTelemetry:
         self.tpot_ms: list[float] = []
         self.ttft_hist = FixedHistogram()
         self.tpot_hist = FixedHistogram()
+        # Admission-latency breakdown: queueing vs prefill compute.
+        self.queue_wait_ms: list[float] = []
+        self.prefill_ms: list[float] = []
+        self.queue_wait_hist = FixedHistogram()
+        self.prefill_hist = FixedHistogram()
+        # KV/slot utilization accumulators (token-iterations: one unit =
+        # one cache position over one decode iteration).
+        self.kv_reserved_tokens = 0
+        self.kv_written_tokens = 0
+        self.slot_iters_active = 0
+        self.slot_iters_total = 0
+        self.admission_blocked_s = 0.0
         self.tokens_emitted = 0
         self.requests_finished = 0
         self.finish_reasons: dict[str, int] = {}
@@ -100,6 +131,32 @@ class ServeTelemetry:
     def on_tokens(self, n: int, t: float | None = None) -> None:
         self.tokens_emitted += n
         self._busy_t1 = time.perf_counter() if t is None else t
+
+    def on_kv(self, *, reserved: int, written: int, active: int,
+              slots: int) -> None:
+        """One decode iteration's KV-cache occupancy: ``reserved`` =
+        active slots × per-slot budget, ``written`` = Σ live cache write
+        heads (prompt + generated positions actually holding K/V). All
+        host-side integers the engine already tracks — no device read."""
+        self.kv_reserved_tokens += int(reserved)
+        self.kv_written_tokens += int(written)
+        self.slot_iters_active += int(active)
+        self.slot_iters_total += int(slots)
+
+    def on_admitted(self, queue_wait_ms: float,
+                    prefill_ms: float) -> None:
+        """One request seated and prefilled: its queueing span
+        (arrival → seat) and prefill-compute span (seat → first token),
+        in ms — the same arithmetic the trace spans carry."""
+        self.queue_wait_ms.append(queue_wait_ms)
+        self.queue_wait_hist.observe(queue_wait_ms)
+        self.prefill_ms.append(prefill_ms)
+        self.prefill_hist.observe(prefill_ms)
+
+    def on_admission_blocked(self, seconds: float) -> None:
+        """Wall-time this iteration spent with requests queued while
+        every decode slot was busy (head-of-line blocking)."""
+        self.admission_blocked_s += max(float(seconds), 0.0)
 
     def on_finished(self, fin: FinishedRequest) -> None:
         self.requests_finished += 1
@@ -154,20 +211,52 @@ class ServeTelemetry:
             "requests_timed_out": self.finish_reasons.get(FINISH_TIMEOUT, 0),
             "tokens_emitted": self.tokens_emitted,
             "busy_seconds": busy_s,
+            # Utilization accounting (see module docstring): the
+            # over-reservation evidence for the paged-KV roadmap item.
+            "kv_reserved_tokens": int(self.kv_reserved_tokens),
+            "kv_written_tokens": int(self.kv_written_tokens),
+            "kv_reserved_vs_written": (
+                self.kv_reserved_tokens / self.kv_written_tokens
+                if self.kv_written_tokens else 0.0),
+            "slot_occupancy_mean": (
+                self.slot_iters_active / self.slot_iters_total
+                if self.slot_iters_total else 0.0),
+            "queue_wait_p50_ms": pct(self.queue_wait_ms, 50),
+            "queue_wait_p95_ms": pct(self.queue_wait_ms, 95),
+            "prefill_p50_ms": pct(self.prefill_ms, 50),
+            "prefill_p95_ms": pct(self.prefill_ms, 95),
+            "admission_blocked_s": self.admission_blocked_s,
         }
 
-    def dump(self, path: str, *, reason: str = "serving",
-             stats: dict[str, Any] | None = None) -> dict[str, Any]:
-        """Flight-recorder-compatible JSON dump with a ``serving`` extra
-        section (``tools/flight_report.py`` renders it). ``stats`` lets
-        the engine pass its merged summary (queue counters included);
-        the full TTFT/TPOT bucket counts ride a ``histograms`` subkey
+    def _serving_section(self, stats: dict[str, Any] | None
+                         ) -> dict[str, Any]:
+        """The ``serving`` extra section dumps AND live scrapes carry:
+        the SLA summary plus the full fixed-bucket latency histograms
         (the recorder's own decode-iteration histogram is already in the
         snapshot's top-level ``histograms``)."""
         serving = dict(stats if stats is not None else self.stats())
         serving["histograms"] = {
             "ttft_ms": self.ttft_hist.to_dict(),
             "tpot_ms": self.tpot_hist.to_dict(),
+            "queue_wait_ms": self.queue_wait_hist.to_dict(),
+            "prefill_ms": self.prefill_hist.to_dict(),
         }
+        return serving
+
+    def snapshot(self, *, reason: str = "scrape",
+                 stats: dict[str, Any] | None = None) -> dict[str, Any]:
+        """The live flight snapshot (dump shape, no disk): what the
+        ``/metrics``/``/vars`` exporter serves mid-run. Reads only
+        host-side state this object already holds — scrape-safe from
+        another thread by construction."""
+        return self.recorder.snapshot(
+            reason=reason, extra={"serving": self._serving_section(stats)})
+
+    def dump(self, path: str, *, reason: str = "serving",
+             stats: dict[str, Any] | None = None) -> dict[str, Any]:
+        """Flight-recorder-compatible JSON dump with a ``serving`` extra
+        section (``tools/flight_report.py`` renders it). ``stats`` lets
+        the engine pass its merged summary (queue counters included)."""
         return self.recorder.dump(
-            path, reason=reason, extra={"serving": serving})
+            path, reason=reason,
+            extra={"serving": self._serving_section(stats)})

@@ -67,6 +67,17 @@ class WallClock:
         self.totals[name] += dt
         self.lifetime[name] += dt
 
+    @property
+    def current_phase(self) -> str | None:
+        """The innermost open phase name, or None outside any phase.
+        Read lock-free from other threads (the /healthz endpoint): the
+        stack only ever gains/loses whole frames under the GIL, and a
+        transiently stale answer is fine for a liveness probe."""
+        try:
+            return self._stack[-1][0]
+        except IndexError:  # popped between the probe's check and read
+            return None
+
     @contextlib.contextmanager
     def phase(self, name: str):
         if not self.enabled:

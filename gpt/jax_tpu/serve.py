@@ -64,6 +64,12 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--flight-dump", type=str, default=None,
                         help="write a flight-recorder JSON here at exit "
                              "(tools/flight_report.py renders it)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="live telemetry plane: /metrics (Prometheus "
+                             "text, incl. TTFT/TPOT histograms + KV/slot "
+                             "utilization), /healthz (serving/draining/"
+                             "drained phase) and /vars, scrapeable while "
+                             "the engine serves (loopback; 0 = ephemeral)")
     parser.add_argument("--trace", action=argparse.BooleanOptionalAction,
                         default=False,
                         help="span-level Perfetto trace: one track per "
@@ -170,6 +176,19 @@ def main() -> int:
         seed=args.seed,
     ), trace=trace)
 
+    # Live telemetry plane: scrape the engine while it serves. The
+    # handler thread reads host-side telemetry the decode loop already
+    # materialized (engine.flight_snapshot never flushes or syncs).
+    exporter = None
+    if args.metrics_port is not None:
+        from distributed_training_tpu.observability.exporter import (
+            attach_engine,
+        )
+
+        exporter = attach_engine(
+            engine, args.metrics_port, component="serve",
+            printer=lambda msg: print(msg, file=sys.stderr, flush=True))
+
     if args.prompts_file:
         with open(args.prompts_file) as fh:
             lines = [ln.rstrip("\n") for ln in fh]
@@ -246,6 +265,8 @@ def main() -> int:
         trace.save(trace_path)
         print(f"[serve] trace: {trace_path} ({len(trace)} events)",
               file=sys.stderr)
+    if exporter is not None:
+        exporter.close()  # daemon thread; close just frees the port early
     return 0
 
 

@@ -137,6 +137,13 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--trace-dir", type=str, default=None,
                         help="trace output directory (default: "
                              "<flight dir>/trace)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="live telemetry plane: serve /metrics "
+                             "(Prometheus text), /healthz and /vars from "
+                             "a background thread on this port while the "
+                             "run is alive (loopback; 0 = ephemeral; "
+                             "master process only). Scrapes read cached "
+                             "host-side summaries — never a device value")
     parser.add_argument("--grad-norm-metric", action="store_true",
                         default=False,
                         help="global L2 grad norm as an on-device step "
@@ -252,6 +259,7 @@ def build_config(args: argparse.Namespace):
         observability=ObservabilityConfig(
             flight_recorder=args.flight_recorder,
             dump_dir=args.flight_dir,
+            metrics_port=args.metrics_port,
             grad_norm=args.grad_norm_metric or args.anomaly_detection,
             anomaly_detection=args.anomaly_detection,
             anomaly_action=args.anomaly_action,

@@ -68,7 +68,7 @@ def add_argument() -> argparse.Namespace:
                         help="uniform label smoothing for the train CE")
     parser.add_argument("--remat", action="store_true", default=False,
                         help="activation checkpointing per block (fit "
-                             "bigger batches; ~30% extra backward FLOPs)")
+                             "bigger batches; ~30%% extra backward FLOPs)")
 
     # -- optimizer overrides (None = keep the plugin preset) ----------------
     parser.add_argument("--optimizer", type=str, default=None,
@@ -169,6 +169,12 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--trace-dir", type=str, default=None,
                         help="trace output directory (default: "
                              "<flight dir>/trace)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        help="live telemetry plane: /metrics (Prometheus "
+                             "text), /healthz and /vars served from a "
+                             "background thread on this port while the "
+                             "run is alive (loopback; 0 = ephemeral; "
+                             "master process only)")
     parser.add_argument("--grad-norm-metric", action="store_true",
                         default=False,
                         help="global L2 grad norm as an on-device metric")
@@ -314,6 +320,7 @@ def build_config(args: argparse.Namespace):
         observability=ObservabilityConfig(
             flight_recorder=args.flight_recorder,
             dump_dir=args.flight_dir,
+            metrics_port=args.metrics_port,
             grad_norm=args.grad_norm_metric or args.anomaly_detection,
             anomaly_detection=args.anomaly_detection,
             anomaly_action=args.anomaly_action,

@@ -1,0 +1,158 @@
+"""Bench regression gate tests (tools/bench_compare.py).
+
+The gate's contract: 0 = no regression, 1 = regression (direction-aware
+per metric), 2 = malformed input — and it must ingest every bench
+format the repo emits (serve_bench SLA line, bench.py JSON lines among
+human log lines, the driver's BENCH wrapper object).
+"""
+
+import json
+
+import pytest
+
+from conftest import load_cli_module
+
+SLA = {
+    "metric_absent": "ignored",
+    "throughput_tok_s": 1000.0,
+    "ttft_p95_ms": 20.0,
+    "tpot_p95_ms": 2.0,
+    "requests_finished": 8,
+    "tokens_emitted": 64,
+    "kv_reserved_vs_written": 4.0,
+}
+
+
+@pytest.fixture(scope="module")
+def bc():
+    return load_cli_module("tools/bench_compare.py")
+
+
+def _write(tmp_path, name, obj):
+    path = tmp_path / name
+    path.write_text(obj if isinstance(obj, str) else json.dumps(obj))
+    return str(path)
+
+
+class TestVerdicts:
+    def test_identical_files_pass(self, bc, tmp_path, capsys):
+        p = _write(tmp_path, "base.json", SLA)
+        assert bc.main([p, p]) == 0
+        assert "REGRESSION" not in capsys.readouterr().out
+
+    def test_throughput_drop_fails_direction_higher(self, bc, tmp_path):
+        cur = dict(SLA, throughput_tok_s=400.0)  # -60% < 50% allowance
+        assert bc.main([_write(tmp_path, "b.json", SLA),
+                        _write(tmp_path, "c.json", cur)]) == 1
+
+    def test_latency_growth_fails_direction_lower(self, bc, tmp_path):
+        cur = dict(SLA, ttft_p95_ms=100.0)  # 5x > the 3.0 allowance
+        assert bc.main([_write(tmp_path, "b.json", SLA),
+                        _write(tmp_path, "c.json", cur)]) == 1
+
+    def test_latency_improvement_never_fails(self, bc, tmp_path):
+        cur = dict(SLA, ttft_p95_ms=0.1, throughput_tok_s=9999.0)
+        assert bc.main([_write(tmp_path, "b.json", SLA),
+                        _write(tmp_path, "c.json", cur)]) == 0
+
+    def test_dropped_request_fails_zero_tolerance(self, bc, tmp_path):
+        cur = dict(SLA, requests_finished=7)
+        assert bc.main([_write(tmp_path, "b.json", SLA),
+                        _write(tmp_path, "c.json", cur)]) == 1
+
+    def test_metric_override_and_only(self, bc, tmp_path):
+        cur = dict(SLA, throughput_tok_s=400.0, ttft_p95_ms=100.0)
+        b = _write(tmp_path, "b.json", SLA)
+        c = _write(tmp_path, "c.json", cur)
+        # Loosen throughput, gate only it: the latency cliff is ignored.
+        assert bc.main([b, c, "--metric", "throughput_tok_s=0.9",
+                        "--only", "throughput_tok_s"]) == 0
+        # Tighten it instead: now it trips.
+        assert bc.main([b, c, "--metric", "throughput_tok_s=0.1",
+                        "--only", "throughput_tok_s"]) == 1
+
+    def test_both_direction_gates_deterministic_counters_two_sided(
+            self, bc, tmp_path):
+        """kv accounting is workload-deterministic: drift in EITHER
+        direction is breakage — an inflated written count (ratio down)
+        must trip the gate just like over-reservation growth (up)."""
+        b = _write(tmp_path, "b.json", SLA)
+        down = dict(SLA, kv_reserved_vs_written=2.0)  # written inflated
+        up = dict(SLA, kv_reserved_vs_written=8.0)
+        assert bc.main([b, _write(tmp_path, "d.json", down),
+                        "--only", "kv_reserved_vs_written"]) == 1
+        assert bc.main([b, _write(tmp_path, "u.json", up),
+                        "--only", "kv_reserved_vs_written"]) == 1
+        same = dict(SLA, kv_reserved_vs_written=4.01)  # within 5%
+        assert bc.main([b, _write(tmp_path, "s.json", same),
+                        "--only", "kv_reserved_vs_written"]) == 0
+
+    def test_metric_missing_from_current_fails(self, bc, tmp_path):
+        cur = {k: v for k, v in SLA.items() if k != "throughput_tok_s"}
+        assert bc.main([_write(tmp_path, "b.json", SLA),
+                        _write(tmp_path, "c.json", cur)]) == 1
+
+    def test_zero_baseline_skipped_not_failed(self, bc, tmp_path):
+        base = dict(SLA, ttft_p95_ms=0.0)
+        cur = dict(SLA, ttft_p95_ms=50.0)
+        assert bc.main([_write(tmp_path, "b.json", base),
+                        _write(tmp_path, "c.json", cur)]) == 0
+
+    def test_json_output_machine_readable(self, bc, tmp_path, capsys):
+        cur = dict(SLA, throughput_tok_s=1.0)
+        rc = bc.main([_write(tmp_path, "b.json", SLA),
+                      _write(tmp_path, "c.json", cur), "--json"])
+        assert rc == 1
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["regressed"] is True
+        verdicts = {v["metric"]: v["status"]
+                    for v in out["records"][0]["comparisons"]}
+        assert verdicts["throughput_tok_s"] == "REGRESSION"
+        assert verdicts["ttft_p95_ms"] == "ok"
+
+
+class TestInputFormats:
+    def test_bench_wrapper_parsed_object(self, bc, tmp_path):
+        """The driver's BENCH_rXX wrapper: compare the 'parsed' record."""
+        wrap = {"n": 5, "cmd": "python bench.py", "rc": 0,
+                "parsed": {"metric": "resnet50 throughput",
+                           "value": 2581.4, "unit": "images/sec/chip"}}
+        worse = {"parsed": {"metric": "resnet50 throughput",
+                            "value": 1000.0, "unit": "images/sec/chip"}}
+        b = _write(tmp_path, "b.json", wrap)
+        assert bc.main([b, b]) == 0
+        assert bc.main([b, _write(tmp_path, "c.json", worse)]) == 1
+
+    def test_json_lines_matched_by_metric_name(self, bc, tmp_path):
+        """bench.py emits image + LM lines among human log lines;
+        records pair by their 'metric' field, not position."""
+        base = ("[bench] warm-up done\n"
+                + json.dumps({"metric": "image", "value": 100.0}) + "\n"
+                + json.dumps({"metric": "lm", "value": 50.0}) + "\n")
+        cur = (json.dumps({"metric": "lm", "value": 49.0}) + "\n"
+               + json.dumps({"metric": "image", "value": 10.0}) + "\n")
+        rc = bc.main([_write(tmp_path, "b.json", base),
+                      _write(tmp_path, "c.json", cur), "--json"])
+        assert rc == 1
+        # swapped order still matched right: 'lm' ok, 'image' regressed
+
+    def test_malformed_inputs_exit_2(self, bc, tmp_path, capsys):
+        good = _write(tmp_path, "good.json", SLA)
+        assert bc.main([good, str(tmp_path / "missing.json")]) == 2
+        assert bc.main([good,
+                        _write(tmp_path, "junk.json", "not json\n")]) == 2
+        assert bc.main([good, good, "--metric", "nonsense"]) == 2
+        assert bc.main([good, good, "--only", "no_such_metric"]) == 2
+        err = capsys.readouterr().err
+        assert "bench_compare: error:" in err
+
+    def test_real_committed_baseline_loads(self, bc):
+        """The committed CI baseline stays parseable and self-compares
+        clean — a drift here means the gate step is broken."""
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(repo, "profiles", "serve_smoke_baseline.json")
+        recs = bc.load_records(path)
+        assert recs and recs[0]["requests_finished"] == 8
+        assert bc.main([path, path]) == 0

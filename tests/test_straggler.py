@@ -47,6 +47,54 @@ class TestFixedHistogram:
         assert h.counts == [1, 0, 1]
         assert h.quantile(0.99) == 2.0  # +Inf reports the last bound
 
+    def test_empty_histogram_quantiles_are_zero_no_div(self):
+        """Audit pin: an empty histogram's quantile must be 0.0 at every
+        q — not a ZeroDivisionError from the rank/count interpolation."""
+        h = FixedHistogram(bounds=(1.0, 2.0))
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert h.quantile(q) == 0.0
+        with pytest.raises(ValueError, match="must be in"):
+            h.quantile(1.5)
+        with pytest.raises(ValueError, match="must be in"):
+            h.quantile(-0.1)
+
+    def test_all_mass_in_inf_bucket_clamps_to_last_bound(self):
+        """Audit pin: quantiles landing in the +Inf bucket clamp to the
+        last FINITE bound (there is no upper edge to interpolate
+        toward) — at every q, not just the tail."""
+        h = FixedHistogram(bounds=(1.0, 2.0, 4.0))
+        for _ in range(5):
+            h.observe(1e9)
+        assert h.counts == [0, 0, 0, 5]
+        for q in (0.01, 0.25, 0.5, 0.99, 1.0):
+            assert h.quantile(q) == 4.0
+
+    def test_bucket_boundary_interpolation_exact(self):
+        """Audit pin: interpolation endpoints at bucket boundaries —
+        rank == bucket's full cumulative mass gives the bucket's UPPER
+        edge, half the mass gives the midpoint, and the first bucket
+        interpolates up from 0 (latencies have no negative edge)."""
+        h = FixedHistogram(bounds=(1.0, 2.0))
+        for _ in range(4):
+            h.observe(1.5)  # all mass in bucket (1, 2]
+        assert h.quantile(1.0) == 2.0
+        assert h.quantile(0.5) == 1.5
+        assert h.quantile(0.25) == 1.25
+        first = FixedHistogram(bounds=(10.0,))
+        first.observe(5.0)
+        assert first.quantile(0.5) == 5.0  # 0 → 10 edge, half rank
+        assert first.quantile(1.0) == 10.0
+
+    def test_quantile_skips_empty_leading_buckets(self):
+        """Audit pin: a tiny q with empty leading buckets lands at the
+        first OCCUPIED bucket's lower edge — interpolation never places
+        mass in a zero-count bucket."""
+        h = FixedHistogram(bounds=(1.0, 2.0, 4.0, 8.0))
+        h.observe(3.0)  # only bucket (2, 4] occupied
+        assert h.quantile(0.0) == 2.0
+        assert h.quantile(0.001) > 2.0
+        assert h.quantile(1.0) == 4.0
+
     def test_merge_and_round_trip(self):
         a, b = FixedHistogram(), FixedHistogram()
         for v in (3.0, 30.0):

@@ -27,6 +27,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from distributed_training_tpu.observability.flight_recorder import (  # noqa: E402
     FlightRecorder,
 )
+from distributed_training_tpu.observability.prometheus import (  # noqa: E402
+    prometheus_lines,
+)
 
 
 def _fmt_bytes(n: float) -> str:
@@ -123,6 +126,20 @@ def render(summary: dict) -> str:
             f"tpot p50 {srv['tpot_p50_ms']:.2f} ms  "
             f"p95 {srv['tpot_p95_ms']:.2f} ms  |  "
             f"queue depth max {srv['queue_depth_max']}")
+        # KV/slot utilization (serving/metrics.py): the measured
+        # max_len over-reservation + admission-latency breakdown.
+        if srv.get("kv_written_tokens"):
+            add(f"    kv util: written {srv['kv_written_tokens']:.0f} / "
+                f"reserved {srv['kv_reserved_tokens']:.0f} token-iters  "
+                f"(over-reservation x{srv['kv_reserved_vs_written']:.2f})"
+                f"  |  slot occupancy {srv['slot_occupancy_mean']:.1%}")
+        if srv.get("requests_finished") and "queue_wait_p50_ms" in srv:
+            add(f"    admission: queue wait p50 "
+                f"{srv['queue_wait_p50_ms']:.1f} / p95 "
+                f"{srv['queue_wait_p95_ms']:.1f} ms  |  prefill p50 "
+                f"{srv['prefill_p50_ms']:.1f} / p95 "
+                f"{srv['prefill_p95_ms']:.1f} ms  |  blocked "
+                f"{srv.get('admission_blocked_s', 0.0):.2f}s")
         degraded = {k: srv.get(k, 0) for k in (
             "requests_timed_out", "requests_shed",
             "requests_drain_rejected")}
@@ -168,80 +185,6 @@ def render(summary: dict) -> str:
     else:
         add("  anomalies: none")
     return "\n".join(lines)
-
-
-def _prom_hist(lines: list, name: str, hist: dict, help_text: str) -> None:
-    """One Prometheus histogram family from a FixedHistogram dict."""
-    lines.append(f"# HELP {name} {help_text}")
-    lines.append(f"# TYPE {name} histogram")
-    acc = 0
-    bounds = list(hist["bounds"]) + ["+Inf"]
-    for bound, count in zip(bounds, hist["counts"]):
-        acc += count
-        le = bound if isinstance(bound, str) else f"{bound:g}"
-        lines.append(f'{name}_bucket{{le="{le}"}} {acc}')
-    lines.append(f"{name}_sum {hist['sum']:g}")
-    lines.append(f"{name}_count {hist['count']}")
-
-
-def _prom_gauge(lines: list, name: str, value, help_text: str = "",
-                labels: str = "") -> None:
-    if not isinstance(value, (int, float)) or isinstance(value, bool):
-        return  # non-finite metrics arrive as strings; a scrape skips them
-    if help_text:
-        lines.append(f"# HELP {name} {help_text}")
-        lines.append(f"# TYPE {name} gauge")
-    lines.append(f"{name}{labels} {value:g}")
-
-
-def prometheus_lines(snap: dict) -> list:
-    """The dump as Prometheus text exposition — the bridge from flight
-    forensics to a scraper: ``flight_report.py --prometheus dump.json``
-    can feed a node_exporter textfile collector or a push gateway."""
-    lines: list = []
-    _prom_gauge(lines, "flight_steps_recorded_total",
-                snap.get("steps_recorded_total", 0),
-                "Steps recorded over the run")
-    for k, v in (snap.get("step_time_stats") or {}).items():
-        _prom_gauge(lines, f"flight_{k}", v, "Ring-window step time")
-    wc = snap.get("wall_clock") or {}
-    if wc:
-        _prom_gauge(lines, "flight_goodput", wc.get("goodput"),
-                    "Step share of tracked wall-time")
-        phases = wc.get("phase_seconds") or {}
-        if phases:
-            lines.append("# HELP flight_phase_seconds Wall-clock phase "
-                         "totals")
-            lines.append("# TYPE flight_phase_seconds gauge")
-            for ph, v in sorted(phases.items()):
-                _prom_gauge(lines, "flight_phase_seconds", v,
-                            labels=f'{{phase="{ph}"}}')
-    for name, hist in (snap.get("histograms") or {}).items():
-        _prom_hist(lines, f"flight_{name}", hist,
-                   "Fixed-bucket run-lifetime histogram")
-    srv = snap.get("serving") or {}
-    for k, v in srv.items():
-        if k == "histograms":
-            continue
-        _prom_gauge(lines, f"serving_{k}", v, "Serving SLA summary field")
-    for name, hist in (srv.get("histograms") or {}).items():
-        _prom_hist(lines, f"serving_{name}", hist,
-                   "Fixed-bucket serving latency histogram")
-    hosts = snap.get("hosts") or {}
-    strag = hosts.get("straggler")
-    if strag:
-        _prom_gauge(lines, "flight_straggler_host", strag["host"],
-                    "Attributed straggler process index")
-        _prom_gauge(lines, "flight_straggler_step", strag["step"],
-                    "Attributed straggler step")
-        _prom_gauge(lines, "flight_straggler_excess_ms",
-                    strag["excess_ms"], "Straggler excess over baseline")
-    res = snap.get("resilience") or {}
-    for k in ("saves_committed", "saves_failed", "io_retries"):
-        if k in res:
-            _prom_gauge(lines, f"resilience_{k}", res[k],
-                        "Resilience counter")
-    return lines
 
 
 def main(argv=None) -> int:

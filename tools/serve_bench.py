@@ -61,6 +61,10 @@ def add_argument() -> argparse.Namespace:
                    help="skip the compile warm-up pass (its compile time "
                         "then lands in the measured TTFT tail)")
     p.add_argument("--flight-dump", type=str, default=None)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="live telemetry plane: /metrics (Prometheus "
+                        "text), /healthz and /vars scrapeable while the "
+                        "bench runs (loopback; 0 = ephemeral port)")
     p.add_argument("--trace", action=argparse.BooleanOptionalAction,
                    default=False,
                    help="span-level Perfetto trace of the measured "
@@ -113,6 +117,18 @@ def main() -> int:
         max_new_tokens=args.max_new_tokens,
         temperature=args.temperature, eos_id=args.eos_id,
         prefill_bucket=args.prefill_bucket, seed=args.seed), trace=trace)
+
+    # Live telemetry plane: the measured window is scrapeable while it
+    # runs.
+    exporter = None
+    if args.metrics_port is not None:
+        from distributed_training_tpu.observability.exporter import (
+            attach_engine,
+        )
+
+        exporter = attach_engine(
+            engine, args.metrics_port, component="serve_bench",
+            printer=lambda msg: print(msg, file=sys.stderr, flush=True))
 
     rng = np.random.RandomState(args.seed)
 
@@ -176,6 +192,8 @@ def main() -> int:
         trace.save(trace_path)
         print(f"[serve_bench] trace: {trace_path} ({len(trace)} events)",
               file=sys.stderr)
+    if exporter is not None:
+        exporter.close()
     print(json.dumps(stats, allow_nan=False))
     return 0
 
