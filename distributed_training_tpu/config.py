@@ -439,10 +439,32 @@ class ServeConfig:
     top_p: float | None = None
     eos_id: int | None = None
     pad_id: int = 0
-    # Prompts pad up to a multiple of this for prefill, so the engine
-    # compiles at most max_len/prefill_bucket prefill programs instead of
-    # one per distinct prompt length. Pad K/V writes are zeroed and the
-    # write head rewound to the true length, so padding never changes a
+    # Paged KV cache (vLLM-style block tables; docs/SERVING.md "Paged KV
+    # cache"). KV memory is a fixed pool of kv_page_size-token pages and
+    # each slot holds a static-shape page table; pages allocate on
+    # demand as the write head advances, so a request only ever holds
+    # ceil(written/kv_page_size) pages instead of the full max_len
+    # budget. None → the legacy contiguous per-slot reservation (and the
+    # legacy bucketed batch-1 prefill below). Trade-off: smaller pages
+    # track the write head tighter (reserved/written → 1) but mean more
+    # table entries and a finer-grained gather; larger pages amortize
+    # both at the cost of tail-page waste ~ page_size/2 per sequence.
+    kv_page_size: int | None = 8
+    # Pool size in pages. None → max_batch × ceil(budget/kv_page_size)
+    # (exactly the legacy capacity, no oversubscription); smaller values
+    # oversubscribe — admission then gates on committed pages, so a
+    # burst of long requests queues instead of overflowing.
+    kv_pages: int | None = None
+    # Chunked prefill (Sarathi-style; paged mode only): prompts prefill
+    # in fixed-size chunks that ride along with decode iterations in ONE
+    # fused compiled step, so admission never serializes ahead of
+    # decode. One chunk (oldest prefilling request first) per iteration.
+    prefill_chunk: int = 64
+    # LEGACY prefill path (kv_page_size=None): prompts pad up to a
+    # multiple of this for batch-1 prefill, so the engine compiles at
+    # most max_len/prefill_bucket prefill programs instead of one per
+    # distinct prompt length. Pad K/V writes are zeroed and the write
+    # head rewound to the true length, so padding never changes a
     # single emitted token (pinned by tests/test_serving.py).
     prefill_bucket: int = 64
     # SLA telemetry: flight-recorder ring size (one entry per decode
@@ -479,6 +501,21 @@ class ServeConfig:
         if self.prefill_bucket < 1:
             raise ValueError(
                 f"prefill_bucket must be >= 1, got {self.prefill_bucket}")
+        if self.kv_page_size is not None and self.kv_page_size < 1:
+            raise ValueError(
+                f"kv_page_size must be >= 1 (or None for the legacy "
+                f"contiguous cache), got {self.kv_page_size}")
+        if self.kv_pages is not None:
+            if self.kv_page_size is None:
+                raise ValueError(
+                    "kv_pages requires kv_page_size (the legacy "
+                    "contiguous cache has no page pool)")
+            if self.kv_pages < 1:
+                raise ValueError(
+                    f"kv_pages must be >= 1, got {self.kv_pages}")
+        if self.prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
         if self.flush_every < 1:
             raise ValueError(
                 f"flush_every must be >= 1, got {self.flush_every}")

@@ -71,15 +71,19 @@ class DecoderBlock(nn.Module):
     moe_mlp_type: str = "standard"
     moe_expert_axis: str | None = None
     cache_len: int | None = None
+    kv_page_size: int | None = None
+    kv_pages: int | None = None
 
     @nn.compact
-    def __call__(self, x, train: bool = False, decode: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False,
+                 pages=None):
         y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
         y = RingSelfAttention(
             num_heads=self.num_heads, dtype=self.dtype,
             axis_name=self.seq_axis, causal=True,
             attn_impl=self.attn_impl, cache_len=self.cache_len,
-            name="attn")(y, decode=decode)
+            kv_page_size=self.kv_page_size, kv_pages=self.kv_pages,
+            name="attn")(y, decode=decode, pages=pages)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
         x = x + y
@@ -203,6 +207,15 @@ class TransformerLM(nn.Module):
     # Generator sets prompt + max_new_tokens) shrink the scan carry and the
     # per-step attention width without touching params.
     cache_len: int | None = None
+    # Paged KV cache (serving engine, parallel/ring_attention.py): the
+    # decode cache becomes a shared pool of kv_pages fixed-size pages
+    # (kv_page_size tokens each, physical page 0 reserved as the null
+    # page) and decode calls must pass ``pages`` (a PagedKV of page
+    # tables / write positions / validity). None → the contiguous
+    # per-sequence cache the Generator uses. Config-only like cache_len:
+    # params are identical either way.
+    kv_page_size: int | None = None
+    kv_pages: int | None = None
     # Rematerialize each decoder block in the backward pass (activation
     # checkpointing: O(depth) activation memory for ~30% extra FLOPs).
     # Ignored in decode mode (no backward). The pipeline executor honors
@@ -211,7 +224,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, train: bool = False,
-                 decode: bool = False, return_hidden: bool = False):
+                 decode: bool = False, return_hidden: bool = False,
+                 pages=None):
         """``decode=True`` runs the cached autoregressive path: every block
         appends K/V for this call's tokens to its ``cache`` collection
         (length ``cache_len``, default ``max_len``) and attends against the
@@ -278,7 +292,9 @@ class TransformerLM(nn.Module):
                 moe_mlp_type=self.moe_mlp_type,
                 moe_expert_axis=self.moe_expert_axis,
                 cache_len=self.cache_len or self.max_len,
-                name=f"block{i}")(x, train, decode)
+                kv_page_size=self.kv_page_size,
+                kv_pages=self.kv_pages,
+                name=f"block{i}")(x, train, decode, pages)
         x = make_final_norm(self, name="ln_f")(x)
         if return_hidden:
             return x
@@ -290,20 +306,40 @@ def init_decode_cache(model: "TransformerLM", params: Any,
     """Empty KV-cache pytree for ``decode=True`` without running a forward.
 
     ``jax.eval_shape`` traces a one-token decode apply (no FLOPs, no
-    allocation) to learn the cache structure — per block:
+    allocation) to learn the cache structure, then materializes zeros.
+
+    Contiguous layout (``kv_page_size=None``): per block,
     ``cached_key``/``cached_value`` [B, cache_len, H, hd] plus the scalar
-    ``cache_index`` write head — then materializes zeros. A zero cache with
-    index 0 is exactly the state a prefill starts from, so the serving
-    engine (``serving/engine.py``) stacks one of these per decode slot and
-    scatters freshly-prefilled caches into freed slots without ever
-    tracing a throwaway forward.
+    ``cache_index`` write head. A zero cache with index 0 is exactly the
+    state a prefill starts from, so the legacy serving path stacks one of
+    these per decode slot and scatters freshly-prefilled caches into
+    freed slots without ever tracing a throwaway forward.
+
+    Paged layout (``kv_page_size`` set): per block, the batch-free flat
+    pools ``key_pages``/``value_pages`` [kv_pages * kv_page_size, H, hd]
+    shared by every decode slot — routing state (page tables, write
+    positions) is per-call :class:`~distributed_training_tpu.parallel.
+    ring_attention.PagedKV` input, not cache state, so the same pool
+    pytree serves both the [max_batch, 1] decode batch and the
+    [1, prefill_chunk] chunk inside the engine's fused step.
     """
+    paged = getattr(model, "kv_page_size", None) is not None
 
     def shape_fn(p):
         toks = jnp.zeros((batch_size, 1), jnp.int32)
+        pages = None
+        if paged:
+            from distributed_training_tpu.parallel.ring_attention import (
+                PagedKV,
+            )
+
+            pages = PagedKV(
+                table=jnp.zeros((batch_size, 1), jnp.int32),
+                positions=jnp.zeros_like(toks),
+                valid=jnp.zeros(toks.shape, bool))
         _, vars_out = model.apply(
             {"params": p}, toks, positions=jnp.zeros_like(toks),
-            train=False, decode=True, mutable=["cache"])
+            train=False, decode=True, mutable=["cache"], pages=pages)
         return vars_out["cache"]
 
     shapes = jax.eval_shape(shape_fn, params)

@@ -18,7 +18,7 @@ which is what the correctness tests compare against full attention.
 from __future__ import annotations
 
 import functools
-from typing import Any
+from typing import Any, NamedTuple
 
 import flax.linen as nn
 import jax
@@ -26,6 +26,33 @@ import jax.numpy as jnp
 from jax import lax
 
 from distributed_training_tpu.utils.compat import axis_size as _axis_size
+
+
+class PagedKV(NamedTuple):
+    """Per-call paged-KV routing state (a pytree of device arrays).
+
+    The serving engine passes one of these through ``model.apply`` when
+    the KV cache is the paged pool (``kv_page_size`` set): the cache
+    collection then holds only the position-free page pool, while WHICH
+    pool rows a batch row reads/writes travels here — so a decode batch
+    of ``max_batch`` slots and a ``[1, chunk]`` prefill chunk can share
+    one pool inside one compiled step despite different batch shapes.
+
+    - ``table`` int32 [B, pages_per_slot]: each row's logical→physical
+      page map. Unallocated logical pages point at physical page 0, the
+      reserved null page (never handed out by the allocator) — reads of
+      it are causally masked, writes to it are discarded garbage.
+    - ``positions`` int32 [B, T_in]: each incoming token's global write
+      position (the engine's host-side write heads; the legacy path's
+      ``cache_index`` counter, externalized).
+    - ``valid`` bool [B, T_in]: tokens that really exist. Invalid lanes
+      (inactive decode slots, chunk padding) write to the null page and
+      their outputs are discarded host-side — masks, never shapes.
+    """
+
+    table: jnp.ndarray
+    positions: jnp.ndarray
+    valid: jnp.ndarray
 
 
 def _online_block_update(o, m, l, s, v):
@@ -295,6 +322,12 @@ class RingSelfAttention(nn.Module):
     causal: bool = False
     attn_impl: str = "exact"  # exact | flash
     cache_len: int | None = None  # KV-cache length for decode=True
+    # Paged KV cache (serving engine): the cache collection becomes a
+    # position-free pool of kv_pages pages × kv_page_size tokens
+    # (physical page 0 reserved as the null page) and decode calls route
+    # through the :class:`PagedKV` page tables instead of cache_index.
+    kv_page_size: int | None = None
+    kv_pages: int | None = None  # physical pages INCLUDING the null page
 
     def _decode_attend(self, q, k, v, head_dim: int):
         """Cached-KV attention: write K/V at ``cache_index``, attend q
@@ -337,8 +370,74 @@ class RingSelfAttention(nn.Module):
         out = jnp.einsum("...qk,...kd->...qd", p, vh)
         return jnp.swapaxes(out, -3, -2)  # back to [B, T, H, hd]
 
+    def _paged_decode_attend(self, q, k, v, head_dim: int, pages: PagedKV):
+        """Paged-pool cached-KV attention (serving engine's decode path).
+
+        Shapes: q/k/v [B, T_in, H, hd]; the cache collection holds one
+        flat pool per K and V — [kv_pages * kv_page_size, H, hd], page 0
+        being the reserved null page. Each incoming token scatters its
+        K/V at ``table[b, pos // ps] * ps + pos % ps`` (null page when
+        ``valid`` is False), then every query row gathers its OWN row's
+        page table back into a contiguous-looking [L, H, hd] view
+        (L = pages_per_slot × ps) and attends with the same global-
+        position causal mask the contiguous path uses. Row arithmetic is
+        identical to :meth:`_decode_attend` — gathered entries for
+        written positions ARE the contiguous cache values, and everything
+        past the query position (unwritten pages, stale freed pages, the
+        null page) is masked to -inf exactly like the contiguous tail —
+        so greedy outputs stay token-identical to the sequential
+        ``Generator`` (pinned by tests/test_serving.py).
+        """
+        b, t_in = q.shape[0], q.shape[1]
+        if self.kv_pages is None:
+            raise ValueError("paged decode requires kv_pages (pool size)")
+        ps = int(self.kv_page_size)
+        pool_rows = int(self.kv_pages) * ps
+        shape = (pool_rows, self.num_heads, head_dim)
+        ck = self.variable("cache", "key_pages", jnp.zeros, shape, k.dtype)
+        cv = self.variable("cache", "value_pages", jnp.zeros, shape, v.dtype)
+        table, positions, valid = pages
+        # Physical write rows; invalid tokens land in the null page
+        # (row < ps), where duplicate scatters are harmless garbage.
+        logical = positions // ps
+        phys = jnp.take_along_axis(table, logical, axis=1) * ps \
+            + positions % ps
+        write_idx = jnp.where(valid, phys, 0).reshape(-1)
+        k_all = ck.value.at[write_idx].set(k.reshape(b * t_in, -1, head_dim))
+        v_all = cv.value.at[write_idx].set(v.reshape(b * t_in, -1, head_dim))
+        if not self.is_initializing():
+            ck.value, cv.value = k_all, v_all
+
+        # Static-shape gather: row b reads its table's pages in logical
+        # order — positions 0..L-1 exactly as the contiguous cache lays
+        # them out (unallocated logical pages read the null page; the
+        # causal mask below hides them along with the future).
+        l_all = table.shape[1] * ps
+        gather_idx = (table[:, :, None] * ps
+                      + jnp.arange(ps)[None, None, :]).reshape(b, l_all)
+        kg = k_all[gather_idx]  # [B, L, H, hd]
+        vg = v_all[gather_idx]
+        qh = jnp.swapaxes(q, -3, -2)               # [B, H, T_in, hd]
+        kh, vh = (jnp.swapaxes(t, -3, -2) for t in (kg, vg))
+        scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
+        s = jnp.einsum("...qd,...kd->...qk", qh.astype(jnp.float32),
+                       kh.astype(jnp.float32)) * scale
+        qpos = positions                            # [B, T_in]
+        kpos = jnp.arange(l_all)
+        s = jnp.where(kpos[None, None, None, :] > qpos[:, None, :, None],
+                      -jnp.inf, s)
+        # Per-ROW overflow poison (the contiguous path's guard, scoped to
+        # the offending query so a padded chunk row can't poison real
+        # ones): a write position past the page table corrupts whatever
+        # page the clamped table gather aliased, so that row is wrong.
+        s = jnp.where((qpos >= l_all)[:, None, :, None], jnp.nan, s)
+        p = jax.nn.softmax(s, axis=-1).astype(vh.dtype)
+        out = jnp.einsum("...qk,...kd->...qd", p, vh)
+        return jnp.swapaxes(out, -3, -2)  # back to [B, T, H, hd]
+
     @nn.compact
-    def __call__(self, x, deterministic: bool = True, decode: bool = False):
+    def __call__(self, x, deterministic: bool = True, decode: bool = False,
+                 pages: PagedKV | None = None):
         d = x.shape[-1]
         if d % self.num_heads:
             raise ValueError(f"hidden {d} not divisible by {self.num_heads} heads")
@@ -359,7 +458,15 @@ class RingSelfAttention(nn.Module):
             # The KV-cache keeps its [B, cache_len, H, hd] layout (decode is
             # latency-, not layout-bound; T is 1 per step).
             qd, kd, vd = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-            out = self._decode_attend(qd, kd, vd, head_dim)  # [B, T, H, hd]
+            if pages is not None:
+                if self.kv_page_size is None:
+                    raise ValueError(
+                        "pages= passed but kv_page_size is unset; build "
+                        "the model with kv_page_size/kv_pages for the "
+                        "paged decode path")
+                out = self._paged_decode_attend(qd, kd, vd, head_dim, pages)
+            else:
+                out = self._decode_attend(qd, kd, vd, head_dim)
             out = jnp.swapaxes(out, 1, 2)  # [B, H, T, hd]
         else:
             # model.init traces this module outside shard_map where the mesh

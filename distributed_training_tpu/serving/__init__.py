@@ -6,11 +6,18 @@ iteration-level batching + vLLM-style fixed-slot cache management,
 restated for XLA's static-shape world:
 
 - :mod:`queue` — thread-safe arrival-ordered admission with a per-request
-  cache-budget guard (typed rejection, not a wedged queue head).
-- :mod:`scheduler` — fixed decode slots; FIFO refill and EOS/length
-  eviction at iteration boundaries; active masks instead of shape changes.
-- :mod:`engine` — the compiled prefill/scatter/decode trio over a
-  slot-axis KV-cache pytree, and the admit→prefill→decode→evict loop.
+  cache-budget guard in page-based accounting (typed rejection, not a
+  wedged queue head).
+- :mod:`pages` — the fixed-size KV page pool (PagedAttention's memory
+  model, host half): free-list allocator with commitment-based
+  admission safety; physical page 0 reserved as the device null page.
+- :mod:`scheduler` — fixed decode slots; FIFO refill (page-aware via a
+  ``can_seat`` gate) and EOS/length eviction at iteration boundaries;
+  active masks instead of shape changes.
+- :mod:`engine` — paged KV + chunked prefill by default (a fused
+  prefill-chunk+decode step and a decode-only step over one shared page
+  pool), the legacy contiguous slot-axis trio behind
+  ``kv_page_size=None``, and the admit→prefill→decode→evict loop.
 - :mod:`metrics` — TTFT/TPOT/throughput/queue-depth SLA telemetry through
   the round-7 flight recorder, plus KV/slot utilization accounting
   (reserved-vs-written cache positions, queue-wait vs prefill breakdown,
@@ -27,6 +34,11 @@ from distributed_training_tpu.resilience.errors import (  # noqa: F401
 )
 from distributed_training_tpu.serving.engine import Engine  # noqa: F401
 from distributed_training_tpu.serving.metrics import ServeTelemetry  # noqa: F401
+from distributed_training_tpu.serving.pages import (  # noqa: F401
+    NULL_PAGE,
+    PagePool,
+    pages_for,
+)
 from distributed_training_tpu.serving.queue import RequestQueue  # noqa: F401
 from distributed_training_tpu.serving.request import (  # noqa: F401
     FINISH_EOS,

@@ -3,39 +3,53 @@
 The training stack's decode loop (``inference/sampler.py``) compiles one
 ``generate`` program per prompt: great latency for one user, zero
 batching across users. This engine turns the same
-``RingSelfAttention._decode_attend`` KV cache into a multi-tenant server
-with THREE compiled programs total (one bucketed prefill family, one
-slot scatter, one decode step), all static-shape:
+``RingSelfAttention`` KV cache into a multi-tenant server. Two cache
+managements exist, selected by ``ServeConfig.kv_page_size``:
 
-- **Slot-axis cache.** The per-sequence cache pytree (per block:
-  ``cached_key``/``cached_value`` [1, cache_len, H, hd] + scalar
-  ``cache_index``) gains a leading slot axis via
-  ``models/gpt.py::init_decode_cache`` + stacking: leaves become
-  [max_batch, 1, cache_len, H, hd] and the write heads [max_batch]. The
-  decode step ``jax.vmap``s the model's single-sequence decode over that
-  axis, so every slot keeps its OWN cache length counter — the exact
-  per-slot state continuous batching needs, with zero model changes.
-- **Bucketed prefill.** A request's prompt pads up to a multiple of
-  ``prefill_bucket`` and prefills at batch 1; pad K/V writes are zeroed
-  and the write head rewound to the true length afterwards, so the
-  emitted tokens are untouched by padding (causal masking already kept
-  the real-token logits exact) while the engine compiles at most
-  ``budget / prefill_bucket`` prefill shapes.
+**Paged KV + chunked prefill (default; docs/SERVING.md "Paged KV
+cache").** KV memory is one fixed pool of ``kv_page_size``-token pages
+per layer (PagedAttention's layout); each decode slot holds a
+static-shape page table mapping logical pages → physical pages, pages
+allocate on demand as the write head advances, and admission commits a
+request's worst-case page count instead of the full ``max_len`` budget.
+Prefill is chunked (Sarathi-Serve): the prompt splits into fixed-size
+``prefill_chunk`` pieces that ride along with decode iterations in ONE
+fused compiled step, so admission never serializes ahead of decode.
+Compiled-program inventory: a fused prefill-chunk+decode step and a
+decode-only step — two programs, one shape each, regardless of prompt
+mix (the chunk lane is always ``[1, prefill_chunk]``, padded rows write
+the pool's null page).
+
+**Legacy contiguous slots (``kv_page_size=None``).** The per-sequence
+cache pytree gains a leading slot axis (``[max_batch, 1, cache_len, H,
+hd]``); admission runs one bucketed batch-1 prefill and a slot-scatter,
+and decode ``vmap``s the single-sequence path — three compiled programs
+(bucketed prefill family, scatter, decode), every slot reserving the
+full budget.
+
+Shared discipline either way — masks, never shapes:
+
 - **Iteration-level scheduling.** At each iteration boundary the
-  :class:`SlotScheduler` evicts finished sequences (EOS / length budget)
-  and refills freed slots FIFO from the :class:`RequestQueue`; the
-  decode step then advances every active slot one token. Slot membership
-  is a boolean mask — shapes never change, nothing retraces.
-- **Lane independence = bitwise determinism.** Each vmap lane runs the
-  identical single-sequence program regardless of which other requests
-  share the batch, and sampling RNG is ``fold_in(fold_in(seed, uid),
-  position)`` — a pure function of the request and position. A request's
-  tokens are therefore bitwise independent of batch composition (pinned
-  by ``tests/test_serving.py``).
+  :class:`SlotScheduler` evicts finished sequences (EOS / length budget
+  / deadline) and refills freed slots FIFO from the
+  :class:`RequestQueue` (page-aware in paged mode: the queue head seats
+  only when the pool can commit its worst case). Slot membership is
+  boolean masks and page-table contents — shapes never change, nothing
+  retraces.
+- **Lane independence = bitwise determinism.** A slot's row arithmetic
+  is identical regardless of which other requests share the batch
+  (rows of every position-wise op and of the per-row paged gather are
+  independent), and sampling RNG is ``fold_in(fold_in(seed, uid),
+  position)`` — a pure function of the request and position. A
+  request's tokens are therefore bitwise independent of batch
+  composition AND of the paging/chunking configuration, and greedy
+  decode is token-identical to the sequential ``Generator`` (pinned by
+  ``tests/test_serving.py``).
 
-SLA telemetry (TTFT / TPOT / throughput / queue depth) flows through the
-round-7 flight recorder via :class:`ServeTelemetry`; ``dump_flight``
-writes a ``tools/flight_report.py``-readable record.
+SLA telemetry (TTFT / TPOT / throughput / queue depth / KV-page
+utilization) flows through the round-7 flight recorder via
+:class:`ServeTelemetry`; ``dump_flight`` writes a
+``tools/flight_report.py``-readable record.
 """
 
 from __future__ import annotations
@@ -56,7 +70,9 @@ from distributed_training_tpu.inference.sampler import (
     sample_token,
 )
 from distributed_training_tpu.models.gpt import init_decode_cache
+from distributed_training_tpu.parallel.ring_attention import PagedKV
 from distributed_training_tpu.serving.metrics import ServeTelemetry
+from distributed_training_tpu.serving.pages import PagePool, pages_for
 from distributed_training_tpu.serving.queue import RequestQueue
 from distributed_training_tpu.serving.request import FinishedRequest, Request
 from distributed_training_tpu.serving.scheduler import SlotScheduler
@@ -75,13 +91,13 @@ class Engine:
 
     ``trace`` (an :class:`~distributed_training_tpu.observability.trace.
     TraceSession`, or None = off) draws the engine on a Perfetto
-    timeline: per-iteration prefill/decode spans on an 'engine' track, a
+    timeline: per-iteration decode spans on an 'engine' track, a
     queue-depth counter series, admission marks on a 'queue' track, and
     — the Orca view — one track PER DECODE SLOT carrying each request's
-    queued → prefill → decode lifecycle spans and finish marks. All
-    timestamps come from the same ``perf_counter`` clock as
-    :class:`ServeTelemetry`, so span-derived latencies equal the SLA
-    numbers exactly (pinned by tests/test_trace.py).
+    queued → prefill (per-chunk spans in paged mode) → decode lifecycle
+    and finish marks. All timestamps come from the same ``perf_counter``
+    clock as :class:`ServeTelemetry`, so span-derived latencies equal
+    the SLA numbers exactly (pinned by tests/test_trace.py).
     """
 
     def __init__(self, model: Any, params: Any, cfg: ServeConfig, *,
@@ -94,49 +110,166 @@ class Engine:
             raise ValueError(
                 f"cache budget {self.budget} cannot hold a prompt token "
                 f"plus a generated token")
-        # One clone with the serving cache length; every compiled program
-        # below derives its shapes from it.
-        self.model = model.clone(cache_len=self.budget)
+        self.paged = cfg.kv_page_size is not None
         self.params = params
         self.sample_cfg = SampleConfig(
             max_new_tokens=cfg.max_new_tokens,
             temperature=cfg.temperature, top_k=cfg.top_k, top_p=cfg.top_p,
             eos_id=cfg.eos_id, pad_id=cfg.pad_id)
+
+        s = cfg.max_batch
+        if self.paged:
+            ps = int(cfg.kv_page_size)
+            self.page_size = ps
+            self.pages_per_slot = pages_for(self.budget, ps)
+            self.pool_pages = (int(cfg.kv_pages) if cfg.kv_pages is not None
+                               else s * self.pages_per_slot)
+            self.pool = PagePool(self.pool_pages, ps)
+            # +1 physical page: the device pool keeps page 0 as the null
+            # page (masked writes, unallocated table entries); the
+            # allocator serves ids 1..pool_pages.
+            self.model = model.clone(cache_len=self.budget,
+                                     kv_page_size=ps,
+                                     kv_pages=self.pool_pages + 1)
+            # A chunk wider than the longest admissible prompt is pure
+            # padding compute.
+            self.prefill_chunk = min(int(cfg.prefill_chunk),
+                                     max(self.budget - 1, 1))
+        else:
+            self.page_size = None
+            self.pool = None
+            # One clone with the serving cache length; every compiled
+            # program below derives its shapes from it.
+            self.model = model.clone(cache_len=self.budget)
+
         self.queue = RequestQueue(
             self.budget, default_max_new_tokens=cfg.max_new_tokens,
             max_depth=cfg.max_queue_depth,
             ttft_deadline_ms=cfg.ttft_deadline_ms,
-            deadline_ms=cfg.deadline_ms, trace=trace)
-        self.scheduler = SlotScheduler(cfg.max_batch)
+            deadline_ms=cfg.deadline_ms, trace=trace,
+            page_size=self.page_size,
+            pool_pages=self.pool_pages if self.paged else None)
+        self.scheduler = SlotScheduler(s)
         self._drained = False
         self.telemetry = ServeTelemetry(cfg.ring_size)
         self._base_rng = jax.random.PRNGKey(cfg.seed)
         self._iteration = 0
 
-        # Slot-axis device state. The stacked cache comes from the model's
-        # own structure (init_decode_cache), so scatters from prefill
-        # results are structure-identical by construction.
-        s = cfg.max_batch
-        single = init_decode_cache(self.model, params, batch_size=1)
-        self._cache = jax.tree.map(
-            lambda leaf: jnp.zeros((s,) + leaf.shape, leaf.dtype), single)
-        self._tok = jnp.zeros((s,), jnp.int32)    # last emitted token/slot
-        self._pos = jnp.zeros((s,), jnp.int32)    # cache write head/slot
-        self._rngs = jnp.zeros((s,) + self._base_rng.shape,
-                               self._base_rng.dtype)
-
-        # Donation keeps one slot-cache resident instead of two per decode
+        # Donation keeps one cache resident instead of two per decode
         # step; the CPU backend can't donate (it would only warn noisily).
         donate = jax.default_backend() != "cpu"
-        self._prefill = jax.jit(self._prefill_impl)
-        self._admit = jax.jit(
-            self._admit_impl,
-            donate_argnums=(0, 1, 2, 3) if donate else ())
-        self._decode = jax.jit(
-            self._decode_impl,
-            donate_argnums=(1, 2, 3) if donate else ())
+        if self.paged:
+            # Device state: ONLY the page pool (batch-free). Slot
+            # routing (page tables, write heads, last tokens, RNGs) is
+            # host-side numpy, shipped as tiny step inputs — so page
+            # allocation and slot membership never touch compiled code.
+            self._cache = init_decode_cache(self.model, params,
+                                            batch_size=1)
+            self._tables = np.zeros((s, self.pages_per_slot), np.int32)
+            self._slot_rng = np.zeros(
+                (s,) + self._base_rng.shape,
+                np.asarray(self._base_rng).dtype)
+            self._slot_pages: list[list[int]] = [[] for _ in range(s)]
+            self._slot_commit_left = [0] * s
+            self._fused = jax.jit(
+                self._fused_impl, donate_argnums=(1,) if donate else ())
+            self._decode = jax.jit(
+                self._decode_only_impl,
+                donate_argnums=(1,) if donate else ())
+        else:
+            # Slot-axis device state. The stacked cache comes from the
+            # model's own structure (init_decode_cache), so scatters
+            # from prefill results are structure-identical by
+            # construction.
+            single = init_decode_cache(self.model, params, batch_size=1)
+            self._cache = jax.tree.map(
+                lambda leaf: jnp.zeros((s,) + leaf.shape, leaf.dtype),
+                single)
+            self._tok = jnp.zeros((s,), jnp.int32)  # last token/slot
+            self._pos = jnp.zeros((s,), jnp.int32)  # cache write head/slot
+            self._rngs = jnp.zeros((s,) + self._base_rng.shape,
+                                   self._base_rng.dtype)
+            self._prefill = jax.jit(self._prefill_impl)
+            self._admit = jax.jit(
+                self._admit_impl,
+                donate_argnums=(0, 1, 2, 3) if donate else ())
+            self._decode = jax.jit(
+                self._decode_impl,
+                donate_argnums=(1, 2, 3) if donate else ())
 
-    # -- compiled pieces -----------------------------------------------------
+    # -- compiled pieces: paged KV + chunked prefill -------------------------
+    def _decode_step(self, params, cache, tok, pos, active, rngs, tables):
+        """One token for every active slot through the paged pool.
+
+        ``tok``/``pos``/``active``/``rngs`` are [B]-shaped host state;
+        ``tables`` [B, pages_per_slot]. Inactive lanes still compute
+        (static shapes) but write the null page and sample pad — their
+        slot's pages are untouched, so a freed slot's pool pages stay
+        bitwise intact until the allocator reuses them. Each lane's
+        row arithmetic matches the sequential ``Generator``'s one-token
+        step exactly (the [B, 1] batch extends batch dims only, never
+        the M dimension of any matmul — the bitwise-stability boundary).
+        """
+        pages = PagedKV(table=tables, positions=pos[:, None],
+                        valid=active[:, None])
+        logits, vars_out = self.model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            positions=pos[:, None], train=False, decode=True,
+            mutable=["cache"], pages=pages)
+
+        def lane(rng_s, pos_s, row):
+            return sample_token(jax.random.fold_in(rng_s, pos_s),
+                                row[None], self.sample_cfg)[0]
+
+        nxt = jax.vmap(lane)(rngs, pos, logits[:, -1, :])
+        nxt = jnp.where(active, nxt, jnp.int32(self.sample_cfg.pad_id))
+        return vars_out["cache"], nxt
+
+    def _chunk_step(self, params, cache, toks, pos, valid, table, rng):
+        """One prefill chunk ``[1, C]`` for the oldest prefilling slot.
+
+        Writes the chunk's K/V through the slot's page table (padding
+        rows hit the null page) and samples a candidate token per row
+        with ``fold_in(request_rng, position)`` — the host keeps row
+        ``true_len-1-start`` as the request's first token when this
+        chunk is final, making its RNG and logits row identical to the
+        full-prompt prefill's.
+        """
+        pages = PagedKV(table=table, positions=pos[None],
+                        valid=valid[None])
+        logits, vars_out = self.model.apply(
+            {"params": params, "cache": cache}, toks[None],
+            positions=pos[None], train=False, decode=True,
+            mutable=["cache"], pages=pages)
+
+        def row(pos_s, lg):
+            return sample_token(jax.random.fold_in(rng, pos_s),
+                                lg[None], self.sample_cfg)[0]
+
+        sampled = jax.vmap(row)(pos, logits[0])
+        return vars_out["cache"], sampled
+
+    def _fused_impl(self, params, cache, d_tok, d_pos, d_active, d_rngs,
+                    tables, c_tok, c_pos, c_valid, c_table, c_rng):
+        """The fused iteration: one prefill chunk piggybacks onto the
+        decode batch inside one compiled program (Sarathi-Serve), so an
+        admission costs decode ZERO extra dispatches and never blocks
+        it. The two sub-applies touch disjoint pages (the chunk's slot
+        is not decoding), so their order is arithmetic-free."""
+        cache, c_sampled = self._chunk_step(params, cache, c_tok, c_pos,
+                                            c_valid, c_table, c_rng)
+        cache, nxt = self._decode_step(params, cache, d_tok, d_pos,
+                                       d_active, d_rngs, tables)
+        return cache, nxt, c_sampled
+
+    def _decode_only_impl(self, params, cache, d_tok, d_pos, d_active,
+                          d_rngs, tables):
+        """Iterations with no prefill pending skip the chunk lane's
+        compute entirely (the second compiled program)."""
+        return self._decode_step(params, cache, d_tok, d_pos, d_active,
+                                 d_rngs, tables)
+
+    # -- compiled pieces: legacy contiguous slots ----------------------------
     def _prefill_impl(self, params, prompt, true_len, rng):
         """[1, Lb] padded prompt → (single-sequence cache, first token).
 
@@ -215,7 +348,8 @@ class Engine:
                arrival_t: float | None = None) -> Request:
         """Enqueue a request (thread-safe). Raises
         :class:`~distributed_training_tpu.inference.sampler.
-        CacheBudgetError` when it can never fit a slot."""
+        CacheBudgetError` when it can never fit a slot's page table (or
+        the legacy contiguous budget)."""
         return self.queue.submit(prompt, max_new_tokens=max_new_tokens,
                                  arrival_t=arrival_t)
 
@@ -227,7 +361,35 @@ class Engine:
         b = self.cfg.prefill_bucket
         return min(self.budget, -(-n // b) * b)
 
+    def _req_pages(self, req: Request) -> int:
+        """Worst-case page commitment: the request's whole lifetime
+        (prompt + completion budget), page-rounded. The last emitted
+        token is never written back, so this strictly covers every
+        write the sequence can issue."""
+        return pages_for(req.prompt.size + req.max_new_tokens,
+                         self.page_size)
+
+    def _ensure_pages(self, slot: int, tokens: int) -> None:
+        """Grow ``slot``'s page table to cover ``tokens`` cache
+        positions, drawing on-demand from the slot's commitment."""
+        need = pages_for(tokens, self.page_size)
+        have = len(self._slot_pages[slot])
+        if need > have:
+            new = self.pool.alloc(need - have)
+            for i, p in enumerate(new):
+                self._tables[slot, have + i] = p
+            self._slot_pages[slot].extend(new)
+            self._slot_commit_left[slot] -= len(new)
+
+    def _free_slot_pages(self, slot: int) -> None:
+        self.pool.free(self._slot_pages[slot],
+                       uncommit=max(self._slot_commit_left[slot], 0))
+        self._slot_pages[slot] = []
+        self._slot_commit_left[slot] = 0
+        self._tables[slot, :] = 0
+
     def _prefill_request(self, seq) -> None:
+        """Legacy path: one bucketed batch-1 prefill + slot scatter."""
         req = seq.request
         n = req.prompt.size
         padded = np.full((1, self._bucket(n)), self.sample_cfg.pad_id,
@@ -239,13 +401,20 @@ class Engine:
         self._cache, self._tok, self._pos, self._rngs = self._admit(
             self._cache, self._tok, self._pos, self._rngs,
             jnp.int32(seq.slot), new_cache, tok, jnp.int32(n), req_rng)
+        seq.prefill_pos = n
         first = int(tok)  # the one deliberate sync: TTFT is measured here
         t = time.perf_counter()
+        self._note_first_token(seq, first, t)
+
+    def _note_first_token(self, seq, first: int, t: float) -> None:
+        """Shared first-token bookkeeping: the TTFT measurement point.
+
+        Admission-latency breakdown: queueing (arrival → seat) vs
+        prefill compute (seat → first token) — the same endpoints the
+        trace spans carry, so the two views agree bitwise."""
+        req = seq.request
         seq.note_token(first, t)
         self.telemetry.on_tokens(1, t)
-        # Admission-latency breakdown: queueing (arrival → seat) vs
-        # prefill compute (seat → first token) — the same endpoints the
-        # trace spans below carry, so the two views agree bitwise.
         self.telemetry.on_admitted((seq.seated_t - req.arrival_t) * 1e3,
                                    (t - seq.seated_t) * 1e3)
         if self.trace is not None:
@@ -257,16 +426,165 @@ class Engine:
             self.trace.complete("queued", req.arrival_t, seq.seated_t,
                                 track=track, uid=req.uid)
             self.trace.complete("prefill", seq.seated_t, t, track=track,
-                                uid=req.uid, prompt_len=int(n))
+                                uid=req.uid,
+                                prompt_len=int(req.prompt.size))
             self.trace.instant("first_token", track=track, t=t,
                                uid=req.uid, t_arrival=req.arrival_t,
                                t_first_token=t)
 
     def step(self) -> list[FinishedRequest]:
-        """One engine iteration: admit+prefill, decode, evict.
+        """One engine iteration: admit(+chunk-prefill), decode, evict.
 
         Returns the requests that finished this iteration. Safe to call
         when idle (records an excluded gap and returns [])."""
+        return self._step_paged() if self.paged else self._step_legacy()
+
+    def _step_paged(self) -> list[FinishedRequest]:
+        it = self._iteration
+        self._iteration += 1
+        eos = self.sample_cfg.eos_id
+        deadlines = (self.cfg.ttft_deadline_ms is not None
+                     or self.cfg.deadline_ms is not None)
+        finished: list[FinishedRequest] = []
+        if deadlines:
+            for req in self.queue.pop_expired(time.perf_counter()):
+                finished.append(FinishedRequest.timed_out_in_queue(req))
+
+        had_work = not self.idle
+        if had_work:
+            self.telemetry.begin_work()
+        # Page-aware admission: the queue head seats only when the pool
+        # can commit its worst-case page count — strictly FIFO, so the
+        # check is on the head alone (see SlotScheduler.admit). The gate
+        # COMMITS as it accepts, so a multi-seat pass sees its own
+        # earlier reservations. Seating costs NO device work here; the
+        # prompt prefills chunk-by-chunk below, riding the decode
+        # iterations.
+        def seat_and_commit(req: Request) -> bool:
+            n_pages = self._req_pages(req)
+            if not self.pool.can_commit(n_pages):
+                return False
+            self.pool.commit(n_pages)
+            return True
+
+        for seq in self.scheduler.admit(self.queue, seat_and_commit):
+            slot = seq.slot
+            self._slot_pages[slot] = []
+            self._slot_commit_left[slot] = self._req_pages(seq.request)
+            self._tables[slot, :] = 0
+            self._slot_rng[slot] = np.asarray(
+                jax.random.fold_in(self._base_rng, seq.request.uid))
+        # Head-of-line blocking: anything still queued after the
+        # admission pass is blocked on a slot OR on pool pages until the
+        # next boundary — bill the rest of this iteration as
+        # admission-blocked time (the legacy definition, generalized
+        # from "all slots busy" to "cannot seat").
+        blocked_t0 = (time.perf_counter() if len(self.queue) > 0
+                      else None)
+
+        active_seqs = self.scheduler.active()
+        decoding = [s for s in active_seqs if not s.prefilling]
+        prefilling = [s for s in active_seqs if s.prefilling]
+        # Oldest prefilling request first (seat order == arrival order):
+        # one chunk per iteration keeps the fused step's shape fixed and
+        # admission FIFO-fair.
+        chunk_seq = min(prefilling, key=lambda s: s.request.uid,
+                        default=None)
+
+        if chunk_seq is not None or decoding:
+            t_step0 = time.perf_counter()
+            s = self.cfg.max_batch
+            d_tok = np.zeros((s,), np.int32)
+            d_pos = np.zeros((s,), np.int32)
+            d_active = np.zeros((s,), bool)
+            for seq in decoding:
+                # Write position of the incoming token = tokens already
+                # cached (prompt + generated minus the uncached last).
+                p = seq.request.prompt.size + len(seq.tokens) - 1
+                self._ensure_pages(seq.slot, p + 1)
+                d_tok[seq.slot] = seq.tokens[-1]
+                d_pos[seq.slot] = p
+                d_active[seq.slot] = True
+            c = 0
+            if chunk_seq is not None:
+                n = chunk_seq.request.prompt.size
+                start = chunk_seq.prefill_pos
+                c = min(self.prefill_chunk, n - start)
+                self._ensure_pages(chunk_seq.slot, start + c)
+                cw = self.prefill_chunk
+                c_tok = np.full((cw,), self.sample_cfg.pad_id, np.int32)
+                c_pos = np.zeros((cw,), np.int32)
+                c_valid = np.zeros((cw,), bool)
+                c_tok[:c] = chunk_seq.request.prompt[start:start + c]
+                c_pos[:c] = np.arange(start, start + c)
+                c_valid[:c] = True
+                self._cache, nxt, c_sampled = self._fused(
+                    self.params, self._cache, jnp.asarray(d_tok),
+                    jnp.asarray(d_pos), jnp.asarray(d_active),
+                    jnp.asarray(self._slot_rng),
+                    jnp.asarray(self._tables), jnp.asarray(c_tok),
+                    jnp.asarray(c_pos), jnp.asarray(c_valid),
+                    jnp.asarray(self._tables[chunk_seq.slot][None]),
+                    jnp.asarray(self._slot_rng[chunk_seq.slot]))
+            else:
+                self._cache, nxt = self._decode(
+                    self.params, self._cache, jnp.asarray(d_tok),
+                    jnp.asarray(d_pos), jnp.asarray(d_active),
+                    jnp.asarray(self._slot_rng),
+                    jnp.asarray(self._tables))
+            toks = np.asarray(nxt)  # per-iteration sync: tokens must land
+            t = time.perf_counter()
+            for seq in decoding:
+                seq.note_token(toks[seq.slot], t)
+            self.telemetry.on_tokens(len(decoding), t)
+            if chunk_seq is not None:
+                start = chunk_seq.prefill_pos
+                chunk_seq.prefill_pos = start + c
+                if self.trace is not None:
+                    self.trace.complete(
+                        "prefill_chunk", t_step0, t,
+                        track=f"slot {chunk_seq.slot}",
+                        uid=chunk_seq.request.uid, start=int(start),
+                        tokens=int(c))
+                if chunk_seq.prefill_pos == chunk_seq.request.prompt.size:
+                    # Final chunk: its last valid row is the request's
+                    # first token (same RNG fold and logits row as a
+                    # full-prompt prefill).
+                    first = int(np.asarray(c_sampled)[c - 1])
+                    self._note_first_token(chunk_seq, first, t)
+            # KV utilization, host-side only: reserved = pages actually
+            # held by occupied slots (the paged win — compare the legacy
+            # path's active × full budget), written = live cache
+            # positions, both reconstructed without a device read.
+            counted = decoding + ([chunk_seq] if chunk_seq is not None
+                                  else [])
+            reserved = sum(len(self._slot_pages[q.slot]) for q in counted
+                           ) * self.page_size
+            written = sum(q.request.prompt.size + len(q.tokens) - 1
+                          for q in decoding)
+            if chunk_seq is not None:
+                written += chunk_seq.prefill_pos
+            self.telemetry.on_kv(
+                reserved=reserved, written=written, active=len(counted),
+                slots=self.cfg.max_batch,
+                pages_allocated=self.pool.num_allocated,
+                pages_total=self.pool.num_pages)
+            if blocked_t0 is not None:
+                self.telemetry.on_admission_blocked(t - blocked_t0)
+            if self.trace is not None:
+                self.trace.complete("decode", t_step0, t, track="engine",
+                                    iteration=it, active=len(decoding),
+                                    prefill_chunk=int(c))
+                self.trace.counter("active_slots", len(counted))
+                self.trace.counter("kv_written_tokens", written)
+                self.trace.counter("kv_pages_allocated",
+                                   self.pool.num_allocated)
+            finished.extend(self.scheduler.evict_finished(
+                eos, now=t if deadlines else None))
+
+        return self._finish_iteration(it, had_work, finished)
+
+    def _step_legacy(self) -> list[FinishedRequest]:
         it = self._iteration
         self._iteration += 1
         eos = self.sample_cfg.eos_id
@@ -329,9 +647,20 @@ class Engine:
             finished.extend(self.scheduler.evict_finished(
                 eos, now=t if deadlines else None))
 
+        return self._finish_iteration(it, had_work, finished)
+
+    def _finish_iteration(self, it: int, had_work: bool,
+                          finished: list[FinishedRequest]
+                          ) -> list[FinishedRequest]:
+        """Shared iteration tail: page reclamation, telemetry, traces."""
+        if self.paged:
+            for fin in finished:
+                if fin.slot is not None:
+                    self._free_slot_pages(fin.slot)
         if had_work:
             self.telemetry.on_iteration(
-                it, queue_depth=len(self.queue), active=len(active_seqs))
+                it, queue_depth=len(self.queue),
+                active=self.scheduler.num_active)
             if self.trace is not None:
                 self.trace.counter("queue_depth", len(self.queue))
             if self.idle:  # drained: close the busy segment at last token
@@ -434,7 +763,8 @@ class Engine:
 
     def reset_stats(self) -> None:
         """Fresh telemetry window (e.g. after a compile warm-up pass);
-        compiled programs and slot state are untouched."""
+        compiled programs, slot state, and page allocations are
+        untouched."""
         self.telemetry = ServeTelemetry(self.cfg.ring_size)
         self.queue.reset_counters()
         self._iteration = 0

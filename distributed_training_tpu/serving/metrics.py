@@ -85,6 +85,11 @@ class ServeTelemetry:
         self.kv_written_tokens = 0
         self.slot_iters_active = 0
         self.slot_iters_total = 0
+        # Page-pool occupancy (paged engine only; page-iterations):
+        # allocated vs total pool pages per iteration — the capacity
+        # headroom view the allocator adds on top of reserved/written.
+        self.page_iters_allocated = 0
+        self.page_iters_total = 0
         self.admission_blocked_s = 0.0
         self.tokens_emitted = 0
         self.requests_finished = 0
@@ -133,15 +138,23 @@ class ServeTelemetry:
         self._busy_t1 = time.perf_counter() if t is None else t
 
     def on_kv(self, *, reserved: int, written: int, active: int,
-              slots: int) -> None:
+              slots: int, pages_allocated: int | None = None,
+              pages_total: int | None = None) -> None:
         """One decode iteration's KV-cache occupancy: ``reserved`` =
-        active slots × per-slot budget, ``written`` = Σ live cache write
-        heads (prompt + generated positions actually holding K/V). All
-        host-side integers the engine already tracks — no device read."""
+        KV positions actually HELD for occupied slots (allocated pages ×
+        page size under the paged allocator; active slots × full budget
+        on the legacy path), ``written`` = Σ live cache write heads
+        (prompt + generated positions actually holding K/V). The paged
+        engine also reports pool occupancy (``pages_allocated`` of
+        ``pages_total``). All host-side integers the engine already
+        tracks — no device read."""
         self.kv_reserved_tokens += int(reserved)
         self.kv_written_tokens += int(written)
         self.slot_iters_active += int(active)
         self.slot_iters_total += int(slots)
+        if pages_allocated is not None and pages_total is not None:
+            self.page_iters_allocated += int(pages_allocated)
+            self.page_iters_total += int(pages_total)
 
     def on_admitted(self, queue_wait_ms: float,
                     prefill_ms: float) -> None:
@@ -221,6 +234,14 @@ class ServeTelemetry:
             "slot_occupancy_mean": (
                 self.slot_iters_active / self.slot_iters_total
                 if self.slot_iters_total else 0.0),
+            # Paged-allocator pool view (0.0 on the legacy path): mean
+            # fraction of pool pages allocated per iteration, and the
+            # same numerator in page-iterations for the bench gate's
+            # workload-deterministic drift check.
+            "page_pool_occupancy_mean": (
+                self.page_iters_allocated / self.page_iters_total
+                if self.page_iters_total else 0.0),
+            "kv_pages_allocated_iters": int(self.page_iters_allocated),
             "queue_wait_p50_ms": pct(self.queue_wait_ms, 50),
             "queue_wait_p95_ms": pct(self.queue_wait_ms, 95),
             "prefill_p50_ms": pct(self.prefill_ms, 50),

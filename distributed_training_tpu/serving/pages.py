@@ -1,0 +1,158 @@
+"""Fixed-size KV page pool: the allocator behind the paged serving cache.
+
+PagedAttention's memory model (vLLM), host-side half: KV memory is a
+fixed pool of ``page_size``-token pages and a sequence holds
+``ceil(written / page_size)`` of them instead of a contiguous
+``max_len`` reservation. Pages are interchangeable (any physical page
+serves any logical position via the per-slot page table), so there is
+no external fragmentation BY CONSTRUCTION — the free list is the whole
+allocation state, and capacity arithmetic is exact.
+
+Physical page ids run ``1..num_pages``; **page 0 is the reserved null
+page** the device pool keeps for masked writes (inactive decode lanes,
+chunk padding) and unallocated page-table entries. The allocator never
+hands it out, so a request's pages can never alias the garbage page.
+
+Admission safety is COMMITMENT-based: seating a request commits its
+worst-case page count (``ceil((prompt + max_new_tokens) / page_size)``)
+while physical pages still allocate on demand as the write head
+advances. A committed page can always be allocated, so an admitted
+sequence can never hit pool exhaustion mid-flight — overload queues at
+admission (or raises the typed :class:`~distributed_training_tpu.
+inference.sampler.CacheBudgetError` at submit when a request could
+never fit the pool), it does not corrupt a running batch.
+"""
+
+from __future__ import annotations
+
+from distributed_training_tpu.inference.sampler import CacheBudgetError
+
+# Physical page 0: the device pool's garbage page (see module docstring).
+NULL_PAGE = 0
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``tokens`` cache positions (ceil division)."""
+    if tokens < 0:
+        raise ValueError(f"tokens must be >= 0, got {tokens}")
+    return -(-tokens // page_size)
+
+
+class PagePool:
+    """Free-list allocator over ``num_pages`` interchangeable KV pages.
+
+    >>> pool = PagePool(num_pages=8, page_size=16)
+    >>> pool.commit(3)           # admission: worst-case reservation
+    >>> p = pool.alloc(1)        # on-demand: draws against the commitment
+    >>> pool.free(p, uncommit=2) # eviction: pages back + unused commitment
+
+    ``alloc``/``commit`` raise the typed :class:`CacheBudgetError`
+    (pages requested vs free) on exhaustion; ``free`` raises on a
+    double-free or a foreign page id, so a leak or aliasing bug fails
+    loudly at the boundary instead of corrupting a neighbor's KV.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        # LIFO free list: a just-freed page is reused first, keeping the
+        # working set of device pages dense (and reuse deterministic).
+        self._free: list[int] = list(range(self.num_pages, 0, -1))
+        self._allocated: set[int] = set()
+        self.committed = 0  # pages promised to seated requests, unallocated
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        """Physically free pages (ignores commitments)."""
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    @property
+    def available(self) -> int:
+        """Pages a NEW request may commit: free minus already-committed."""
+        return len(self._free) - self.committed
+
+    def can_commit(self, n: int) -> bool:
+        return n <= self.available
+
+    # -- transitions ---------------------------------------------------------
+    def commit(self, n: int) -> None:
+        """Reserve ``n`` pages worth of future allocations (admission).
+
+        Raises :class:`CacheBudgetError` when the pool cannot promise
+        them — the page-aware admission gate.
+        """
+        if n < 0:
+            raise ValueError(f"cannot commit {n} pages")
+        if n > self.available:
+            raise CacheBudgetError(
+                f"KV page pool exhausted: requested {n} page(s) but only "
+                f"{max(self.available, 0)} of {self.num_pages} free and "
+                f"uncommitted ({self.num_allocated} allocated, "
+                f"{self.committed} committed; page_size="
+                f"{self.page_size})")
+        self.committed += n
+
+    def release(self, n: int) -> None:
+        """Return ``n`` unused commitments (early finish / eviction)."""
+        if n < 0 or n > self.committed:
+            raise ValueError(
+                f"cannot release {n} of {self.committed} committed pages")
+        self.committed -= n
+
+    def alloc(self, n: int = 1, *, committed: bool = True) -> list[int]:
+        """Draw ``n`` physical pages (ids 1..num_pages, never the null
+        page). ``committed=True`` (the engine's path) consumes prior
+        :meth:`commit` reservations; ``committed=False`` allocates
+        against the uncommitted remainder (raw allocator use).
+
+        Raises :class:`CacheBudgetError` on exhaustion — pages requested
+        vs free, as the admission error contract specifies.
+        """
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        budget = self.committed if committed else self.available
+        if n > budget or n > len(self._free):
+            raise CacheBudgetError(
+                f"KV page pool exhausted: requested {n} page(s) but "
+                f"{len(self._free)} of {self.num_pages} free "
+                f"({'committed budget ' + str(self.committed) if committed else 'uncommitted'}; "
+                f"page_size={self.page_size})")
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        if committed:
+            self.committed -= n
+        return pages
+
+    def free(self, pages: list[int], *, uncommit: int = 0) -> None:
+        """Return ``pages`` to the pool, plus ``uncommit`` unused
+        commitments (a request that finished early via EOS/timeout never
+        allocated its worst case)."""
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(
+                    f"page {p} is not allocated (double free, the null "
+                    f"page, or a foreign id)")
+            self._allocated.discard(p)
+            self._free.append(p)
+        if uncommit:
+            self.release(uncommit)
+
+    def check_balanced(self) -> None:
+        """Invariant audit: every page is exactly free or allocated and
+        nothing is committed — the post-drain steady state. Raises
+        ``AssertionError`` with the leak arithmetic otherwise."""
+        assert len(self._free) + len(self._allocated) == self.num_pages, (
+            f"page leak: {len(self._free)} free + {len(self._allocated)} "
+            f"allocated != {self.num_pages} total")
+        assert self.committed == 0, (
+            f"{self.committed} committed page(s) never released")
+        assert not (set(self._free) & self._allocated), "page aliased"

@@ -6,11 +6,15 @@ applies three typed guards at submit time, so a request that can never
 be served (or should not be) fails fast in the producer instead of
 wedging or bloating the queue:
 
-- **budget** — ``prompt_len + max_new_tokens`` must fit the per-slot
-  KV-cache budget (:func:`~distributed_training_tpu.inference.sampler.
-  cache_budget`); violations raise the typed :class:`~distributed_
-  training_tpu.inference.sampler.CacheBudgetError` (it would never
-  become admissible, so queueing it would wedge the queue head forever).
+- **budget** — the request's whole-lifetime KV footprint must be
+  servable: ``prompt_len + max_new_tokens`` within the per-slot token
+  budget (:func:`~distributed_training_tpu.inference.sampler.
+  cache_budget`), and — paged engine — its worst-case page count
+  (``ceil(total / kv_page_size)``) within the page pool. Violations
+  raise the typed :class:`~distributed_training_tpu.inference.sampler.
+  CacheBudgetError` with page-based accounting (pages needed vs the
+  pool/table capacity); it would never become admissible, so queueing
+  it would wedge the FIFO head forever.
 - **depth** — an optional ``max_depth`` bounds the queue; a submit that
   would exceed it is SHED with :class:`~distributed_training_tpu.
   resilience.errors.QueueFullError` (every queued request's TTFT grows
@@ -59,12 +63,19 @@ class RequestQueue:
                  max_depth: int | None = None,
                  ttft_deadline_ms: float | None = None,
                  deadline_ms: float | None = None,
-                 trace=None):
+                 trace=None, page_size: int | None = None,
+                 pool_pages: int | None = None):
         if budget < 2:
             raise ValueError(f"budget must be >= 2, got {budget}")
         if max_depth is not None and max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.budget = int(budget)
+        # Paged-KV admission accounting: when set, the fail-fast check
+        # (and its error message) is in pages — a request whose
+        # worst-case page count exceeds the POOL can never seat, even
+        # if its token count fits the per-slot table.
+        self.page_size = page_size
+        self.pool_pages = pool_pages
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.max_depth = max_depth
         self.ttft_deadline_ms = ttft_deadline_ms
@@ -100,7 +111,31 @@ class RequestQueue:
         if mnt < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {mnt}")
         total = tokens.size + mnt
-        if total > self.budget:
+        if self.page_size is not None:
+            # Page-based accounting: the request's worst-case footprint
+            # in pages vs what a slot's page table (and the pool) can
+            # ever hand one sequence.
+            from distributed_training_tpu.serving.pages import pages_for
+
+            need = pages_for(total, self.page_size)
+            cap = pages_for(self.budget, self.page_size)
+            if self.pool_pages is not None:
+                cap = min(cap, self.pool_pages)
+            # The token budget stays authoritative (write positions must
+            # fit the positional table) even when page-count rounding
+            # would cover the overflow.
+            if need > cap or total > self.budget:
+                with self._lock:
+                    self.rejected += 1
+                raise CacheBudgetError(
+                    f"prompt ({tokens.size}) + max_new_tokens ({mnt}) = "
+                    f"{total} tokens needs {need} KV page(s) of "
+                    f"{self.page_size}, but at most {cap} page(s) and "
+                    f"{self.budget} token positions can ever serve one "
+                    f"sequence"
+                    + (f" ({self.pool_pages}-page pool)"
+                       if self.pool_pages is not None else ""))
+        elif total > self.budget:
             with self._lock:
                 self.rejected += 1
             raise CacheBudgetError(
@@ -174,6 +209,13 @@ class RequestQueue:
         engine polls at iteration boundaries, it does not park a thread)."""
         with self._lock:
             return self._q.popleft() if self._q else None
+
+    def peek(self) -> Request | None:
+        """The queue head without popping it — the page-aware admission
+        gate inspects the head's footprint before committing pool pages
+        (scheduler.admit's ``can_seat``)."""
+        with self._lock:
+            return self._q[0] if self._q else None
 
     def pop_expired(self, now: float) -> list[Request]:
         """Remove and return every queued request already past its TTFT

@@ -28,10 +28,11 @@ class Request:
     """One admitted generation request (arrival-ordered by ``uid``).
 
     ``ttft_deadline_t`` / ``deadline_t`` are absolute ``perf_counter``
-    deadlines (None = none): a request still queued past its TTFT
-    deadline, or still decoding past its total deadline, is evicted with
-    finish reason ``timeout`` instead of holding a slot or queue
-    position forever under overload.
+    deadlines (None = none): a request past its TTFT deadline with no
+    first token yet (still queued, or seated mid-chunked-prefill), or
+    still decoding past its total deadline, is evicted with finish
+    reason ``timeout`` instead of holding a slot or queue position
+    forever under overload.
     """
 
     uid: int
@@ -55,6 +56,19 @@ class ActiveSequence:
     seated_t: float | None = None
     first_token_t: float | None = None
     last_token_t: float | None = None
+    # Chunked-prefill progress (paged engine): prompt tokens already
+    # written to the KV pool. A seated sequence decodes only once
+    # prefill_pos reaches the prompt length AND its first token landed;
+    # until then it occupies its slot as "prefilling".
+    prefill_pos: int = 0
+
+    @property
+    def prefilling(self) -> bool:
+        """Seated but not yet decoding (paged engine's chunked prefill);
+        always False on the legacy path, whose batch-1 prefill emits the
+        first token before the sequence ever reaches the slot state."""
+        return (self.prefill_pos < self.request.prompt.size
+                or not self.tokens)
 
     def note_token(self, token: int, t: float) -> None:
         self.tokens.append(int(token))
@@ -76,6 +90,19 @@ class ActiveSequence:
             return FINISH_LENGTH
         dl = self.request.deadline_t
         if now is not None and dl is not None and now >= dl:
+            return FINISH_TIMEOUT
+        # TTFT deadline, mid-prefill: chunked prefill holds a slot for
+        # ceil(prompt/chunk) iterations before the first token, so a
+        # request can now miss its TTFT SLA while SEATED (impossible on
+        # the legacy path, whose seat and first token share an
+        # iteration). Past the deadline with no first token it will
+        # never make its SLA — evict so the chunk lane and its pool
+        # pages go to a request that still can. A first token landing on
+        # the deadline tick wins (first_token_t set → not a timeout),
+        # matching the EOS/length-beat-deadline rule above.
+        tdl = self.request.ttft_deadline_t
+        if (now is not None and tdl is not None and now >= tdl
+                and self.first_token_t is None):
             return FINISH_TIMEOUT
         return None
 
@@ -110,12 +137,17 @@ class FinishedRequest:
         tpot = None
         if n > 1:
             tpot = (seq.last_token_t - seq.first_token_t) * 1e3 / (n - 1)
+        # A deadline eviction can now land mid-prefill (chunked prefill
+        # holds a slot across iterations): no first token, no TTFT
+        # sample — same contract as a queue-side timeout.
+        ttft = (None if seq.first_token_t is None
+                else (seq.first_token_t - seq.request.arrival_t) * 1e3)
         return FinishedRequest(
             uid=seq.request.uid,
             prompt=seq.request.prompt,
             tokens=np.asarray(seq.tokens, np.int32),
             finish_reason=reason,
-            ttft_ms=(seq.first_token_t - seq.request.arrival_t) * 1e3,
+            ttft_ms=ttft,
             tpot_ms=tpot,
             arrival_t=seq.request.arrival_t,
             first_token_t=seq.first_token_t,

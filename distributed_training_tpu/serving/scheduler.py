@@ -58,18 +58,30 @@ class SlotScheduler:
         return seq
 
     # -- iteration boundaries ------------------------------------------------
-    def admit(self, queue) -> list[ActiveSequence]:
+    def admit(self, queue, can_seat=None) -> list[ActiveSequence]:
         """Fill free slots from ``queue`` in strict arrival order.
 
         Lowest free slot first — slot choice is cosmetic (slots are
         independent lanes), but a deterministic rule keeps batched runs
         reproducible. Returns the newly seated sequences; the engine
         prefills each one.
+
+        ``can_seat`` (paged engine) is the page-aware admission gate: a
+        predicate over the queue HEAD, consulted before each pop. When
+        the head's worst-case page commitment does not fit the pool,
+        admission stops — strictly FIFO, never skipping ahead to a
+        smaller request, so a long-context request cannot starve behind
+        a stream of short ones (the legacy ``max_len``-sum behavior,
+        restated in pages).
         """
         seated: list[ActiveSequence] = []
         for slot in range(self.num_slots):
             if self._slots[slot] is not None:
                 continue
+            if can_seat is not None:
+                head = queue.peek()
+                if head is None or not can_seat(head):
+                    break
             req: Request | None = queue.pop()
             if req is None:
                 break
@@ -88,10 +100,11 @@ class SlotScheduler:
         Called after tokens land (post-prefill and post-decode-step): a
         one-token request or an instant EOS finishes without ever joining
         a decode iteration. ``now`` additionally evicts slots past their
-        total deadline with finish reason ``timeout`` (partial tokens
-        returned) — a slot is serving capacity, and a request that
-        already missed its SLA must hand it to one that can still make
-        its own.
+        total deadline (partial tokens returned) — and, chunked prefill,
+        slots past their TTFT deadline with no first token yet — with
+        finish reason ``timeout``: a slot is serving capacity, and a
+        request that already missed its SLA must hand it to one that can
+        still make its own.
         """
         done: list[FinishedRequest] = []
         for slot in range(self.num_slots):

@@ -44,9 +44,27 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--top-k", type=int, default=None)
     parser.add_argument("--top-p", type=float, default=None)
     parser.add_argument("--eos-id", type=int, default=None)
+    parser.add_argument("--kv-page-size", type=int, default=8,
+                        help="paged KV cache (docs/SERVING.md): KV "
+                             "memory is a pool of this-many-token pages "
+                             "with per-slot page tables; pages allocate "
+                             "as written, so admission gates on actual "
+                             "footprint, not max-len. 0 = legacy "
+                             "contiguous per-slot reservation")
+    parser.add_argument("--kv-pages", type=int, default=None,
+                        help="KV pool size in pages; default max_batch x "
+                             "ceil(budget/page_size) = the legacy "
+                             "capacity. Smaller oversubscribes: bursts "
+                             "queue on pages instead of slots")
+    parser.add_argument("--prefill-chunk", type=int, default=64,
+                        help="chunked prefill (paged mode): prompt "
+                             "tokens prefilled per decode iteration, "
+                             "riding the fused step so admission never "
+                             "blocks decode")
     parser.add_argument("--prefill-bucket", type=int, default=64,
-                        help="prompt lengths pad to a multiple of this "
-                             "(bounds prefill compile count)")
+                        help="LEGACY prefill (--kv-page-size 0): prompt "
+                             "lengths pad to a multiple of this (bounds "
+                             "prefill compile count)")
     # Graceful degradation (resilience round; docs/RESILIENCE.md).
     parser.add_argument("--max-queue-depth", type=int, default=None,
                         help="bounded admission: a submit beyond this "
@@ -169,6 +187,9 @@ def main() -> int:
         top_k=args.top_k,
         top_p=args.top_p,
         eos_id=args.eos_id,
+        kv_page_size=args.kv_page_size or None,
+        kv_pages=args.kv_pages,
+        prefill_chunk=args.prefill_chunk,
         prefill_bucket=args.prefill_bucket,
         max_queue_depth=args.max_queue_depth,
         ttft_deadline_ms=args.ttft_deadline_ms,

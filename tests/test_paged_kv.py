@@ -1,0 +1,131 @@
+"""Page-allocator unit tests (serving/pages.py) — host-side only.
+
+The PagePool is the admission-safety keystone of the paged serving
+engine: every guarantee the engine makes about never corrupting a
+neighbor's KV mid-flight reduces to this allocator's invariants —
+typed exhaustion, no leaks, no aliasing, commitment arithmetic that
+cannot strand pages. All tests are pure Python (no jax), so the whole
+file runs in milliseconds.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_training_tpu.inference.sampler import CacheBudgetError
+from distributed_training_tpu.serving import NULL_PAGE, PagePool, pages_for
+
+
+class TestPagesFor:
+    def test_ceil_division(self):
+        assert pages_for(0, 8) == 0
+        assert pages_for(1, 8) == 1
+        assert pages_for(8, 8) == 1
+        assert pages_for(9, 8) == 2
+        with pytest.raises(ValueError, match="tokens"):
+            pages_for(-1, 8)
+
+
+class TestAllocFree:
+    def test_alloc_never_hands_out_null_page(self):
+        pool = PagePool(num_pages=4, page_size=8)
+        pages = pool.alloc(4, committed=False)
+        assert NULL_PAGE not in pages
+        assert sorted(pages) == [1, 2, 3, 4]
+
+    def test_lifo_reuse(self):
+        """A just-freed page is reused first — deterministic reuse keeps
+        the device working set dense and test runs reproducible."""
+        pool = PagePool(num_pages=4, page_size=8)
+        a = pool.alloc(2, committed=False)
+        pool.free([a[1]])
+        b = pool.alloc(1, committed=False)
+        assert b == [a[1]]
+
+    def test_exhaustion_raises_typed_with_page_accounting(self):
+        pool = PagePool(num_pages=3, page_size=8)
+        pool.alloc(2, committed=False)
+        with pytest.raises(CacheBudgetError,
+                           match=r"requested 2 page\(s\) but 1"):
+            pool.alloc(2, committed=False)
+        # The failed alloc must not have consumed anything.
+        assert pool.num_free == 1 and pool.num_allocated == 2
+
+    def test_double_free_and_foreign_page_raise(self):
+        pool = PagePool(num_pages=2, page_size=8)
+        pages = pool.alloc(1, committed=False)
+        pool.free(pages)
+        with pytest.raises(ValueError, match="not allocated"):
+            pool.free(pages)
+        with pytest.raises(ValueError, match="not allocated"):
+            pool.free([NULL_PAGE])
+
+
+class TestCommitment:
+    def test_commit_gates_admission(self):
+        pool = PagePool(num_pages=4, page_size=8)
+        pool.commit(3)
+        assert pool.available == 1
+        assert not pool.can_commit(2)
+        with pytest.raises(CacheBudgetError, match="pool exhausted"):
+            pool.commit(2)
+
+    def test_alloc_draws_from_commitment(self):
+        pool = PagePool(num_pages=4, page_size=8)
+        pool.commit(2)
+        pool.alloc(2)  # committed=True default
+        assert pool.committed == 0 and pool.num_allocated == 2
+        with pytest.raises(CacheBudgetError):
+            pool.alloc(1)  # nothing committed anymore
+
+    def test_free_with_uncommit_releases_unused_worst_case(self):
+        """An early-EOS request frees its pages AND its unallocated
+        commitment tail in one call."""
+        pool = PagePool(num_pages=4, page_size=8)
+        pool.commit(3)
+        pages = pool.alloc(1)
+        pool.free(pages, uncommit=2)
+        pool.check_balanced()
+
+    def test_release_over_committed_raises(self):
+        pool = PagePool(num_pages=4, page_size=8)
+        pool.commit(1)
+        with pytest.raises(ValueError, match="release"):
+            pool.release(2)
+
+
+class TestNoLeaksUnderRandomizedAdmission:
+    def test_randomized_admission_evict_cycles_stay_balanced(self):
+        """Fragmentation-free invariant: after ANY interleaving of
+        commit → on-demand alloc → free(+uncommit) request lifecycles,
+        free + allocated == total, nothing committed, nothing aliased —
+        pages are interchangeable, so no admission order can fragment
+        the pool."""
+        rng = np.random.RandomState(0)
+        pool = PagePool(num_pages=16, page_size=8)
+        live: list[tuple[list[int], int]] = []  # (pages, commit_left)
+        for _ in range(500):
+            op = rng.randint(3)
+            if op == 0:  # admission: commit a worst case
+                n = int(rng.randint(1, 5))
+                if pool.can_commit(n):
+                    pool.commit(n)
+                    live.append(([], n))
+                else:
+                    with pytest.raises(CacheBudgetError):
+                        pool.commit(n)
+            elif op == 1 and live:  # decode progress: on-demand alloc
+                i = rng.randint(len(live))
+                pages, left = live[i]
+                if left > 0:
+                    pages.extend(pool.alloc(1))
+                    live[i] = (pages, left - 1)
+            elif op == 2 and live:  # eviction: free + uncommit tail
+                pages, left = live.pop(rng.randint(len(live)))
+                pool.free(pages, uncommit=left)
+            # Mid-flight audit: every page is exactly one of
+            # free/allocated and the null page never escaped.
+            assert pool.num_free + pool.num_allocated == pool.num_pages
+            assert NULL_PAGE not in pool._allocated
+        for pages, left in live:
+            pool.free(pages, uncommit=left)
+        pool.check_balanced()

@@ -2,21 +2,26 @@
 
 Load-bearing properties, in order of importance:
 
-1. **Oracle equivalence**: batched continuous-batching greedy decode is
-   token-identical to the sequential :class:`Generator` (temperature 0)
-   run per prompt — slot packing, bucketed prefill, and mid-flight
-   refills must not change a single emitted token.
+1. **Oracle equivalence**: batched continuous-batching greedy decode —
+   paged KV pool + chunked prefill, the default — is token-identical to
+   the sequential :class:`Generator` (temperature 0) run per prompt:
+   slot packing, page-table gathers, chunked prefill, and mid-flight
+   refills must not change a single emitted token. The legacy
+   contiguous path (kv_page_size=None) is pinned equal too.
 2. **Composition independence**: a request's tokens are bitwise
    independent of which other requests share the batch (engine at
-   max_batch=N == engine at max_batch=1), greedy AND sampled — the
-   per-slot vmap lanes and fold_in(uid, position) RNG guarantee it. The
-   solo engine also runs UNPADDED prefill (bucket 1) against the batched
-   engine's padded buckets, so the same equality pins prefill-padding
-   invisibility.
-3. **Scheduler mechanics**: FIFO admission, slot refill at iteration
-   boundaries, EOS/length eviction, typed admission rejection.
+   max_batch=N == engine at max_batch=1), greedy AND sampled — per-row
+   arithmetic independence and fold_in(uid, position) RNG guarantee it.
+   The solo engine runs a DIFFERENT prefill chunking (chunk 4, forcing
+   multi-chunk prefills) against the batched engine's single-chunk
+   prefills, so the same equality pins chunking invisibility (the
+   legacy analogue pinned bucket-padding invisibility).
+3. **Scheduler mechanics**: FIFO admission (page-aware under an
+   oversubscribed pool), slot refill at iteration boundaries,
+   EOS/length eviction, typed page-accounted admission rejection.
 4. **Telemetry**: the SLA summary carries all five latency/throughput
-   fields; the flight dump round-trips through FlightRecorder.load.
+   fields plus the page-pool utilization view; the flight dump
+   round-trips through FlightRecorder.load.
 
 Engines compile real XLA programs, so the expensive greedy runs are
 module-scoped fixtures shared across the assertion classes.
@@ -98,11 +103,23 @@ def batched_greedy(lm, prompts):
 
 @pytest.fixture(scope="module")
 def solo_greedy(lm, prompts):
-    """Same requests, one slot, UNPADDED prefill (bucket 1): the
-    sequential + padding-free counterpart of ``batched_greedy``."""
+    """Same requests, one slot, and a 4-token prefill chunk (prompts 5
+    and 9 split across iterations): the sequential + differently-chunked
+    counterpart of ``batched_greedy``."""
     model, params = lm
     return _serve(model, params, prompts, max_batch=1,
-                  max_new_tokens=N_NEW, temperature=0.0, prefill_bucket=1)
+                  max_new_tokens=N_NEW, temperature=0.0, prefill_chunk=4)
+
+
+@pytest.fixture(scope="module")
+def legacy_greedy(lm, prompts):
+    """The pre-paging engine (contiguous max_len slots, bucketed batch-1
+    prefill) on the same workload — the before side of the before/after
+    evidence pair, and the layout-equivalence oracle."""
+    model, params = lm
+    return _serve(model, params, prompts, max_batch=2,
+                  max_new_tokens=N_NEW, temperature=0.0,
+                  kv_page_size=None)
 
 
 class TestOracleEquivalence:
@@ -122,13 +139,42 @@ class TestOracleEquivalence:
     def test_batched_vs_sequential_engine_bitwise_greedy(
             self, batched_greedy, solo_greedy):
         """A request's tokens must not depend on batch composition OR on
-        the prefill bucket: max_batch=2/bucket-8 output is bitwise equal
-        to max_batch=1/unpadded output."""
+        the prefill chunking: max_batch=2/single-chunk output is bitwise
+        equal to max_batch=1/chunk-4 (multi-chunk) output."""
         _, batched = batched_greedy
         _, solo = solo_greedy
         for uid in batched:
             np.testing.assert_array_equal(batched[uid].tokens,
                                           solo[uid].tokens)
+
+    def test_legacy_contiguous_engine_bitwise_equal(self, legacy_greedy,
+                                                    batched_greedy):
+        """The legacy contiguous-slot path (kv_page_size=None: bucketed
+        batch-1 prefill + vmapped decode) emits bitwise-identical tokens
+        to the paged+chunked default — one oracle, two cache layouts."""
+        _, legacy = legacy_greedy
+        _, paged = batched_greedy
+        for uid in paged:
+            np.testing.assert_array_equal(paged[uid].tokens,
+                                          legacy[uid].tokens)
+
+    def test_oversubscribed_pool_completes_and_matches(self, lm, prompts,
+                                                       batched_greedy):
+        """A pool with room for ONE request's worst-case commitment at a
+        time (each needs 2 pages of 8; the pool holds 3): page-aware
+        admission leaves the second slot EMPTY until pages free, yet
+        every request completes with bitwise-identical tokens and the
+        allocator drains balanced (no leak, no stranded commitment)."""
+        model, params = lm
+        eng, by_uid = _serve(model, params, prompts, max_batch=2,
+                             max_new_tokens=N_NEW, temperature=0.0,
+                             kv_pages=3)
+        _, oracle = batched_greedy
+        for uid in by_uid:
+            np.testing.assert_array_equal(by_uid[uid].tokens,
+                                          oracle[uid].tokens)
+        eng.pool.check_balanced()
+        assert eng.stats()["admission_blocked_s"] > 0
 
     def test_batched_vs_sequential_engine_bitwise_sampled(self, lm, prompts):
         """Same independence for stochastic sampling: the RNG is a pure
@@ -236,14 +282,22 @@ class TestAdmissionControl:
         assert issubclass(CacheBudgetError, ValueError)
 
     def test_oversized_request_rejected_at_submit(self, lm):
+        """Admission errors speak page-based accounting now: pages
+        needed vs what the table/pool can ever serve one sequence."""
         model, params = lm
         eng = Engine(model, params, ServeConfig(
             max_batch=1, max_new_tokens=2, max_len=16, prefill_bucket=8))
-        with pytest.raises(CacheBudgetError, match="exceeds the KV cache"):
+        with pytest.raises(CacheBudgetError,
+                           match=r"needs 3 KV page\(s\) of 8"):
             eng.submit(np.arange(15, dtype=np.int32))  # 15 + 2 > 16
         eng.submit(np.arange(8, dtype=np.int32))       # 8 + 2 fits
         assert len(eng.run()) == 1
         assert eng.queue.rejected == 1
+        # Legacy path keeps the token-based message.
+        leg = Engine(model, params, ServeConfig(
+            max_batch=1, max_new_tokens=2, max_len=16, kv_page_size=None))
+        with pytest.raises(CacheBudgetError, match="exceeds the KV cache"):
+            leg.submit(np.arange(15, dtype=np.int32))
 
     def test_empty_prompt_rejected(self, lm):
         model, params = lm
@@ -352,6 +406,58 @@ class TestGracefulDegradation:
         assert done[0].tokens.tolist() == [9]
         assert sched.num_active == 0
 
+    def test_ttft_deadline_evicts_mid_prefill(self, lm, prompts):
+        """Chunked prefill opens a seated-but-no-first-token window the
+        legacy path never had (seat and first token shared an
+        iteration): a request past its TTFT deadline mid-prefill must
+        leave with reason 'timeout' — not monopolize the chunk lane for
+        its remaining chunks and then pollute the TTFT percentiles with
+        a deadline-violating sample."""
+        import dataclasses
+
+        from distributed_training_tpu.serving.request import (
+            ActiveSequence,
+            Request,
+        )
+
+        # Host-side semantics first (deterministic): no first token +
+        # expired TTFT deadline → timeout; a landed first token wins.
+        req = Request(uid=0, prompt=np.arange(8, dtype=np.int32),
+                      max_new_tokens=4, arrival_t=0.0,
+                      ttft_deadline_t=5.0)
+        mid = ActiveSequence(request=req, slot=0, prefill_pos=4)
+        assert mid.finish_reason(None, now=4.0) is None
+        assert mid.finish_reason(None, now=5.0) == FINISH_TIMEOUT
+        got_first = ActiveSequence(request=req, slot=0, prefill_pos=8)
+        got_first.note_token(3, t=5.0)
+        assert got_first.finish_reason(None, now=6.0) is None
+
+        # Through the engine: seat a multi-chunk prompt (12 tokens,
+        # chunk 4), then expire its TTFT deadline after the first chunk
+        # — the next iteration must evict it, return its pages, and
+        # leave the slot serving fresh traffic.
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=1, max_new_tokens=4, prefill_chunk=4,
+            ttft_deadline_ms=10_000.0))
+        long_prompt = np.arange(12, dtype=np.int32) % VOCAB
+        eng.submit(long_prompt)
+        assert eng.step() == []  # seated + chunk 1 of 3, no first token
+        seq = eng.scheduler._slots[0]
+        assert seq.prefilling and not seq.tokens
+        seq.request = dataclasses.replace(
+            seq.request, ttft_deadline_t=time.perf_counter() - 1e-3)
+        done = eng.step()
+        assert [f.finish_reason for f in done] == [FINISH_TIMEOUT]
+        assert done[0].tokens.size == 0 and done[0].ttft_ms is None
+        assert eng.stats()["requests_timed_out"] == 1
+        # Pages and commitment fully reclaimed; the slot serves again.
+        eng.pool.check_balanced()
+        eng.submit(prompts[0])
+        fresh = eng.run()
+        assert [f.finish_reason for f in fresh] == [FINISH_LENGTH]
+        assert fresh[0].tokens.size == 4
+
 
 class TestTelemetry:
     def test_stats_fields_flight_dump_and_report(self, batched_greedy,
@@ -389,15 +495,53 @@ class TestUtilizationAccounting:
     measured evidence for the paged-KV roadmap claim that ``max_len``
     slot reservation wastes capacity."""
 
+    @staticmethod
+    def _paged_expectation(lens, chunk, ps):
+        """Per-request analytic reserved/written sums under the paged
+        engine: prefill chunk k holds min(k*chunk, L) written tokens on
+        ceil(.../ps) pages; decode iteration j (1..N_NEW-1) holds L+j
+        tokens on ceil((L+j)/ps) pages. Per-request sums — independent
+        of batch composition by construction."""
+        res = wr = 0
+        for l in lens:
+            k = 0
+            while k * chunk < l:
+                k += 1
+                w = min(k * chunk, l)
+                wr += w
+                res += -(-w // ps) * ps
+            for j in range(1, N_NEW):
+                wr += l + j
+                res += -(-(l + j) // ps) * ps
+        return res, wr
+
     def test_kv_reserved_vs_written_pinned_mixed_lengths(
             self, batched_greedy):
-        """Acceptance: on the mixed-length workload the over-reservation
-        ratio matches the analytic value exactly. Both counters are
-        workload-deterministic — per-slot sums over each request's own
-        decode iterations, independent of batch composition: a request
-        of prompt length L decodes N_NEW-1 iterations with write head
-        L+k at iteration k, while its slot reserves the full budget."""
+        """Acceptance: with the paged allocator the reservation tracks
+        the write head to page granularity — the analytic pin AND the
+        headline: the ratio drops from the legacy ×4+ over-reservation
+        to < 1.5 on the same mixed-length workload. Both counters stay
+        workload-deterministic (per-request sums over each request's own
+        prefill-chunk and decode iterations)."""
         eng, by_uid = batched_greedy
+        exp_reserved, exp_written = self._paged_expectation(
+            PROMPT_LENS, eng.prefill_chunk, eng.page_size)
+        stats = eng.stats()
+        assert stats["kv_written_tokens"] == exp_written
+        assert stats["kv_reserved_tokens"] == exp_reserved
+        assert stats["kv_reserved_vs_written"] == exp_reserved / exp_written
+        assert stats["kv_reserved_vs_written"] < 1.5
+        # Pool-occupancy accounting: pages allocated per iteration are
+        # exactly reserved/page_size (only chunk-active or decoding
+        # slots hold pages), so the new gate metric is analytic too.
+        assert stats["kv_pages_allocated_iters"] \
+            == exp_reserved // eng.page_size
+        assert 0.0 < stats["page_pool_occupancy_mean"] <= 1.0
+
+    def test_legacy_kv_over_reservation_still_measured(self, legacy_greedy):
+        """The legacy path still reports the ×4+ over-reservation the
+        paged allocator reclaims — the before/after evidence pair."""
+        eng, _ = legacy_greedy
         iters = N_NEW - 1  # first token comes from prefill
         exp_written = sum(iters * l + iters * (iters + 1) // 2
                           for l in PROMPT_LENS)
@@ -405,10 +549,8 @@ class TestUtilizationAccounting:
         stats = eng.stats()
         assert stats["kv_written_tokens"] == exp_written
         assert stats["kv_reserved_tokens"] == exp_reserved
-        assert stats["kv_reserved_vs_written"] == exp_reserved / exp_written
-        # The whole point: max_len reservation over-provisions heavily
-        # on short mixed-length requests (budget 64 vs prompts 3..9+6).
         assert stats["kv_reserved_vs_written"] > 4.0
+        assert stats["page_pool_occupancy_mean"] == 0.0
 
     def test_admission_breakdown_and_occupancy(self, batched_greedy):
         eng, by_uid = batched_greedy

@@ -177,6 +177,10 @@ class TestServingTrace:
         assert names["prefill"] == 4
         assert names["first_token"] == 4
         assert names["decode"] >= 4  # per-slot + per-iteration spans
+        # Chunked prefill (paged engine default): each prompt fits one
+        # chunk here, so exactly one prefill_chunk span per request
+        # rides a slot track — the prefill/decode interleaving view.
+        assert names["prefill_chunk"] == 4
         assert names["request.arrival"] == 4
         assert names["finish:length"] == 4
         tracks = {e["args"]["name"] for e in events

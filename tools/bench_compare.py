@@ -67,9 +67,15 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     "tokens_emitted": ("higher", 0.0),
     # utilization accounting is workload-deterministic (per-slot sums,
     # batch-composition-independent): ANY drift is accounting breakage,
-    # not noise — a paged-KV rewrite changing it legitimately must
-    # update the baseline, which is the point of a gate
+    # not noise — the paged-KV rewrite changed it legitimately and
+    # refreshed the baseline, which is the point of a gate
     "kv_reserved_vs_written": ("both", 0.05),
+    # paged-KV pool accounting: allocated page-iterations are the same
+    # per-request-deterministic sums in page units (zero-drift like the
+    # token counters); pool occupancy divides by the iteration count,
+    # which breathes with host timing — gate it loosely, both ways
+    "kv_pages_allocated_iters": ("both", 0.0),
+    "page_pool_occupancy_mean": ("both", 0.75),
 }
 
 

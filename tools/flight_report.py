@@ -133,6 +133,12 @@ def render(summary: dict) -> str:
                 f"reserved {srv['kv_reserved_tokens']:.0f} token-iters  "
                 f"(over-reservation x{srv['kv_reserved_vs_written']:.2f})"
                 f"  |  slot occupancy {srv['slot_occupancy_mean']:.1%}")
+        # Paged-KV pool view (0 on the legacy contiguous path).
+        if srv.get("page_pool_occupancy_mean"):
+            add(f"    kv pages: pool occupancy "
+                f"{srv['page_pool_occupancy_mean']:.1%}  "
+                f"({srv.get('kv_pages_allocated_iters', 0)} "
+                f"page-iters allocated)")
         if srv.get("requests_finished") and "queue_wait_p50_ms" in srv:
             add(f"    admission: queue wait p50 "
                 f"{srv['queue_wait_p50_ms']:.1f} / p95 "

@@ -49,7 +49,18 @@ def add_argument() -> argparse.Namespace:
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--eos-id", type=int, default=None)
-    p.add_argument("--prefill-bucket", type=int, default=16)
+    p.add_argument("--kv-page-size", type=int, default=8,
+                   help="paged KV cache: pool page size in tokens; "
+                        "0 = legacy contiguous per-slot reservation "
+                        "(and legacy bucketed prefill)")
+    p.add_argument("--kv-pages", type=int, default=None,
+                   help="KV pool size in pages; default max_batch x "
+                        "ceil(budget/page) (no oversubscription)")
+    p.add_argument("--prefill-chunk", type=int, default=64,
+                   help="chunked prefill: prompt tokens prefilled per "
+                        "decode iteration (paged mode)")
+    p.add_argument("--prefill-bucket", type=int, default=16,
+                   help="LEGACY prefill bucketing (--kv-page-size 0)")
     # Tiny random-weight model (no checkpoint: this benches the ENGINE —
     # scheduling, prefill/decode latency — not model quality).
     p.add_argument("--vocab-size", type=int, default=256)
@@ -116,6 +127,9 @@ def main() -> int:
         max_batch=args.max_batch, max_len=args.max_len,
         max_new_tokens=args.max_new_tokens,
         temperature=args.temperature, eos_id=args.eos_id,
+        kv_page_size=args.kv_page_size or None,
+        kv_pages=args.kv_pages,
+        prefill_chunk=args.prefill_chunk,
         prefill_bucket=args.prefill_bucket, seed=args.seed), trace=trace)
 
     # Live telemetry plane: the measured window is scrapeable while it
@@ -139,16 +153,24 @@ def main() -> int:
                 for l in lens]
 
     if not args.no_warmup:
-        # Exercise every prefill bucket + the decode/admit programs on the
-        # measured engine itself (compiles are per-jit-closure, so a
-        # throwaway engine would not warm this one), then reset the
-        # telemetry window.
-        for lb in range(args.prefill_bucket, 2 * args.prompt_len - 1 +
-                        args.prefill_bucket, args.prefill_bucket):
-            lb = min(lb, engine.budget - 2)  # keep warm-ups admissible
-            engine.submit(rng.randint(0, args.vocab_size,
-                                      size=lb).astype(np.int32),
-                          max_new_tokens=2)
+        # Compile on the measured engine itself (compiles are
+        # per-jit-closure, so a throwaway engine would not warm this
+        # one), then reset the telemetry window. Paged mode has exactly
+        # two shapes — the fused chunk+decode step and the decode-only
+        # step — so two short requests cover them; legacy mode walks
+        # every prefill bucket.
+        if engine.paged:
+            for _ in range(2):
+                engine.submit(rng.randint(0, args.vocab_size,
+                                          size=2).astype(np.int32),
+                              max_new_tokens=2)
+        else:
+            for lb in range(args.prefill_bucket, 2 * args.prompt_len - 1 +
+                            args.prefill_bucket, args.prefill_bucket):
+                lb = min(lb, engine.budget - 2)  # keep warm-ups admissible
+                engine.submit(rng.randint(0, args.vocab_size,
+                                          size=lb).astype(np.int32),
+                              max_new_tokens=2)
         warm_tokens = sum(f.tokens.size for f in engine.run())
         engine.reset_stats()
         print(f"[serve_bench] warm-up done ({warm_tokens} tokens)",
