@@ -371,10 +371,20 @@ class ChaosConfig:
     # mid-write leaves, which latest_valid_epoch must skip.
     torn_ckpt_epoch: int | None = None
     torn_truncate_bytes: int = 64
+    # Tear-AFTER-commit: corrupt this epoch's save payload while
+    # keeping its COMMITTED marker and manifest — invisible to the
+    # marker scan, caught only by the checksum pass. The hot-swap
+    # watcher (serving/hotswap.py) must quarantine it at the verify
+    # stage instead of deploying it.
+    corrupt_ckpt_epoch: int | None = None
     # Probability (per distinct read key, seeded) that a data read
     # raises a ONE-SHOT transient ChaosIOError — the RetryPolicy on the
     # loaders must absorb it.
     data_error_rate: float = 0.0
+    # Same, for the hot-swap staging read: the swap attempt must be
+    # rejected with a typed SwapError (engine keeps its weights) and
+    # the next watcher poll must succeed.
+    swap_error_rate: float = 0.0
     # Inject a host-side stall of slow_step_ms every slow_step_every-th
     # step (straggler simulation; shows up as flight-recorder p95).
     slow_step_every: int | None = None
@@ -388,7 +398,9 @@ class ChaosConfig:
     def active(self) -> bool:
         return (self.kill_at_step is not None
                 or self.torn_ckpt_epoch is not None
+                or self.corrupt_ckpt_epoch is not None
                 or self.data_error_rate > 0
+                or self.swap_error_rate > 0
                 or self.slow_step_every is not None)
 
     def __post_init__(self):
@@ -400,6 +412,10 @@ class ChaosConfig:
             raise ValueError(
                 f"data_error_rate must be in [0, 1], got "
                 f"{self.data_error_rate}")
+        if not 0.0 <= self.swap_error_rate <= 1.0:
+            raise ValueError(
+                f"swap_error_rate must be in [0, 1], got "
+                f"{self.swap_error_rate}")
         if self.slow_step_every is not None and self.slow_step_every < 1:
             raise ValueError(
                 f"slow_step_every must be >= 1, got {self.slow_step_every}")

@@ -7,6 +7,13 @@ run used ``--ema-decay``, so the orbax opt-state tree round-trips),
 restore the requested/latest epoch, and pick raw or EMA params. Keeping
 it here means restore-contract changes (like the round-5 head-bias
 default flip this error message names) happen once, not per CLI.
+
+The tail of that sequence — restore epoch N into the template, pick raw
+or EMA params — is :func:`restore_params`, separated out so the live
+weight hot-swap watcher (``serving/hotswap.py``) can re-run it per
+newly committed checkpoint WITHOUT rebuilding the model, optimizer, or
+template state; :func:`build_lm_and_restorer` returns a closure over
+the template doing exactly that.
 """
 
 from __future__ import annotations
@@ -32,7 +39,31 @@ def moe_kwargs_from_flags(*, enabled: bool, num_experts, top_k: int,
     )
 
 
-def build_lm_and_restore(
+def restore_params(template_state: Any, checkpoint: str, epoch: int, *,
+                   use_ema: bool = False) -> Any:
+    """The restore TAIL of :func:`build_lm_and_restore`: restore
+    ``epoch`` into the prebuilt TEMPLATE train state and return the
+    serving params (EMA average or raw).
+
+    The hot-swap watcher (``serving/hotswap.py``) re-runs this per
+    newly committed checkpoint — one orbax read, no model/optimizer/
+    template rebuild. Verification runs before orbax touches the tree
+    (``restore_checkpoint``), so a torn/corrupt save raises the typed
+    ``CheckpointCorruptError``; a tree mismatch surfaces as whatever
+    orbax raises (the caller wraps it into its own vocabulary).
+    """
+    from distributed_training_tpu import checkpoint as ckpt_lib
+
+    restored, _, _ = ckpt_lib.restore_checkpoint(checkpoint, epoch,
+                                                 template_state)
+    if use_ema:
+        from distributed_training_tpu.train.optim import ema_params
+
+        return ema_params(restored.opt_state)
+    return restored.params
+
+
+def build_lm_and_restorer(
     *,
     vocab_size: int = 256,
     num_layers: int = 4,
@@ -49,9 +80,13 @@ def build_lm_and_restore(
     use_ema: bool = False,
     seed: int = 0,
     printer: Callable[[str], None] = print,
-) -> tuple[Any, Any, int]:
-    """Returns ``(model, params, epoch)``; ``epoch`` is -1 when no
-    checkpoint existed (params are then the seeded random init).
+) -> tuple[Any, Any, int, Callable[..., Any]]:
+    """Returns ``(model, params, epoch, restore_fn)``; ``epoch`` is -1
+    when no checkpoint existed (params are then the seeded random init).
+    ``restore_fn(epoch, directory=checkpoint)`` re-runs the restore
+    tail against the template state built here — the hot-swap staging
+    read (:class:`~distributed_training_tpu.serving.hotswap.HotSwapper`
+    takes it verbatim).
 
     Raises ``SystemExit`` with an actionable message on a tree-mismatch
     restore failure or an ``use_ema`` request without the matching
@@ -90,10 +125,13 @@ def build_lm_and_restore(
     )
     tx = make_optimizer(OptimizerConfig(ema_decay=ema_decay),
                         SchedulerConfig(), world_size=1)
-    state = init_train_state(
+    template = init_train_state(
         model, jax.random.PRNGKey(seed), (1, 8), tx,
         loss_scale=LossScaleState.create(precision),
         input_dtype=jax.numpy.int32)
+
+    def restore_fn(e: int, directory: str = checkpoint) -> Any:
+        return restore_params(template, directory, e, use_ema=use_ema)
 
     epoch = resume
     if epoch < 0:
@@ -104,7 +142,7 @@ def build_lm_and_restore(
         epoch = -1 if latest is None else latest
     if epoch >= 0:
         try:
-            state, _, _ = ckpt_lib.restore_checkpoint(checkpoint, epoch, state)
+            params = restore_fn(epoch)
         except ckpt_lib.CheckpointCorruptError:
             raise  # typed verdict already names the dir and remedy
         except Exception as e:
@@ -121,11 +159,20 @@ def build_lm_and_restore(
         printer(f"restored epoch {epoch} from {checkpoint}")
     else:
         printer("no checkpoint found; using the seeded random init")
+        if use_ema:
+            from distributed_training_tpu.train.optim import ema_params
 
-    params = state.params
+            params = ema_params(template.opt_state)
+        else:
+            params = template.params
     if use_ema:
-        from distributed_training_tpu.train.optim import ema_params
-
-        params = ema_params(state.opt_state)
         printer("sampling from EMA parameter average")
+    return model, params, epoch, restore_fn
+
+
+def build_lm_and_restore(**kwargs: Any) -> tuple[Any, Any, int]:
+    """:func:`build_lm_and_restorer` without the re-restorer — the
+    original ``(model, params, epoch)`` surface ``generate.py`` and the
+    non-watching ``serve.py`` path consume."""
+    model, params, epoch, _ = build_lm_and_restorer(**kwargs)
     return model, params, epoch

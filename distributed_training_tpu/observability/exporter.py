@@ -13,9 +13,10 @@ picture. This module is that plane, on stdlib ``http.server`` only:
   live scrape and ``flight_report.py --prometheus`` of the same run
   agree family-for-family.
 - ``GET /healthz`` — one small JSON object: liveness, the current run
-  phase (train step / eval / serving / draining / drained), uptime,
-  scrape count. 200 means "process alive and responding"; phase carries
-  the rest.
+  phase (train step / eval / serving / swapping / draining / drained),
+  uptime, scrape count, plus owner extras (the serving engine adds its
+  deployed ``weights_epoch`` and swap counters). 200 means "process
+  alive and responding"; phase carries the rest.
 - ``GET /vars`` — the full flight snapshot as strict JSON (the same
   dict a flight dump would write, minus the disk I/O).
 
@@ -73,9 +74,15 @@ class MetricsExporter:
 
     def __init__(self, snapshot_provider: Callable[[], dict], *,
                  port: int, host: str = "127.0.0.1",
-                 phase_provider: Callable[[], str] | None = None):
+                 phase_provider: Callable[[], str] | None = None,
+                 health_provider: Callable[[], dict] | None = None):
         self._provider = snapshot_provider
         self._phase = phase_provider or (lambda: "running")
+        # Optional owner-specific /healthz extras (the serving engine
+        # adds weights_epoch + swap counters so a rollout driver can
+        # confirm a live weight deploy from the health endpoint alone).
+        # Same scrape-safety contract: cached host-side state only.
+        self._health_extra = health_provider
         self._t0 = time.perf_counter()
         self.scrapes = 0  # /metrics GETs served (rides /healthz)
         exporter = self
@@ -130,12 +137,15 @@ class MetricsExporter:
                 body = prometheus_text(self._provider())
                 ctype = TEXT_CONTENT_TYPE
             elif path == "/healthz":
-                body = json.dumps({
+                payload = {
                     "status": "ok",
                     "phase": str(self._phase()),
                     "uptime_seconds": time.perf_counter() - self._t0,
                     "scrapes": self.scrapes,
-                }, allow_nan=False) + "\n"
+                }
+                if self._health_extra is not None:
+                    payload.update(self._health_extra())
+                body = json.dumps(payload, allow_nan=False) + "\n"
                 ctype = "application/json"
             elif path == "/vars":
                 # The full snapshot, strict JSON (the provider's dict is
@@ -175,11 +185,13 @@ def attach_engine(engine, port: int, *, component: str = "serve",
     """Attach a started exporter to a serving ``Engine`` — the one
     wiring both serving CLIs (``serve.py``, ``serve_bench.py``) share:
     snapshots from ``engine.flight_snapshot`` (never flushes, never
-    syncs), /healthz phase from ``engine.phase``
-    (serving → draining → drained)."""
+    syncs), /healthz phase from ``engine.phase`` (serving ⇄ swapping →
+    draining → drained) plus the hot-swap extras from ``engine.health``
+    (weights_epoch, swaps_completed/rejected)."""
     exporter = MetricsExporter(
         engine.flight_snapshot, port=port, host=host,
-        phase_provider=lambda: engine.phase).start()
+        phase_provider=lambda: engine.phase,
+        health_provider=engine.health).start()
     printer(f"[{component}] live metrics: {exporter.url('')} "
             f"(/metrics /healthz /vars)")
     return exporter

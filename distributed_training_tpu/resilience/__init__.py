@@ -34,12 +34,14 @@ from distributed_training_tpu.resilience.chaos import (  # noqa: F401
     ChaosIOError,
     ChaosMonkey,
     chaos_io_check,
+    corrupt_committed_checkpoint,
     tear_checkpoint,
 )
 from distributed_training_tpu.resilience.errors import (  # noqa: F401
     CheckpointCorruptError,
     DrainingError,
     QueueFullError,
+    SwapError,
 )
 from distributed_training_tpu.resilience.retry import (  # noqa: F401
     RetryPolicy,

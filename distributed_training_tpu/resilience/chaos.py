@@ -14,6 +14,15 @@ This harness makes every fault a first-class, deterministic test input:
   truncate its largest array file and remove the ``COMMITTED`` marker:
   byte-for-byte what a crash mid-write leaves behind, which
   ``latest_valid_epoch`` must skip.
+- **tear-after-commit** — corrupt a committed save's largest payload
+  file while KEEPING the marker and manifest: bit rot / a buggy copy
+  landing after a successful commit, invisible to the marker scan and
+  caught only by the manifest checksum pass — the fault the hot-swap
+  watcher's verify stage (``serving/hotswap.py``) must refuse.
+- **staging-read I/O fault** — a seeded one-shot :class:`ChaosIOError`
+  from inside the hot-swap staging read (``swap_error_rate``): the
+  attempt is rejected with a typed ``SwapError``, the engine keeps its
+  weights, the next poll retries.
 - **transient data-I/O errors** — a seeded, per-key one-shot
   :class:`ChaosIOError` raised from inside the data loaders' read path,
   which the :class:`~distributed_training_tpu.resilience.retry.
@@ -36,12 +45,28 @@ import zlib
 
 from distributed_training_tpu.resilience.verify import (
     COMMIT_NAME,
-    MANIFEST_NAME,
+    is_manifest_name as _is_manifest,
 )
 
 
 class ChaosIOError(OSError):
     """An injected transient I/O fault (retryable by construction)."""
+
+
+def _largest_payload_file(path: str) -> str:
+    """The deterministically-chosen victim of a checkpoint fault: the
+    largest non-manifest file (lexicographic tiebreak)."""
+    victims = []
+    for dirpath, _, files in os.walk(path):
+        for name in files:
+            if name == COMMIT_NAME or _is_manifest(name):
+                continue
+            p = os.path.join(dirpath, name)
+            victims.append((-os.path.getsize(p), os.path.relpath(p, path), p))
+    if not victims:
+        raise FileNotFoundError(f"no checkpoint files to tear at {path}")
+    victims.sort()  # largest first, lexicographic tiebreak: deterministic
+    return victims[0][2]
 
 
 def tear_checkpoint(path: str, truncate_bytes: int = 64) -> str:
@@ -50,23 +75,33 @@ def tear_checkpoint(path: str, truncate_bytes: int = 64) -> str:
     marker (a real crash dies before the marker, which is written last).
     Returns the truncated file's path. Also used by the CI chaos smoke.
     """
-    victims = []
-    for dirpath, _, files in os.walk(path):
-        for name in files:
-            if name in (MANIFEST_NAME, COMMIT_NAME):
-                continue
-            p = os.path.join(dirpath, name)
-            victims.append((-os.path.getsize(p), os.path.relpath(p, path), p))
-    if not victims:
-        raise FileNotFoundError(f"no checkpoint files to tear at {path}")
-    victims.sort()  # largest first, lexicographic tiebreak: deterministic
-    _, _, victim = victims[0]
+    victim = _largest_payload_file(path)
     size = os.path.getsize(victim)
     with open(victim, "r+b") as fh:
         fh.truncate(min(truncate_bytes, max(size - 1, 0)))
     marker = os.path.join(path, COMMIT_NAME)
     if os.path.exists(marker):
         os.remove(marker)
+    return victim
+
+
+def corrupt_committed_checkpoint(path: str, flip_bytes: int = 64) -> str:
+    """Tear-AFTER-commit: flip the leading bytes of the save's largest
+    payload file while leaving the manifest and the ``COMMITTED`` marker
+    intact — bit rot or a buggy copy that lands *after* a successful
+    commit. Invisible to the marker scan, caught by the manifest
+    checksum pass (``verify_checkpoint`` reason ``"checksum"``) — which
+    is exactly the gate the hot-swap watcher stages candidates through
+    (``serving/hotswap.py``). Returns the corrupted file's path."""
+    victim = _largest_payload_file(path)
+    n = min(flip_bytes, os.path.getsize(victim))
+    if n < 1:
+        raise FileNotFoundError(
+            f"largest payload file of {path} is empty; nothing to corrupt")
+    with open(victim, "r+b") as fh:
+        buf = fh.read(n)
+        fh.seek(0)
+        fh.write(bytes(b ^ 0xFF for b in buf))
     return victim
 
 
@@ -88,8 +123,9 @@ class ChaosMonkey:
         self.trace = trace
         self._killed = False
         self._torn = False
+        self._corrupted = False
         self._io_failed: set[str] = set()
-        self.counters = {"kills": 0, "torn_ckpts": 0,
+        self.counters = {"kills": 0, "torn_ckpts": 0, "corrupt_ckpts": 0,
                          "io_faults": 0, "slow_steps": 0}
 
     def _mark(self, name: str, **attrs) -> None:
@@ -125,7 +161,12 @@ class ChaosMonkey:
     # -- checkpoint path -----------------------------------------------------
     def after_checkpoint_save(self, path: str, epoch: int) -> None:
         """Post-save hook (sync path and the async writer thread both
-        call it): tears the configured epoch's save exactly once."""
+        call it): tears or corrupts the configured epoch's save exactly
+        once. ``torn_ckpt_epoch`` leaves a torn UNCOMMITTED save (what a
+        mid-write crash leaves; auto-resume must fall back);
+        ``corrupt_ckpt_epoch`` leaves a checksum-failing COMMITTED save
+        (tear-after-commit — the swap-targeted fault the hot-swap
+        watcher's verify stage must catch)."""
         c = self.cfg
         if c.torn_ckpt_epoch is not None and epoch == c.torn_ckpt_epoch \
                 and not self._torn:
@@ -133,20 +174,31 @@ class ChaosMonkey:
             self.counters["torn_ckpts"] += 1
             self._mark("chaos.torn_ckpt", epoch=int(epoch))
             tear_checkpoint(path, c.torn_truncate_bytes)
+        if getattr(c, "corrupt_ckpt_epoch", None) is not None \
+                and epoch == c.corrupt_ckpt_epoch and not self._corrupted:
+            self._corrupted = True
+            self.counters["corrupt_ckpts"] += 1
+            self._mark("chaos.corrupt_ckpt", epoch=int(epoch))
+            corrupt_committed_checkpoint(path)
 
     # -- data I/O ------------------------------------------------------------
     def io_check(self, kind: str, key: str) -> None:
         """Raise a one-shot :class:`ChaosIOError` for ``key`` when the
         seeded coin says so — once per key, so a retry always succeeds
-        (the injected faults are transient by construction)."""
+        (the injected faults are transient by construction). Kinds:
+        ``"data"`` (loader reads, absorbed by the RetryPolicy) and
+        ``"swap"`` (hot-swap staging reads — the attempt is rejected
+        with a typed SwapError and the next watcher poll retries)."""
         c = self.cfg
-        if kind != "data" or c.data_error_rate <= 0:
+        rate = {"data": c.data_error_rate,
+                "swap": getattr(c, "swap_error_rate", 0.0)}.get(kind, 0.0)
+        if rate <= 0:
             return
         full = f"{c.seed}:{kind}:{key}"
         if full in self._io_failed:
             return
         if zlib.crc32(full.encode()) % 1_000_000 \
-                < int(c.data_error_rate * 1_000_000):
+                < int(rate * 1_000_000):
             self._io_failed.add(full)
             self.counters["io_faults"] += 1
             self._mark("chaos.io_fault", key=key)  # loader threads: safe

@@ -14,6 +14,10 @@ library raised five frames down. The hierarchy is deliberately shallow:
 - :class:`QueueFullError` — bounded-queue load shedding: the request
   queue is at ``max_queue_depth`` and sheds the submit instead of
   growing without bound.
+- :class:`SwapError` — a live weight hot-swap failed at some stage
+  (verification, staging read, tree validation, apply/rollback). The
+  serving engine keeps the old weights; the error records where the
+  candidate died.
 """
 
 from __future__ import annotations
@@ -44,3 +48,26 @@ class DrainingError(RuntimeError):
 class QueueFullError(RuntimeError):
     """The bounded request queue is full; the submit was shed instead of
     growing the queue (and its tail latency) without bound."""
+
+
+class SwapError(RuntimeError):
+    """A live weight hot-swap candidate was rejected (or a rollback had
+    nothing to arm). The engine is guaranteed to still be serving the
+    weights it served before the attempt — a swap either completes
+    atomically at an iteration boundary or leaves no trace on the hot
+    path.
+
+    Carries the pipeline ``stage`` where the candidate died
+    (``"verify"`` — checksum/commit verification failed, candidate
+    quarantined; ``"stage"`` — I/O or restore failure reading the
+    verified save; ``"validate"`` — restored tree mismatches the
+    serving model's structure/shapes/dtypes; ``"arm"`` / ``"rollback"``
+    — barrier-side refusals) and the candidate ``epoch`` (None when no
+    candidate was identified).
+    """
+
+    def __init__(self, message: str, *, stage: str = "swap",
+                 epoch: int | None = None):
+        super().__init__(message)
+        self.stage = stage
+        self.epoch = epoch

@@ -17,6 +17,18 @@ resume. Each save gets two extra artifacts inside its ``epoch_N`` dir:
   no marker, so scanners classify the save as uncommitted without
   reading a byte of array data.
 
+Multihost saves (``process_count > 1``) close the round-9 gap the
+single-file design left open ("no process can hash a peer's in-flight
+files"): each process writes ``MANIFEST.<p>.json`` hashing ONLY the
+files it owns — orbax's per-process ``ocdbt.process_<p>`` artifacts,
+with the shared metadata files owned by process 0 — and the master
+writes ``COMMITTED`` last, after every peer's manifest is visible.
+Verification merges the manifest family — independent of the READER's
+world size, so any process count can check any save — and requires it
+complete: each per-process manifest records the saving world size, and
+a missing member means that process's payload is unprovable (rejected
+as torn). Single-process behavior is bit-identical to round 9.
+
 :func:`verify_checkpoint` is the single validity oracle: committed +
 manifest-consistent ⇒ valid; manifest-less dirs from before this round
 are accepted when they carry a recognizable orbax structure (legacy
@@ -30,6 +42,8 @@ from __future__ import annotations
 
 import json
 import os
+import re
+import time
 import zlib
 from typing import Any
 
@@ -39,6 +53,28 @@ from distributed_training_tpu.resilience.retry import RetryPolicy
 MANIFEST_NAME = "MANIFEST.json"
 COMMIT_NAME = "COMMITTED"
 MANIFEST_VERSION = 1
+
+# Single-process MANIFEST.json plus the multihost per-process
+# MANIFEST.<p>.json family (and their .tmp staging names).
+_MANIFEST_RE = re.compile(r"^MANIFEST(\.\d+)?\.json(\.tmp)?$")
+# Orbax writes each process's array shards under per-process
+# subdirectories (`ocdbt.process_<p>/...`); that marker is the ownership
+# partition per-process manifests hash along.
+_PROCESS_DIR_RE = re.compile(r"(?:^|/|\\)ocdbt\.process_(\d+)(?:/|\\|$)")
+
+
+def is_manifest_name(name: str) -> bool:
+    """True for manifest artifacts (any process), which describe the
+    save rather than being part of it."""
+    return bool(_MANIFEST_RE.match(os.path.basename(name)))
+
+
+def manifest_name(process_index: int = 0, process_count: int = 1) -> str:
+    """``MANIFEST.json`` single-process (bit-identical legacy layout),
+    ``MANIFEST.<p>.json`` per process otherwise."""
+    if process_count == 1:
+        return MANIFEST_NAME
+    return f"MANIFEST.{int(process_index)}.json"
 
 # Orbax entry files across the supported versions (0.7 ocdbt layout,
 # older aggregate-file layouts, newer metadata layouts): a manifest-less
@@ -68,10 +104,25 @@ def _walk_files(root: str) -> dict[str, str]:
         for name in files:
             p = os.path.join(dirpath, name)
             rel = os.path.relpath(p, root)
-            if rel in (MANIFEST_NAME, COMMIT_NAME):
+            if rel == COMMIT_NAME or is_manifest_name(rel):
                 continue
             out[rel] = p
     return out
+
+
+def _owned_by(rel: str, process_index: int, process_count: int) -> bool:
+    """The per-process manifest ownership partition: a file under an
+    orbax ``ocdbt.process_<q>`` directory belongs to process ``q``;
+    everything else (top-level metadata, aggregate files — written by
+    the save coordinator) belongs to process 0. Every file has exactly
+    one owner, so the union of all per-process manifests covers the
+    whole save with no double hashing of in-flight peer bytes."""
+    if process_count == 1:
+        return True
+    m = _PROCESS_DIR_RE.search(rel)
+    if m is not None:
+        return int(m.group(1)) == process_index
+    return process_index == 0
 
 
 def leaf_checksums(tree: Any, prefix: str = "") -> dict[str, list]:
@@ -92,33 +143,72 @@ def leaf_checksums(tree: Any, prefix: str = "") -> dict[str, list]:
     return {prefix.rstrip("/"): [crc, str(arr.dtype), list(arr.shape)]}
 
 
-def write_manifest(path: str, leaves: dict[str, list] | None = None) -> None:
+def write_manifest(path: str, leaves: dict[str, list] | None = None, *,
+                   process_index: int = 0, process_count: int = 1,
+                   peer_wait_s: float = 120.0) -> None:
     """Manifest + atomic COMMITTED marker for a completed orbax save at
     ``path``. Call only after the save fully returned — the marker's
-    meaning IS "everything before me is on disk"."""
-    files = {rel: [os.path.getsize(p), _crc_file(p)]
-             for rel, p in sorted(_walk_files(path).items())}
+    meaning IS "everything before me is on disk".
 
-    def _write():
+    Single-process (the default): bit-identical to the round-9 layout —
+    one ``MANIFEST.json`` over every file, marker written last.
+
+    Multihost (``process_count > 1``): this process hashes ONLY the
+    files it owns (see :func:`_owned_by`) into ``MANIFEST.<p>.json`` —
+    hashing a peer's files would race its still-flushing writes and
+    record checksums of in-flight bytes. Process 0 writes ``COMMITTED``
+    last, after polling (up to ``peer_wait_s``) for every peer's
+    manifest: a save whose peers never manifested stays uncommitted,
+    which downstream scanners already treat as torn — fail safe, not
+    fail silent.
+    """
+    name = manifest_name(process_index, process_count)
+    files = {rel: [os.path.getsize(p), _crc_file(p)]
+             for rel, p in sorted(_walk_files(path).items())
+             if _owned_by(rel, process_index, process_count)}
+
+    def _write_manifest():
         manifest = {"manifest_version": MANIFEST_VERSION, "files": files,
                     "leaves": leaves or {}}
-        tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+        if process_count > 1:
+            manifest["process_index"] = int(process_index)
+            manifest["process_count"] = int(process_count)
+        tmp = os.path.join(path, name + ".tmp")
         with open(tmp, "w") as fh:
             json.dump(manifest, fh, indent=1, sort_keys=True)
-        os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+        os.replace(tmp, os.path.join(path, name))
+
+    def _write_marker():
         tmp = os.path.join(path, COMMIT_NAME + ".tmp")
         with open(tmp, "w") as fh:
             fh.write("")  # presence is the contract, content is not
         os.replace(tmp, os.path.join(path, COMMIT_NAME))
 
-    _MANIFEST_IO_RETRY.call(_write)
+    _MANIFEST_IO_RETRY.call(_write_manifest)
+    if process_index != 0:
+        return
+    if process_count > 1:
+        deadline = time.monotonic() + peer_wait_s
+        missing = [q for q in range(1, process_count)
+                   if not os.path.isfile(
+                       os.path.join(path, manifest_name(q, process_count)))]
+        while missing and time.monotonic() < deadline:
+            time.sleep(0.05)
+            missing = [q for q in missing if not os.path.isfile(
+                os.path.join(path, manifest_name(q, process_count)))]
+        if missing:
+            import warnings
+
+            warnings.warn(
+                f"checkpoint at {path}: peer manifest(s) {missing} never "
+                f"appeared within {peer_wait_s}s; leaving the save "
+                f"UNCOMMITTED (scanners will treat it as torn)",
+                stacklevel=2)
+            return
+    _MANIFEST_IO_RETRY.call(_write_marker)
 
 
-def read_manifest(path: str) -> dict[str, Any] | None:
-    """The parsed manifest, or None when the save predates manifests."""
-    mpath = os.path.join(path, MANIFEST_NAME)
-    if not os.path.isfile(mpath):
-        return None
+def _parse_manifest(path: str, mpath: str) -> dict[str, Any]:
     try:
         with open(mpath) as fh:
             return json.load(fh)
@@ -127,6 +217,32 @@ def read_manifest(path: str) -> dict[str, Any] | None:
             f"checkpoint manifest {mpath} is unreadable ({e}); the save "
             f"is untrustworthy — quarantine the directory and resume "
             f"from an earlier epoch", path=path, reason="torn") from e
+
+
+def read_manifest(path: str) -> dict[str, Any] | None:
+    """The parsed single-process ``MANIFEST.json``, or None when the
+    save predates manifests (or is a multihost per-process-manifest
+    save — use :func:`read_manifests` for those)."""
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        return None
+    return _parse_manifest(path, mpath)
+
+
+def read_manifests(path: str) -> list[dict[str, Any]]:
+    """Every manifest present at ``path`` — the single
+    ``MANIFEST.json`` and/or the per-process ``MANIFEST.<p>.json``
+    family — parsed, sorted by filename. Empty when the save predates
+    manifests; an unreadable manifest raises the typed corruption
+    error (a save whose proof is garbage is untrustworthy)."""
+    if not os.path.isdir(path):
+        return []
+    out: list[dict[str, Any]] = []
+    for name in sorted(os.listdir(path)):
+        if not is_manifest_name(name) or name.endswith(".tmp"):
+            continue
+        out.append(_parse_manifest(path, os.path.join(path, name)))
+    return out
 
 
 def is_committed(path: str) -> bool:
@@ -141,9 +257,9 @@ def verify_checkpoint(path: str) -> None:
         raise CheckpointCorruptError(
             f"no checkpoint directory at {path}", path=path, reason="empty")
     files = _walk_files(path)
-    manifest = read_manifest(path)
+    manifests = read_manifests(path)
     committed = is_committed(path)
-    if manifest is None and not committed:
+    if not manifests and not committed:
         # Legacy (pre-manifest) save: restorable iff it carries a
         # recognizable orbax structure.
         if any(m in files or os.path.isdir(os.path.join(path, m))
@@ -163,13 +279,53 @@ def verify_checkpoint(path: str) -> None:
             f"Remedy: resume from an earlier epoch; auto_resume does this "
             f"fallback automatically and quarantines the directory",
             path=path, reason="uncommitted")
-    if manifest is None:
+    if not manifests:
         raise CheckpointCorruptError(
             f"checkpoint at {path} carries a {COMMIT_NAME} marker but no "
             f"{MANIFEST_NAME} — the save artifacts were tampered with or "
             f"partially deleted. Remedy: resume from an earlier epoch",
             path=path, reason="torn")
-    want = manifest.get("files", {})
+    # Merge every manifest present (the single MANIFEST.json, or the
+    # multihost MANIFEST.<p>.json family). The ownership partition makes
+    # entries disjoint by construction; two manifests disagreeing about
+    # one file means the save was assembled from mismatched worlds —
+    # corrupt. Multihost manifests record the saving world size, and the
+    # full family must be present: a missing MANIFEST.<p>.json would
+    # leave process p's payload entirely unchecked, so bit rot there
+    # would verify clean — the same partial-delete the single-manifest
+    # path rejects above.
+    want: dict[str, list] = {}
+    counts: set[int] = set()
+    present: set[int] = set()
+    for m in manifests:
+        if "process_count" in m:
+            counts.add(int(m["process_count"]))
+            present.add(int(m.get("process_index", 0)))
+        for rel, entry in m.get("files", {}).items():
+            if rel in want and list(want[rel]) != list(entry):
+                raise CheckpointCorruptError(
+                    f"checkpoint at {path}: manifests disagree about "
+                    f"{rel!r} ({want[rel]} vs {entry}) — the save was "
+                    f"assembled from mismatched processes. Remedy: "
+                    f"resume from an earlier epoch",
+                    path=path, reason="torn")
+            want[rel] = entry
+    if counts:
+        if len(counts) > 1:
+            raise CheckpointCorruptError(
+                f"checkpoint at {path}: per-process manifests disagree "
+                f"about the saving world size ({sorted(counts)}) — the "
+                f"save was assembled from mismatched processes. Remedy: "
+                f"resume from an earlier epoch",
+                path=path, reason="torn")
+        missing = sorted(set(range(counts.pop())) - present)
+        if missing:
+            raise CheckpointCorruptError(
+                f"checkpoint at {path} is missing per-process "
+                f"manifest(s) for process(es) {missing} — those "
+                f"processes' payload files cannot be verified (a "
+                f"partial delete or tampering). Remedy: resume from an "
+                f"earlier epoch", path=path, reason="torn")
     for rel, (size, crc) in sorted(want.items()):
         p = files.get(rel)
         if p is None:

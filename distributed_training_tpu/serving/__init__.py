@@ -23,6 +23,12 @@ restated for XLA's static-shape world:
   (reserved-vs-written cache positions, queue-wait vs prefill breakdown,
   admission-blocked time) — live-scrapeable via ``--metrics-port``
   (``observability/exporter.py``).
+- :mod:`hotswap` — zero-drain live weight hot-swap: a watcher streams
+  newly COMMITTED checkpoints through the resilience verification path
+  into the running engine at a decode-iteration boundary (in-flight
+  requests keep their KV pages); torn/corrupt candidates are
+  quarantined and never touch the engine, and ``Engine.rollback()``
+  re-arms the previous weights.
 
 Surfaces: ``gpt/jax_tpu/serve.py`` (interactive/file serving CLI) and
 ``tools/serve_bench.py`` (Poisson load generator). See docs/SERVING.md.
@@ -31,8 +37,13 @@ Surfaces: ``gpt/jax_tpu/serve.py`` (interactive/file serving CLI) and
 from distributed_training_tpu.resilience.errors import (  # noqa: F401
     DrainingError,
     QueueFullError,
+    SwapError,
 )
 from distributed_training_tpu.serving.engine import Engine  # noqa: F401
+from distributed_training_tpu.serving.hotswap import (  # noqa: F401
+    HotSwapper,
+    committed_epochs,
+)
 from distributed_training_tpu.serving.metrics import ServeTelemetry  # noqa: F401
 from distributed_training_tpu.serving.pages import (  # noqa: F401
     NULL_PAGE,

@@ -54,6 +54,7 @@ utilization) flows through the round-7 flight recorder via
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any
 
@@ -71,6 +72,7 @@ from distributed_training_tpu.inference.sampler import (
 )
 from distributed_training_tpu.models.gpt import init_decode_cache
 from distributed_training_tpu.parallel.ring_attention import PagedKV
+from distributed_training_tpu.resilience.errors import SwapError
 from distributed_training_tpu.serving.metrics import ServeTelemetry
 from distributed_training_tpu.serving.pages import PagePool, pages_for
 from distributed_training_tpu.serving.queue import RequestQueue
@@ -101,7 +103,7 @@ class Engine:
     """
 
     def __init__(self, model: Any, params: Any, cfg: ServeConfig, *,
-                 trace=None):
+                 trace=None, weights_epoch: int = -1):
         check_unsharded(model)
         self.cfg = cfg
         self.trace = trace
@@ -112,6 +114,27 @@ class Engine:
                 f"plus a generated token")
         self.paged = cfg.kv_page_size is not None
         self.params = params
+        # Live weight hot-swap state (serving/hotswap.py). The engine
+        # serves exactly one params version at a time; a staged
+        # candidate waits under the lock until the next iteration
+        # boundary applies it (never mid-iteration — the compiled step
+        # already holds its params argument). The abstract tree pinned
+        # here at construction is the validation oracle every candidate
+        # must match: same structure, shapes, dtypes ⇒ the compiled
+        # programs accept the new tree without a retrace.
+        self.weights_epoch = int(weights_epoch)
+        self._params_abstract = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                           jnp.result_type(a)), params)
+        self._swap_lock = threading.Lock()
+        self._pending_swap: tuple[Any, int] | None = None
+        # Rollback insurance: the previously served tree survives one
+        # swap (params are inference-sized; one extra copy is the cost
+        # of re-arming the last known-good weights without touching
+        # disk).
+        self._prev_params: Any = None
+        self._prev_epoch: int = -1
+        self.last_swap_error: SwapError | None = None
         self.sample_cfg = SampleConfig(
             max_new_tokens=cfg.max_new_tokens,
             temperature=cfg.temperature, top_k=cfg.top_k, top_p=cfg.top_p,
@@ -432,11 +455,122 @@ class Engine:
                                uid=req.uid, t_arrival=req.arrival_t,
                                t_first_token=t)
 
+    # -- live weight hot-swap (serving/hotswap.py drives this) ---------------
+    def validate_swap(self, params: Any, *, stage: str = "validate",
+                      epoch: int | None = None) -> None:
+        """Raise :class:`SwapError` unless ``params`` is a tree the
+        compiled programs can serve in place of the current weights:
+        identical structure, leaf shapes, and dtypes (anything else
+        would retrace — or worse, silently reinterpret — mid-flight).
+        Runs off the hot path (staging thread / arm call)."""
+        candidate = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                           jnp.result_type(a)), params)
+        if candidate != self._params_abstract:
+            want = jax.tree_util.tree_structure(self._params_abstract)
+            got = jax.tree_util.tree_structure(candidate)
+            detail = (f"tree structure {got} != serving {want}"
+                      if got != want else
+                      "leaf shapes/dtypes differ from the serving model")
+            raise SwapError(
+                f"swap candidate does not match the serving model's "
+                f"parameter tree ({detail}); the engine keeps its "
+                f"current weights (epoch {self.weights_epoch})",
+                stage=stage, epoch=epoch)
+
+    def arm_swap(self, params: Any, *, epoch: int) -> None:
+        """Stage validated weights for the next iteration boundary
+        (thread-safe; the hot-swap watcher calls this from its own
+        thread). The live engine is untouched until :meth:`step` applies
+        the swap; arming again before that replaces the earlier
+        candidate (newest wins). Raises :class:`SwapError`
+        (``stage="arm"``) on a tree/shape/dtype mismatch."""
+        self.validate_swap(params, stage="arm", epoch=epoch)
+        with self._swap_lock:
+            self._pending_swap = (params, int(epoch))
+        if self.trace is not None:
+            self.trace.instant("swap.armed", track="engine",
+                               epoch=int(epoch))
+
+    def rollback(self) -> int:
+        """Re-arm the previously served weights (the last completed
+        swap's predecessor) — the recovery lever when a deployed
+        checkpoint turns out bad downstream of every mechanical check.
+        Returns the re-armed epoch; raises :class:`SwapError`
+        (``stage="rollback"``) when no swap has completed.
+
+        The ``(_prev_params, _prev_epoch)`` pair is snapshotted under
+        the swap lock: the barrier mutates both on the engine thread,
+        and an unlocked read racing it could pair new params with a
+        stale epoch label — or re-arm the very weights being backed
+        out. (Snapshot-then-arm, not arm-under-lock: ``arm_swap`` takes
+        the same non-reentrant lock.)"""
+        with self._swap_lock:
+            prev_params, prev_epoch = self._prev_params, self._prev_epoch
+        if prev_params is None:
+            raise SwapError(
+                "nothing to roll back to: no weight swap has completed "
+                "on this engine", stage="rollback")
+        self.arm_swap(prev_params, epoch=prev_epoch)
+        return prev_epoch
+
+    def note_swap_rejected(self, err: SwapError) -> None:
+        """Record a swap attempt that died in the pipeline (verify /
+        stage / validate / arm). Telemetry + trace only — the engine is
+        guaranteed untouched, still serving its current weights."""
+        self.last_swap_error = err
+        self.telemetry.on_swap_rejected()
+        if self.trace is not None:
+            self.trace.instant("swap.rejected", track="engine",
+                               stage=err.stage,
+                               epoch=-1 if err.epoch is None
+                               else int(err.epoch))
+
+    def _install_params(self, params: Any) -> None:
+        """The barrier's only hot-path work: point the compiled programs
+        at the staged tree. Same shapes/dtypes (validated at arm), so
+        no retrace — the next dispatch just binds a different argument."""
+        self.params = params
+
+    def _apply_pending_swap(self) -> None:
+        """Iteration-boundary swap barrier: apply a staged candidate, if
+        any. In-flight requests keep their slots, KV pages, and RNG
+        streams and continue on the new weights; the pause is billed to
+        ``swap_blocked_s`` (and compensated out of the in-flight
+        requests' TPOT), and the surrounding iteration delta is gap-
+        excluded from the decode step-time percentiles — deployment cost
+        is attributed explicitly, never smeared into serving SLAs."""
+        t0 = time.perf_counter()
+        # One lock section for handoff + install: the (_prev_params,
+        # _prev_epoch) pair and weights_epoch must mutate atomically
+        # with respect to rollback()'s snapshot on the watcher thread.
+        with self._swap_lock:
+            pending, self._pending_swap = self._pending_swap, None
+            if pending is None:
+                return
+            params, epoch = pending
+            self._prev_params = self.params
+            self._prev_epoch = self.weights_epoch
+            self._install_params(params)
+            self.weights_epoch = int(epoch)
+        dt = time.perf_counter() - t0
+        self.telemetry.recorder.mark_gap()
+        self.telemetry.on_swap_applied(dt)
+        for seq in self.scheduler.active():
+            if seq.first_token_t is not None:
+                seq.swap_pause_s += dt
+        if self.trace is not None:
+            self.trace.instant("swap.applied", track="engine",
+                               epoch=int(epoch), blocked_ms=dt * 1e3,
+                               inflight=self.scheduler.num_active)
+
     def step(self) -> list[FinishedRequest]:
-        """One engine iteration: admit(+chunk-prefill), decode, evict.
+        """One engine iteration: swap barrier, admit(+chunk-prefill),
+        decode, evict.
 
         Returns the requests that finished this iteration. Safe to call
         when idle (records an excluded gap and returns [])."""
+        self._apply_pending_swap()
         return self._step_paged() if self.paged else self._step_legacy()
 
     def _step_paged(self) -> list[FinishedRequest]:
@@ -734,12 +868,29 @@ class Engine:
     @property
     def phase(self) -> str:
         """Coarse lifecycle phase for the /healthz endpoint:
-        serving → draining → drained (idle = alive, nothing queued)."""
+        serving ⇄ swapping → draining → drained (idle = alive, nothing
+        queued). ``swapping`` = a staged weight candidate is armed and
+        waiting for the next iteration boundary to apply it — the window
+        a rollout driver sees between arming and the barrier."""
         if self._drained:
             return "drained"
         if self.queue.closed:
             return "draining"
+        with self._swap_lock:
+            if self._pending_swap is not None:
+                return "swapping"
         return "idle" if self.idle else "serving"
+
+    def health(self) -> dict[str, Any]:
+        """Hot-swap-aware extras for the exporter's /healthz payload:
+        the deployed weights epoch and swap counters ride alongside
+        ``phase`` so a rollout driver can confirm (or abort) a deploy
+        from the health endpoint alone, without parsing /metrics."""
+        return {
+            "weights_epoch": int(self.weights_epoch),
+            "swaps_completed": self.telemetry.swaps_completed,
+            "swaps_rejected": self.telemetry.swaps_rejected,
+        }
 
     # -- telemetry surface ---------------------------------------------------
     def stats(self) -> dict[str, Any]:
@@ -759,6 +910,9 @@ class Engine:
         stats["requests_shed"] = self.queue.shed
         stats["requests_drain_rejected"] = self.queue.drain_rejected
         stats["drained"] = bool(self._drained)
+        # Live weight hot-swap: the deployed epoch joins the telemetry's
+        # swaps_completed/swaps_rejected/swap_blocked_s counters.
+        stats["weights_epoch"] = int(self.weights_epoch)
         return stats
 
     def reset_stats(self) -> None:

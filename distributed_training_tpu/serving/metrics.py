@@ -91,6 +91,15 @@ class ServeTelemetry:
         self.page_iters_allocated = 0
         self.page_iters_total = 0
         self.admission_blocked_s = 0.0
+        # Live weight hot-swap accounting (serving/hotswap.py): applied
+        # and rejected swap attempts, and the wall-time swap barriers
+        # blocked the decode loop. The pause is billed HERE, not to the
+        # TPOT samples or the decode step-time percentiles (the engine
+        # marks a recorder gap at the barrier), the same attribution
+        # discipline admission_blocked_s applies to head-of-line time.
+        self.swaps_completed = 0
+        self.swaps_rejected = 0
+        self.swap_blocked_s = 0.0
         self.tokens_emitted = 0
         self.requests_finished = 0
         self.finish_reasons: dict[str, int] = {}
@@ -171,6 +180,18 @@ class ServeTelemetry:
         every decode slot was busy (head-of-line blocking)."""
         self.admission_blocked_s += max(float(seconds), 0.0)
 
+    def on_swap_applied(self, blocked_s: float) -> None:
+        """One live weight swap landed at an iteration boundary;
+        ``blocked_s`` is the barrier's wall time (validate + pointer
+        assign — staging already happened off the hot path)."""
+        self.swaps_completed += 1
+        self.swap_blocked_s += max(float(blocked_s), 0.0)
+
+    def on_swap_rejected(self) -> None:
+        """A swap candidate died somewhere in the pipeline (verify /
+        stage / validate / arm); the engine kept its old weights."""
+        self.swaps_rejected += 1
+
     def on_finished(self, fin: FinishedRequest) -> None:
         self.requests_finished += 1
         self.finish_reasons[fin.finish_reason] = \
@@ -247,6 +268,11 @@ class ServeTelemetry:
             "prefill_p50_ms": pct(self.prefill_ms, 50),
             "prefill_p95_ms": pct(self.prefill_ms, 95),
             "admission_blocked_s": self.admission_blocked_s,
+            # Live weight hot-swap (serving/hotswap.py): deployment
+            # counters + the explicitly-attributed barrier pause.
+            "swaps_completed": self.swaps_completed,
+            "swaps_rejected": self.swaps_rejected,
+            "swap_blocked_s": self.swap_blocked_s,
         }
 
     def _serving_section(self, stats: dict[str, Any] | None

@@ -61,6 +61,13 @@ class ActiveSequence:
     # prefill_pos reaches the prompt length AND its first token landed;
     # until then it occupies its slot as "prefilling".
     prefill_pos: int = 0
+    # Wall-time a live weight hot-swap barrier blocked this sequence's
+    # decode between two of its tokens (serving/hotswap.py). Billed to
+    # the engine-level swap_blocked_s stat and SUBTRACTED from the
+    # request's TPOT: TPOT reports decode compute per token, and the
+    # swap pause is deployment cost the engine attributes explicitly
+    # rather than smearing over whichever requests were in flight.
+    swap_pause_s: float = 0.0
 
     @property
     def prefilling(self) -> bool:
@@ -136,7 +143,10 @@ class FinishedRequest:
         n = len(seq.tokens)
         tpot = None
         if n > 1:
-            tpot = (seq.last_token_t - seq.first_token_t) * 1e3 / (n - 1)
+            span_s = max(
+                seq.last_token_t - seq.first_token_t - seq.swap_pause_s,
+                0.0)
+            tpot = span_s * 1e3 / (n - 1)
         # A deadline eviction can now land mid-prefill (chunked prefill
         # holds a slot across iterations): no first token, no TTFT
         # sample — same contract as a queue-side timeout.

@@ -79,14 +79,28 @@ def add_argument() -> argparse.Namespace:
                         help="evict requests still decoding past this "
                              "total deadline (partial tokens returned, "
                              "finish reason 'timeout')")
+    # Live weight hot-swap (docs/SERVING.md "Live weight hot-swap").
+    parser.add_argument("--watch-ckpt-dir", type=str, default=None,
+                        help="zero-drain continuous deployment: watch "
+                             "this checkpoint directory and hot-swap "
+                             "each newly COMMITTED epoch into the "
+                             "running engine at a decode-iteration "
+                             "boundary (verified staging; torn/corrupt "
+                             "candidates are quarantined and never "
+                             "touch the engine). SIGHUP triggers one "
+                             "immediate poll; SIGUSR1 re-arms the "
+                             "previously served weights (rollback)")
+    parser.add_argument("--watch-interval", type=float, default=2.0,
+                        help="seconds between checkpoint-watcher polls")
     parser.add_argument("--flight-dump", type=str, default=None,
                         help="write a flight-recorder JSON here at exit "
                              "(tools/flight_report.py renders it)")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="live telemetry plane: /metrics (Prometheus "
                              "text, incl. TTFT/TPOT histograms + KV/slot "
-                             "utilization), /healthz (serving/draining/"
-                             "drained phase) and /vars, scrapeable while "
+                             "utilization), /healthz (serving/swapping/"
+                             "draining/drained phase + weights_epoch and "
+                             "swap counters) and /vars, scrapeable while "
                              "the engine serves (loopback; 0 = ephemeral)")
     parser.add_argument("--trace", action=argparse.BooleanOptionalAction,
                         default=False,
@@ -138,7 +152,7 @@ def main() -> int:
 
     from distributed_training_tpu.config import ServeConfig
     from distributed_training_tpu.inference.restore import (
-        build_lm_and_restore,
+        build_lm_and_restorer,
         moe_kwargs_from_flags,
     )
     from distributed_training_tpu.inference.sampler import CacheBudgetError
@@ -146,6 +160,7 @@ def main() -> int:
     from distributed_training_tpu.serving import (
         DrainingError,
         Engine,
+        HotSwapper,
         QueueFullError,
     )
 
@@ -154,7 +169,7 @@ def main() -> int:
         top_k=args.moe_top_k, min_capacity=args.min_capacity,
         mlp_type=args.mlp_type)
 
-    model, params, _ = build_lm_and_restore(
+    model, params, restored_epoch, restore_fn = build_lm_and_restorer(
         vocab_size=args.vocab_size,
         num_layers=args.num_layers,
         num_heads=args.num_heads,
@@ -195,7 +210,36 @@ def main() -> int:
         ttft_deadline_ms=args.ttft_deadline_ms,
         deadline_ms=args.deadline_ms,
         seed=args.seed,
-    ), trace=trace)
+    ), trace=trace, weights_epoch=restored_epoch)
+
+    # Zero-drain live weight hot-swap (docs/SERVING.md): a background
+    # watcher streams newly COMMITTED epochs from --watch-ckpt-dir
+    # through the resilience verification path into the running engine.
+    # SIGHUP wakes the watcher for one immediate poll; SIGUSR1 asks the
+    # watcher thread to re-arm the previously served weights (rollback).
+    # Both handlers only set events — signal-safe: the rollback itself
+    # takes the engine's swap lock, which the serving loop (this very
+    # thread) holds around the barrier, so it must run on the watcher
+    # thread, never on the signal frame.
+    swapper = None
+    if args.watch_ckpt_dir is not None:
+        import signal as signal_mod
+
+        watch_dir = args.watch_ckpt_dir
+        swapper = HotSwapper(
+            engine, watch_dir,
+            lambda e: restore_fn(e, watch_dir),
+            printer=lambda msg: print(msg, file=sys.stderr, flush=True))
+        swapper.start(interval_s=args.watch_interval)
+        if hasattr(signal_mod, "SIGHUP"):
+            signal_mod.signal(signal_mod.SIGHUP,
+                              lambda *_: swapper.trigger())
+        if hasattr(signal_mod, "SIGUSR1"):
+            signal_mod.signal(signal_mod.SIGUSR1,
+                              lambda *_: swapper.request_rollback())
+        print(f"[serve] hot-swap watcher on {watch_dir} "
+              f"(every {args.watch_interval:g}s; SIGHUP = poll now, "
+              f"SIGUSR1 = rollback)", file=sys.stderr, flush=True)
 
     # Live telemetry plane: scrape the engine while it serves. The
     # handler thread reads host-side telemetry the decode loop already
@@ -253,6 +297,12 @@ def main() -> int:
         if guard.triggered:
             print(f"[serve] SIGTERM: drained {len(done)} in-flight "
                   f"request(s), admission closed", file=sys.stderr)
+    if swapper is not None:
+        swapper.close()
+        print(f"[serve] hot-swap: {swapper.counters['armed']} armed / "
+              f"{swapper.counters['rejected']} rejected over "
+              f"{swapper.counters['polls']} polls; serving weights "
+              f"epoch {engine.weights_epoch}", file=sys.stderr)
 
     def decode_bytes(toks):
         return bytes(int(t) % 256 for t in toks).decode(

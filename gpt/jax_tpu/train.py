@@ -186,6 +186,13 @@ def add_chaos_arguments(parser: argparse.ArgumentParser) -> None:
                              "(torn write; auto-resume must fall back)")
     parser.add_argument("--chaos-torn-bytes", type=int, default=64,
                         help="bytes to leave in the torn file")
+    parser.add_argument("--chaos-corrupt-ckpt-epoch", type=int,
+                        default=None,
+                        help="tear-AFTER-commit: corrupt this epoch's "
+                             "save payload while keeping its COMMITTED "
+                             "marker (checksum-level bit rot; the "
+                             "hot-swap watcher's verify stage must "
+                             "quarantine it)")
     parser.add_argument("--chaos-data-error-rate", type=float, default=0.0,
                         help="seeded per-key probability of a one-shot "
                              "transient data-read error (the retry "
@@ -210,6 +217,7 @@ def chaos_config_from_flags(args: argparse.Namespace):
         kill_signal=args.chaos_kill_signal,
         torn_ckpt_epoch=args.chaos_torn_ckpt_epoch,
         torn_truncate_bytes=args.chaos_torn_bytes,
+        corrupt_ckpt_epoch=args.chaos_corrupt_ckpt_epoch,
         data_error_rate=args.chaos_data_error_rate,
         slow_step_every=args.chaos_slow_step_every,
         slow_step_ms=args.chaos_slow_step_ms,

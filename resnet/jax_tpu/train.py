@@ -201,6 +201,11 @@ def add_argument() -> argparse.Namespace:
                              "(truncate + drop COMMITTED; auto-resume "
                              "must fall back)")
     parser.add_argument("--chaos-torn-bytes", type=int, default=64)
+    parser.add_argument("--chaos-corrupt-ckpt-epoch", type=int,
+                        default=None,
+                        help="tear-AFTER-commit: corrupt this epoch's "
+                             "save payload, COMMITTED marker intact "
+                             "(checksum pass must catch it)")
     parser.add_argument("--chaos-data-error-rate", type=float, default=0.0,
                         help="seeded one-shot transient data-read faults "
                              "(the retry policy must absorb them)")
@@ -333,6 +338,7 @@ def build_config(args: argparse.Namespace):
             kill_signal=args.chaos_kill_signal,
             torn_ckpt_epoch=args.chaos_torn_ckpt_epoch,
             torn_truncate_bytes=args.chaos_torn_bytes,
+            corrupt_ckpt_epoch=args.chaos_corrupt_ckpt_epoch,
             data_error_rate=args.chaos_data_error_rate,
             slow_step_every=args.chaos_slow_step_every,
             slow_step_ms=args.chaos_slow_step_ms,

@@ -98,6 +98,25 @@ class TestVerdicts:
         assert bc.main([_write(tmp_path, "b.json", base),
                         _write(tmp_path, "c.json", cur)]) == 0
 
+    def test_swaps_rejected_zero_tolerance_from_zero_baseline(
+            self, bc, tmp_path):
+        """Hot-swap gate: swaps_rejected is not-allowed-to-grow even
+        from a zero baseline (the generic zero-baseline skip would
+        otherwise let rejection drift through unseen), while an equal
+        zero current stays clean and swaps_completed drift trips the
+        both-direction zero tolerance."""
+        base = dict(SLA, swaps_completed=1, swaps_rejected=0)
+        b = _write(tmp_path, "b.json", base)
+        clean = dict(base)
+        assert bc.main([b, _write(tmp_path, "ok.json", clean),
+                        "--only", "swaps_completed,swaps_rejected"]) == 0
+        rejected = dict(base, swaps_rejected=2)
+        assert bc.main([b, _write(tmp_path, "rej.json", rejected),
+                        "--only", "swaps_rejected"]) == 1
+        lost_swap = dict(base, swaps_completed=0)
+        assert bc.main([b, _write(tmp_path, "lost.json", lost_swap),
+                        "--only", "swaps_completed"]) == 1
+
     def test_json_output_machine_readable(self, bc, tmp_path, capsys):
         cur = dict(SLA, throughput_tok_s=1.0)
         rc = bc.main([_write(tmp_path, "b.json", SLA),

@@ -145,6 +145,122 @@ class TestVerifiedSaves:
         assert ckpt_lib.latest_valid_epoch(str(tmp_path)) == 1
 
 
+class TestMultiprocessManifests:
+    """Round-9 gap closed: multihost saves are no longer manifest-less.
+    Each process writes MANIFEST.<p>.json over ONLY the files it owns
+    (orbax's ocdbt.process_<p> artifacts; process 0 owns the shared
+    metadata), the master commits last, and verification merges
+    whatever manifests are present. Single-process behavior stays
+    bit-identical (pinned by TestVerifiedSaves above)."""
+
+    @staticmethod
+    def _fake_save(root):
+        os.makedirs(os.path.join(root, "ocdbt.process_0"))
+        os.makedirs(os.path.join(root, "ocdbt.process_1"))
+        with open(os.path.join(root, "_CHECKPOINT_METADATA"), "w") as fh:
+            fh.write("meta")
+        with open(os.path.join(root, "ocdbt.process_0", "d0"), "w") as fh:
+            fh.write("proc0 payload")
+        with open(os.path.join(root, "ocdbt.process_1", "d1"), "w") as fh:
+            fh.write("proc1 payload")
+
+    def test_ownership_partition_and_master_commits_last(self, tmp_path):
+        from distributed_training_tpu.resilience import verify as V
+
+        root = str(tmp_path / "epoch_0")
+        self._fake_save(root)
+        # Peer manifests first; no COMMITTED until the master's pass.
+        V.write_manifest(root, process_index=1, process_count=2)
+        assert not V.is_committed(root)
+        V.write_manifest(root, process_index=0, process_count=2,
+                         peer_wait_s=5.0)
+        assert V.is_committed(root)
+        m0 = json.load(open(os.path.join(root, "MANIFEST.0.json")))
+        m1 = json.load(open(os.path.join(root, "MANIFEST.1.json")))
+        # Disjoint ownership covering the whole save: process 1 hashes
+        # only its ocdbt dir, process 0 the rest.
+        assert set(m1["files"]) == {"ocdbt.process_1/d1"}
+        assert set(m0["files"]) == {"_CHECKPOINT_METADATA",
+                                    "ocdbt.process_0/d0"}
+        assert m0["process_count"] == 2
+        verify_checkpoint(root)  # merged verification passes
+
+    def test_peer_file_corruption_caught_by_merged_verify(self, tmp_path):
+        from distributed_training_tpu.resilience import verify as V
+
+        root = str(tmp_path / "epoch_0")
+        self._fake_save(root)
+        V.write_manifest(root, process_index=1, process_count=2)
+        V.write_manifest(root, process_index=0, process_count=2,
+                         peer_wait_s=5.0)
+        with open(os.path.join(root, "ocdbt.process_1", "d1"), "w") as fh:
+            fh.write("bit rot!!")
+        with pytest.raises(CheckpointCorruptError) as ei:
+            verify_checkpoint(root)
+        assert ei.value.reason == "checksum"
+
+    def test_manifest_deleted_after_commit_rejected(self, tmp_path):
+        """The manifest family must be COMPLETE, not just consistent: a
+        committed 2-process save whose MANIFEST.1.json was deleted
+        leaves process 1's payload unprovable — bit rot there would
+        verify clean if merging only 'whatever is present'. Same
+        partial-delete verdict the single-manifest path gives."""
+        from distributed_training_tpu.resilience import verify as V
+
+        root = str(tmp_path / "epoch_0")
+        self._fake_save(root)
+        V.write_manifest(root, process_index=1, process_count=2)
+        V.write_manifest(root, process_index=0, process_count=2,
+                         peer_wait_s=5.0)
+        verify_checkpoint(root)
+        os.remove(os.path.join(root, "MANIFEST.1.json"))
+        with pytest.raises(CheckpointCorruptError) as ei:
+            verify_checkpoint(root)
+        assert ei.value.reason == "torn"
+        assert "process(es) [1]" in str(ei.value)
+
+    def test_missing_peer_manifest_leaves_save_uncommitted(self,
+                                                           tmp_path):
+        """Fail safe, not fail silent: if a peer never manifests within
+        the wait budget, the master refuses to commit — scanners then
+        classify the save as torn instead of trusting unprovable
+        bytes."""
+        from distributed_training_tpu.resilience import verify as V
+
+        root = str(tmp_path / "epoch_0")
+        self._fake_save(root)
+        with pytest.warns(UserWarning, match="UNCOMMITTED"):
+            V.write_manifest(root, process_index=0, process_count=2,
+                             peer_wait_s=0.2)
+        assert not V.is_committed(root)
+        with pytest.raises(CheckpointCorruptError):
+            verify_checkpoint(root)
+
+    def test_corrupt_committed_checkpoint_fault(self, tmp_path):
+        """The chaos tear-after-commit fault: marker + manifest intact,
+        payload corrupted — invisible to the marker scan, caught by the
+        checksum pass, quarantined by the fallback scan."""
+        from distributed_training_tpu.resilience.chaos import (
+            corrupt_committed_checkpoint,
+        )
+
+        path = ckpt_lib.save_checkpoint(str(tmp_path), 0, _np_state())
+        ckpt_lib.save_checkpoint(str(tmp_path), 1, _np_state())
+        corrupt_committed_checkpoint(
+            os.path.join(str(tmp_path), "epoch_1"))
+        assert os.path.isfile(
+            os.path.join(str(tmp_path), "epoch_1", COMMIT_NAME))
+        with pytest.raises(CheckpointCorruptError) as ei:
+            verify_checkpoint(os.path.join(str(tmp_path), "epoch_1"))
+        assert ei.value.reason == "checksum"
+        # The resume scan falls back to the older good save and
+        # quarantines the corrupt one.
+        assert ckpt_lib.latest_valid_epoch(str(tmp_path)) == 0
+        assert os.path.isdir(
+            os.path.join(str(tmp_path), "epoch_1.corrupt"))
+        verify_checkpoint(path)  # epoch 0 untouched
+
+
 class TestLastGoodFallback:
     def test_latest_valid_epoch_skips_and_quarantines(self, tmp_path):
         for e in range(3):

@@ -76,6 +76,13 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     # which breathes with host timing — gate it loosely, both ways
     "kv_pages_allocated_iters": ("both", 0.0),
     "page_pool_occupancy_mean": ("both", 0.75),
+    # live weight hot-swap (serving/hotswap.py): the smoke's mid-run
+    # swap mode makes swaps_completed deterministic (exactly the
+    # configured swap count), and ANY rejected swap in a clean smoke is
+    # a broken staging pipeline — zero tolerance, enforced even from a
+    # zero baseline (see compare()).
+    "swaps_completed": ("both", 0.0),
+    "swaps_rejected": ("lower", 0.0),
 }
 
 
@@ -158,9 +165,21 @@ def compare(base: dict, cur: dict,
                         "baseline": b, "current": None})
             continue
         if b == 0:
-            out.append({"metric": key, "status": "skipped",
-                        "baseline": 0.0, "current": c,
-                        "note": "zero baseline, no ratio"})
+            # No ratio exists, so fractional thresholds cannot gate —
+            # EXCEPT a zero-tolerance not-allowed-to-grow metric (e.g.
+            # swaps_rejected), where "baseline 0, current nonzero" is
+            # precisely the drift the gate exists to catch.
+            if frac == 0.0 and direction in ("lower", "both") and c != 0:
+                out.append({"metric": key, "direction": direction,
+                            "threshold": frac, "baseline": 0.0,
+                            "current": c, "change": None,
+                            "status": "REGRESSION",
+                            "note": "zero-tolerance metric grew from a "
+                                    "zero baseline"})
+            else:
+                out.append({"metric": key, "status": "skipped",
+                            "baseline": 0.0, "current": c,
+                            "note": "zero baseline, no ratio"})
             continue
         change = (c - b) / abs(b)
         if direction == "higher":
@@ -238,10 +257,12 @@ def main(argv=None) -> int:
             else:
                 arrow = {"higher": "↑", "lower": "↓",
                          "both": "↕"}[v["direction"]]
+                change = ("" if v.get("change") is None
+                          else f" ({v['change']:+.1%})")
                 print(f"{v['status']:<11} {label} :: {v['metric']} "
                       f"[{arrow} ok within {v['threshold']:.0%}]: "
-                      f"{v['baseline']:g} -> {v['current']:g} "
-                      f"({v['change']:+.1%})")
+                      f"{v['baseline']:g} -> {v['current']:g}"
+                      f"{change}")
     if args.json:
         print(json.dumps({"regressed": failed, "records": results},
                          allow_nan=False))

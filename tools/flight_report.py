@@ -139,6 +139,14 @@ def render(summary: dict) -> str:
                 f"{srv['page_pool_occupancy_mean']:.1%}  "
                 f"({srv.get('kv_pages_allocated_iters', 0)} "
                 f"page-iters allocated)")
+        # Live weight hot-swap (serving/hotswap.py): deployment
+        # counters + the explicitly-attributed barrier pause.
+        if srv.get("swaps_completed") or srv.get("swaps_rejected"):
+            add(f"    swaps: {srv.get('swaps_completed', 0):.0f} "
+                f"completed / {srv.get('swaps_rejected', 0):.0f} "
+                f"rejected  |  blocked "
+                f"{srv.get('swap_blocked_s', 0.0) * 1e3:.1f} ms  |  "
+                f"weights epoch {srv.get('weights_epoch', -1):.0f}")
         if srv.get("requests_finished") and "queue_wait_p50_ms" in srv:
             add(f"    admission: queue wait p50 "
                 f"{srv['queue_wait_p50_ms']:.1f} / p95 "

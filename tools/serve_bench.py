@@ -71,6 +71,14 @@ def add_argument() -> argparse.Namespace:
     p.add_argument("--no-warmup", action="store_true", default=False,
                    help="skip the compile warm-up pass (its compile time "
                         "then lands in the measured TTFT tail)")
+    p.add_argument("--swap-at-request", type=int, default=0,
+                   help="mid-run hot-swap mode: when the Nth measured "
+                        "request is submitted, arm a live weight swap "
+                        "to a second (differently seeded) random init — "
+                        "the engine applies it at the next iteration "
+                        "boundary under the Poisson load, so the SLA "
+                        "line measures swap cost (swaps_completed, "
+                        "swap_blocked_s) alongside latency. 0 = off")
     p.add_argument("--flight-dump", type=str, default=None)
     p.add_argument("--metrics-port", type=int, default=None,
                    help="live telemetry plane: /metrics (Prometheus "
@@ -181,6 +189,18 @@ def main() -> int:
     # Poisson process: exponential inter-arrival gaps at the target rate.
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate, size=n))
 
+    # Mid-run hot-swap mode: the staged tree is built BEFORE the
+    # measured window (staging is off the engine's hot path in real
+    # deployments too — only the arm + iteration-boundary barrier land
+    # inside the measurement, which is exactly the cost being gated).
+    swap_params = None
+    if args.swap_at_request:
+        if not 1 <= args.swap_at_request <= n:
+            raise SystemExit(f"--swap-at-request must be in [1, "
+                             f"{n}], got {args.swap_at_request}")
+        swap_params = model.init(jax.random.PRNGKey(args.seed + 1),
+                                 np.zeros((1, 8), np.int32))["params"]
+
     t0 = time.perf_counter()
     submitted = 0
     finished = 0
@@ -190,6 +210,10 @@ def main() -> int:
             engine.submit(load[submitted],
                           arrival_t=t0 + arrivals[submitted])
             submitted += 1
+            if swap_params is not None and \
+                    submitted == args.swap_at_request:
+                engine.arm_swap(swap_params,
+                                epoch=engine.weights_epoch + 1)
         if engine.idle and submitted < n:
             # Ahead of the arrival process: sleep to the next arrival
             # instead of spinning empty iterations.
