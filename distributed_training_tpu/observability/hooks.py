@@ -100,6 +100,14 @@ class TrainObservability:
         self._pending_raise: AnomalyError | None = None
         self._fired = False
         self._crash_dumped = False
+        # Compiled-program sanitizer hook: snapshot the process-global
+        # XLA compile counter at construction so dumps/scrapes report
+        # how many programs this RUN compiled (a steady-state trainer
+        # compiles a handful up front and then never again — growth
+        # across flushes is a retrace leak; observability/sanitizer.py).
+        from distributed_training_tpu.observability import sanitizer
+
+        self._compiles_at_start = sanitizer.compile_count()
         # Live telemetry plane (observability/exporter.py): a background
         # /metrics//healthz//vars endpoint over scrape_snapshot().
         # Master-only — secondary hosts hold no flushed metrics anyway —
@@ -304,6 +312,13 @@ class TrainObservability:
             # Latest flush-boundary skew/straggler view (cached — no
             # collective here; see on_flush).
             extra = {**(extra or {}), "hosts": self._host_summary}
+        # Sanitizer counter: host-side int read, no device interaction
+        # (scrape-safe by construction).
+        from distributed_training_tpu.observability import sanitizer
+
+        extra = {**(extra or {}),
+                 "xla_compiles": sanitizer.compile_count()
+                 - self._compiles_at_start}
         return totals, extra
 
     def scrape_snapshot(self) -> dict:

@@ -425,7 +425,8 @@ class Engine:
             self._cache, self._tok, self._pos, self._rngs,
             jnp.int32(seq.slot), new_cache, tok, jnp.int32(n), req_rng)
         seq.prefill_pos = n
-        first = int(tok)  # the one deliberate sync: TTFT is measured here
+        # graftlint: disable=hot-path-transfer -- the one deliberate sync: TTFT is measured here
+        first = int(tok)
         t = time.perf_counter()
         self._note_first_token(seq, first, t)
 
@@ -552,6 +553,7 @@ class Engine:
             self._prev_params = self.params
             self._prev_epoch = self.weights_epoch
             self._install_params(params)
+            # graftlint: disable=hot-path-transfer -- epoch is a staged host int, not a device value
             self.weights_epoch = int(epoch)
         dt = time.perf_counter() - t0
         self.telemetry.recorder.mark_gap()
@@ -561,6 +563,7 @@ class Engine:
                 seq.swap_pause_s += dt
         if self.trace is not None:
             self.trace.instant("swap.applied", track="engine",
+                               # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg
                                epoch=int(epoch), blocked_ms=dt * 1e3,
                                inflight=self.scheduler.num_active)
 
@@ -606,6 +609,7 @@ class Engine:
             self._slot_pages[slot] = []
             self._slot_commit_left[slot] = self._req_pages(seq.request)
             self._tables[slot, :] = 0
+            # graftlint: disable=hot-path-transfer -- admission-boundary key landing: slot routing is host-side numpy by design
             self._slot_rng[slot] = np.asarray(
                 jax.random.fold_in(self._base_rng, seq.request.uid))
         # Head-of-line blocking: anything still queued after the
@@ -666,7 +670,8 @@ class Engine:
                     jnp.asarray(d_pos), jnp.asarray(d_active),
                     jnp.asarray(self._slot_rng),
                     jnp.asarray(self._tables))
-            toks = np.asarray(nxt)  # per-iteration sync: tokens must land
+            # graftlint: disable=hot-path-transfer -- THE per-iteration sync: tokens must land (docs/SERVING.md)
+            toks = np.asarray(nxt)
             t = time.perf_counter()
             for seq in decoding:
                 seq.note_token(toks[seq.slot], t)
@@ -678,12 +683,15 @@ class Engine:
                     self.trace.complete(
                         "prefill_chunk", t_step0, t,
                         track=f"slot {chunk_seq.slot}",
+                        # graftlint: disable=hot-path-transfer -- host ints for JSON trace args
                         uid=chunk_seq.request.uid, start=int(start),
+                        # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg
                         tokens=int(c))
                 if chunk_seq.prefill_pos == chunk_seq.request.prompt.size:
                     # Final chunk: its last valid row is the request's
                     # first token (same RNG fold and logits row as a
                     # full-prompt prefill).
+                    # graftlint: disable=hot-path-transfer -- the deliberate sync: the chunked-path TTFT measurement point
                     first = int(np.asarray(c_sampled)[c - 1])
                     self._note_first_token(chunk_seq, first, t)
             # KV utilization, host-side only: reserved = pages actually
@@ -708,6 +716,7 @@ class Engine:
             if self.trace is not None:
                 self.trace.complete("decode", t_step0, t, track="engine",
                                     iteration=it, active=len(decoding),
+                                    # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg
                                     prefill_chunk=int(c))
                 self.trace.counter("active_slots", len(counted))
                 self.trace.counter("kv_written_tokens", written)
@@ -756,7 +765,8 @@ class Engine:
                 self.params, self._cache, self._tok, self._pos,
                 jnp.asarray(mask), self._rngs)
             self._tok = nxt
-            toks = np.asarray(nxt)  # per-iteration sync: tokens must land
+            # graftlint: disable=hot-path-transfer -- THE per-iteration sync: tokens must land (docs/SERVING.md)
+            toks = np.asarray(nxt)
             t = time.perf_counter()
             for seq in active_seqs:
                 seq.note_token(toks[seq.slot], t)
@@ -891,6 +901,24 @@ class Engine:
             "swaps_completed": self.telemetry.swaps_completed,
             "swaps_rejected": self.telemetry.swaps_rejected,
         }
+
+    def compiled_programs(self) -> dict[str, int | None]:
+        """Name → compiled-shape count per jit program — the sanitizer
+        hook (``observability/sanitizer.py``). The documented inventory
+        (docs/SERVING.md): paged = ``fused`` + ``decode`` (2 programs,
+        one shape each once warm); legacy = ``prefill`` + ``admit`` +
+        ``decode`` (3 programs; prefill holds one shape per prompt
+        bucket served). Values are None when the running jax doesn't
+        expose the jit cache."""
+        from distributed_training_tpu.observability.sanitizer import (
+            jit_cache_size,
+        )
+        if self.paged:
+            progs = {"fused": self._fused, "decode": self._decode}
+        else:
+            progs = {"prefill": self._prefill, "admit": self._admit,
+                     "decode": self._decode}
+        return {name: jit_cache_size(fn) for name, fn in progs.items()}
 
     # -- telemetry surface ---------------------------------------------------
     def stats(self) -> dict[str, Any]:

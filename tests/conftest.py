@@ -48,6 +48,18 @@ needs_partial_manual = pytest.mark.xfail(
            "pre-existing on the baked jax 0.4.37 (CHANGES.md round 6/7)")
 
 
+@pytest.fixture
+def compile_watch():
+    """Compiled-program sanitizer hook (observability/sanitizer.py): a
+    CompileWatch marked at test start. Tests exercising warm paths call
+    ``compile_watch.check_no_growth(...)`` to pin that nothing retraced;
+    the first use installs the process-global jax.monitoring listener."""
+    from distributed_training_tpu.observability.sanitizer import CompileWatch
+
+    with CompileWatch() as watch:
+        yield watch
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

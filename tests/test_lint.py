@@ -1,0 +1,469 @@
+"""graftlint: one positive + one negative fixture per rule, the CLI
+exit-code contract, waiver semantics, and the zero-finding self-lint.
+
+The lock-signal-safety positive is a minimal reproduction of the
+round-13 bug the rule exists for (an inline SIGUSR1 rollback taking the
+engine's non-reentrant swap lock); its negative is the shipped fix
+(the handler only sets a ``threading.Event``). Fixtures run through
+:func:`tools.lint.run_lint` in-process — no subprocesses — per the
+round-8 keep-tier-1-lean note.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from tools.lint import LintInputError, run_lint
+from tools.lint.__main__ import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(tmp_path, source, rule, name="snippet.py"):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    findings, _ = run_lint([str(path)], rules=[rule])
+    return findings
+
+
+def _exit_code(tmp_path, source, rule, name="snippet.py"):
+    path = tmp_path / name
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return lint_main([str(path), "--rule", rule])
+
+
+class TestHotPathTransfer:
+    POSITIVE = """
+        class Engine:
+            def step(self):
+                self._advance()
+                return self.loss.item()
+    """
+
+    def test_positive_exits_1(self, tmp_path, capsys):
+        assert _exit_code(tmp_path, self.POSITIVE,
+                          "hot-path-transfer") == 1
+        assert ".item()" in capsys.readouterr().out
+
+    def test_negative_host_side_step_is_clean(self, tmp_path):
+        # Same hot scope, host-side bookkeeping only — and the same
+        # .item() OUTSIDE any hot scope is not the rule's business.
+        assert not _lint(tmp_path, """
+            class Engine:
+                def step(self):
+                    self._advance()
+                    return self.counters["tokens"]
+
+            def summarize(metrics):
+                return metrics.item()
+        """, "hot-path-transfer")
+
+    def test_jitted_function_is_a_hot_root(self, tmp_path):
+        findings = _lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def fused(x):
+                x.block_until_ready()
+                return x
+        """, "hot-path-transfer")
+        assert len(findings) == 1 and "block_until_ready" in \
+            findings[0].message
+
+
+class TestScrapeSafety:
+    def test_positive_handler_reaching_flush_exits_1(self, tmp_path):
+        assert _exit_code(tmp_path, """
+            class Handler:
+                def do_GET(self):
+                    self._respond(self._snapshot())
+
+                def _snapshot(self):
+                    self.recorder.flush()
+                    return self.recorder.stats()
+        """, "scrape-safety") == 1
+
+    def test_negative_read_only_handler_is_clean(self, tmp_path):
+        assert not _lint(tmp_path, """
+            class Handler:
+                def do_GET(self):
+                    self._respond(self._snapshot())
+
+                def _snapshot(self):
+                    return dict(self.recorder.stats())
+        """, "scrape-safety")
+
+
+class TestLockSignalSafety:
+    # The pre-fix round-13 hot-swap pattern, minimized: serve()'s
+    # SIGUSR1 handler runs the rollback INLINE, and the rollback takes
+    # the engine's non-reentrant _swap_lock — which the serving loop
+    # holds around the swap barrier on the very thread the signal
+    # interrupts.
+    ROUND13_BUG = """
+        import signal
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._swap_lock = threading.Lock()
+                self.params = None
+                self._prev_params = None
+
+            def rollback(self):
+                with self._swap_lock:
+                    self.params = self._prev_params
+
+        def serve(engine):
+            signal.signal(signal.SIGUSR1,
+                          lambda *_: engine.rollback())
+    """
+    # The shipped fix: the handler only sets an Event; the watcher
+    # thread services the rollback.
+    ROUND13_FIX = """
+        import signal
+        import threading
+
+        class HotSwapper:
+            def __init__(self):
+                self._rollback_requested = threading.Event()
+
+            def request_rollback(self):
+                self._rollback_requested.set()
+
+        def serve(swapper):
+            signal.signal(signal.SIGUSR1,
+                          lambda *_: swapper.request_rollback())
+    """
+
+    def test_flags_the_round13_inline_rollback(self, tmp_path, capsys):
+        assert _exit_code(tmp_path, self.ROUND13_BUG,
+                          "lock-signal-safety") == 1
+        out = capsys.readouterr().out
+        assert "_swap_lock" in out and "signal handler" in out
+
+    def test_negative_event_setting_handler_is_clean(self, tmp_path):
+        assert not _lint(tmp_path, self.ROUND13_FIX,
+                         "lock-signal-safety")
+
+    def test_round13_shape_in_acquire_release_style(self, tmp_path):
+        # The same deadlock written WITHOUT a with-statement — bare
+        # acquire()/try/finally — must not lint clean: acquire() holds
+        # for the rest of the sequence until release().
+        findings = _lint(tmp_path, """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._swap_lock = threading.Lock()
+
+                def barrier(self):
+                    self._swap_lock.acquire()
+                    try:
+                        self.rollback()
+                    finally:
+                        self._swap_lock.release()
+
+                def rollback(self):
+                    with self._swap_lock:
+                        pass
+        """, "lock-signal-safety")
+        assert len(findings) == 1 and "non-reentrant" in \
+            findings[0].message
+
+    def test_release_ends_the_acquire_style_hold(self, tmp_path):
+        assert not _lint(tmp_path, """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._swap_lock = threading.Lock()
+
+                def barrier(self):
+                    self._swap_lock.acquire()
+                    snapshot = dict(self.state)
+                    self._swap_lock.release()
+                    self.rollback()
+
+                def rollback(self):
+                    with self._swap_lock:
+                        pass
+        """, "lock-signal-safety")
+
+    def test_lock_order_inversion(self, tmp_path):
+        findings = _lint(tmp_path, """
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def forward():
+                with a:
+                    with b:
+                        pass
+
+            def backward():
+                with b:
+                    with a:
+                        pass
+        """, "lock-signal-safety")
+        assert len(findings) == 1 and "inversion" in findings[0].message
+
+    def test_inversion_found_through_a_call_cycle(self, tmp_path):
+        # Regression: the lock closure must be a fixpoint over the
+        # reachable set — a memoized recursion caches an EMPTY set for
+        # whichever function a cycle was entered through, and whether
+        # the inversion was reported then depended on traversal order.
+        findings = _lint(tmp_path, """
+            import threading
+
+            a = threading.Lock()
+            b = threading.Lock()
+
+            def ping(n):
+                with a:
+                    pass
+                pong(n)
+
+            def pong(n):
+                ping(n)
+
+            def caller_one():
+                # Enters the cycle through ping (the acquirer): the
+                # buggy recursion memoized pong's closure as EMPTY here,
+                # hiding holds_b's b->a edge below.
+                with a:
+                    ping(1)
+
+            def holds_b():
+                with b:
+                    pong(2)
+
+            def holds_a_then_b():
+                with a:
+                    with b:
+                        pass
+        """, "lock-signal-safety")
+        assert any("inversion" in f.message for f in findings), \
+            [f.message for f in findings]
+
+    def test_reacquire_through_a_call_while_held(self, tmp_path):
+        findings = _lint(tmp_path, """
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._swap_lock = threading.Lock()
+
+                def barrier(self):
+                    with self._swap_lock:
+                        self.rollback()
+
+                def rollback(self):
+                    with self._swap_lock:
+                        pass
+        """, "lock-signal-safety")
+        assert len(findings) == 1 and "non-reentrant" in \
+            findings[0].message
+
+
+class TestStaticShape:
+    def test_positive_branch_on_traced_value_exits_1(self, tmp_path):
+        assert _exit_code(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(x, n):
+                if n > 0:
+                    return x
+                return -x
+        """, "static-shape") == 1
+
+    def test_negative_static_guards_are_clean(self, tmp_path):
+        assert not _lint(tmp_path, """
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnames=("n",))
+            def step(x, n, mask=None):
+                if n > 0:                 # static by declaration
+                    x = x * n
+                if mask is not None:      # identity test: static
+                    x = x * mask
+                if x.ndim == 2:           # shape attr: static
+                    x = x.sum(axis=-1)
+                return x
+        """, "static-shape")
+
+
+class TestDeterminism:
+    def test_positive_unseeded_rng_and_wall_clock_exit_1(self, tmp_path,
+                                                         capsys):
+        assert _exit_code(tmp_path, """
+            import random
+            import time
+
+            import numpy as np
+
+            def corrupt_sample(batch):
+                if random.random() < 0.5:
+                    batch = batch + np.random.rand(*batch.shape)
+                return batch, time.time()
+        """, "determinism") == 1
+        out = capsys.readouterr().out
+        assert "random.random()" in out and "np.random.rand()" in out \
+            and "time.time()" in out
+
+    def test_negative_seeded_streams_and_intervals_clean(self, tmp_path):
+        assert not _lint(tmp_path, """
+            import time
+
+            import numpy as np
+
+            def augment(batch, seed):
+                rng = np.random.RandomState(seed)
+                t0 = time.perf_counter()
+                return batch + rng.rand(*batch.shape), \\
+                    time.perf_counter() - t0
+        """, "determinism")
+
+    def test_observability_files_are_allowlisted(self, tmp_path):
+        assert not _lint(tmp_path, """
+            import time
+
+            def wall_stamp():
+                return time.time()
+        """, "determinism", name=os.path.join("observability",
+                                              "clock.py"))
+
+
+class TestArgparsePercent:
+    def test_positive_bare_percent_exits_1(self, tmp_path):
+        # The round-11 crash verbatim: one bare '%' in a help string.
+        assert _exit_code(tmp_path, """
+            import argparse
+
+            p = argparse.ArgumentParser()
+            p.add_argument("--remat", help="cuts activation memory "
+                                           "by ~50% at 1/3 recompute")
+        """, "argparse-percent") == 1
+
+    def test_negative_escaped_and_mapping_forms_clean(self, tmp_path):
+        assert not _lint(tmp_path, """
+            import argparse
+
+            p = argparse.ArgumentParser()
+            p.add_argument("--remat", help="cuts memory by ~50%% "
+                                           "(default %(default)s)")
+        """, "argparse-percent")
+
+    def test_unknown_mapping_key_still_flags(self, tmp_path):
+        # '%(approx)s' LOOKS like a spec but argparse only supplies
+        # vars(action)+prog — an unknown key KeyErrors --help exactly
+        # like a bare '%', and so does a spec with no conversion char.
+        findings = _lint(tmp_path, """
+            import argparse
+
+            p = argparse.ArgumentParser()
+            p.add_argument("--x", help="about 50%(approx) faster")
+            p.add_argument("--y", help="uses %(default) then text")
+        """, "argparse-percent")
+        assert len(findings) == 2
+
+
+class TestCoreContract:
+    def test_waivers_trailing_and_standalone(self, tmp_path):
+        findings = _lint(tmp_path, """
+            class Engine:
+                def step(self):
+                    a = self.loss.item()  # graftlint: disable=hot-path-transfer -- test waiver
+                    # graftlint: disable=hot-path-transfer -- standalone covers next line
+                    b = self.aux.item()
+                    c = self.extra.item()
+                    return a, b, c
+        """, "hot-path-transfer")
+        assert len(findings) == 1  # only the unwaived third sync
+
+    def test_waiver_is_rule_scoped(self, tmp_path):
+        findings = _lint(tmp_path, """
+            class Engine:
+                def step(self):
+                    return self.loss.item()  # graftlint: disable=determinism -- wrong rule
+        """, "hot-path-transfer")
+        assert len(findings) == 1
+
+    def test_malformed_waiver_is_malformed_input(self, tmp_path, capsys):
+        path = tmp_path / "bad_waiver.py"
+        path.write_text("x = 1  # graftlint: disallow=foo\n")
+        with pytest.raises(LintInputError, match="without"):
+            run_lint([str(path)])
+        # Empty rule list: exit 2 with a one-line error through the
+        # CLI, never a traceback (the exit-code contract).
+        path.write_text("x = 1  # graftlint: disable=\n")
+        assert lint_main([str(path)]) == 2
+        assert "names no rules" in capsys.readouterr().err
+
+    def test_exit_2_on_syntax_error_and_missing_path(self, tmp_path,
+                                                     capsys):
+        path = tmp_path / "torn.py"
+        path.write_text("def step(:\n")
+        assert lint_main([str(path)]) == 2
+        assert "syntax error" in capsys.readouterr().err
+        assert lint_main([str(tmp_path / "nope.py")]) == 2
+        assert "graftlint: error:" in capsys.readouterr().err
+
+    def test_unknown_rule_is_malformed_input(self, tmp_path, capsys):
+        path = tmp_path / "ok.py"
+        path.write_text("x = 1\n")
+        assert lint_main([str(path), "--rule", "no-such-rule"]) == 2
+        assert "unknown rule" in capsys.readouterr().err
+
+    def test_json_output_shape(self, tmp_path, capsys):
+        import json
+
+        path = tmp_path / "hot.py"
+        path.write_text(textwrap.dedent("""
+            class Engine:
+                def step(self):
+                    return self.loss.item()
+        """))
+        assert lint_main([str(path), "--json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"] and payload["files"] == 1
+        f = payload["findings"][0]
+        assert f["rule"] == "hot-path-transfer" and f["line"] and f["path"]
+
+    def test_absolute_paths_resolve_cross_module_imports(self, tmp_path):
+        # Regression: module names used to be derived verbatim from the
+        # display path, so linting by ABSOLUTE path made every
+        # cross-module from-import look external — reachability stopped
+        # at file boundaries and the gate went falsely green.
+        pkg = tmp_path / "lintpkg"
+        pkg.mkdir()
+        (pkg / "helpers.py").write_text(textwrap.dedent("""
+            def refresh(recorder):
+                recorder.flush()
+        """))
+        (pkg / "handler.py").write_text(textwrap.dedent("""
+            from lintpkg.helpers import refresh
+
+            class Handler:
+                def do_GET(self):
+                    refresh(self.recorder)
+        """))
+        findings, _ = run_lint([str(pkg)], rules=["scrape-safety"])
+        assert len(findings) == 1 and "flush" in findings[0].message
+
+    def test_self_lint_is_clean(self, monkeypatch):
+        # The acceptance bar: the package and its tooling lint clean
+        # (deliberate syncs carry justified waivers; summary counts
+        # them so a silently-dead waiver regime would show up as 0).
+        monkeypatch.chdir(REPO)
+        findings, summary = run_lint(
+            ["distributed_training_tpu", "tools"])
+        assert findings == [], [f.render() for f in findings]
+        assert summary["waived"] >= 10

@@ -1,0 +1,144 @@
+"""Compiled-program sanitizer: the XLA inventory pins hold and trip.
+
+The runtime half of the static-shape discipline (the AST half is
+``tools/lint``'s ``static-shape`` rule): the serving engine's documented
+inventory — paged = 2 compiled programs, legacy = 3, one shape per
+program except the bucketed legacy prefill (docs/SERVING.md
+"compiled-program inventory") — is pinned through
+``Engine.compiled_programs()`` + ``check_engine_inventory``, and a warm
+steady state must not compile at all (``CompileWatch``). The growth
+case forces a retrace the way a real leak would appear (a prompt
+landing in an unwarmed bucket) and asserts the sanitizer trips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_training_tpu.config import ServeConfig
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.observability.sanitizer import (
+    CompileWatch,
+    RecompileError,
+    check_engine_inventory,
+    compile_count,
+    jit_cache_size,
+)
+from distributed_training_tpu.serving import Engine
+
+VOCAB = 32
+MAX_LEN = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model("transformer_lm", num_classes=VOCAB, num_layers=1,
+                      num_heads=2, hidden_dim=16, max_len=MAX_LEN)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))["params"]
+    return model, params
+
+
+def _submit(engine, lens, seed=0):
+    rng = np.random.RandomState(seed)
+    for l in lens:
+        engine.submit(rng.randint(0, VOCAB, size=l).astype(np.int32))
+
+
+class TestCompileWatch:
+    def test_counts_backend_compiles_and_cache_hits_dont(self):
+        x = jnp.arange(8, dtype=jnp.float32)  # materialized pre-watch
+        f = jax.jit(lambda v: v * 2 + 1)
+        with CompileWatch() as watch:
+            f(x)
+        assert watch.compiles >= 1
+        with pytest.raises(RecompileError, match="must not retrace"):
+            watch.check_no_growth("test window")
+        watch.mark()
+        f(x)  # same shape: cache hit
+        assert watch.compiles == 0
+        watch.check_no_growth("warm window")  # no raise
+        watch.expect(0, "warm window")  # no raise
+        assert jit_cache_size(f) == 1
+        x9 = jnp.arange(9, dtype=jnp.float32)  # arange compiles too —
+        watch.mark()                           # keep it outside the pin
+        f(x9)  # new shape: retrace
+        assert jit_cache_size(f) == 2
+        assert watch.compiles == 1
+        watch.expect(1, "one forced retrace")  # no raise
+        with pytest.raises(RecompileError, match="expected exactly"):
+            watch.expect(2, "wrong pin")
+
+    def test_compile_count_monotonic(self):
+        a = compile_count()
+        jax.jit(lambda v: v - 3)(jnp.float32(1.0))
+        b = compile_count()
+        assert b > a >= 0
+
+
+class TestEngineInventory:
+    def test_paged_engine_pins_two_programs_one_shape(self, lm):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=2, max_new_tokens=4, temperature=0.0,
+            prefill_chunk=4))
+        _submit(eng, [3, 5, 7])
+        assert len(eng.run()) == 3
+        progs = eng.compiled_programs()
+        # Both programs ran (chunked prefill rode the fused step; the
+        # post-prefill iterations were decode-only) and each holds
+        # exactly one trace.
+        assert progs == {"fused": 1, "decode": 1}
+        assert check_engine_inventory(eng) == progs
+
+    def test_legacy_engine_pins_three_programs(self, lm):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=2, max_new_tokens=4, temperature=0.0,
+            kv_page_size=None, prefill_bucket=8))
+        _submit(eng, [3, 5, 7])  # one shared 8-token prefill bucket
+        assert len(eng.run()) == 3
+        progs = eng.compiled_programs()
+        assert progs == {"prefill": 1, "admit": 1, "decode": 1}
+        assert check_engine_inventory(eng, prefill_shapes=1) == progs
+
+    def test_warm_paged_steady_state_never_compiles(self, lm):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=2, max_new_tokens=4, temperature=0.0,
+            prefill_chunk=4))
+        _submit(eng, [3, 5])
+        eng.run()  # warm-up: both programs compiled
+        with CompileWatch() as watch:
+            _submit(eng, [3, 5, 7], seed=1)  # same shapes, new uids
+            assert len(eng.run()) == 3
+        watch.check_no_growth("warm paged serving")  # no raise
+        check_engine_inventory(eng)
+
+    def test_forced_extra_shape_trips_the_sanitizer(self, lm):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=2, max_new_tokens=4, temperature=0.0,
+            kv_page_size=None, prefill_bucket=8))
+        _submit(eng, [3, 5])
+        eng.run()  # warm within the first bucket only
+        check_engine_inventory(eng, prefill_shapes=1)
+        with CompileWatch() as watch:
+            _submit(eng, [13])  # lands in the UNWARMED second bucket
+            eng.run()
+        # The forced retrace is visible on both surfaces: the window
+        # compiled, and the prefill program now holds two shapes.
+        assert watch.compiles >= 1
+        with pytest.raises(RecompileError, match="must not retrace"):
+            watch.check_no_growth("legacy window with a cross-bucket "
+                                  "prompt")
+        assert eng.compiled_programs()["prefill"] == 2
+        with pytest.raises(RecompileError, match="prefill"):
+            check_engine_inventory(eng, prefill_shapes=1)
+
+    def test_fixture_hands_out_a_marked_watch(self, lm, compile_watch):
+        # The conftest fixture arms a watch before the test body; a
+        # test that only touches warm code can assert silence.
+        assert compile_watch.compiles == 0
+        compile_watch.check_no_growth("fixture smoke")

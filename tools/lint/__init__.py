@@ -1,0 +1,19 @@
+"""graftlint: the repo-specific AST invariant linter.
+
+Usage (CLI)::
+
+    python -m tools.lint distributed_training_tpu tools
+    python -m tools.lint --json --rule lock-signal-safety serving/
+
+Exit codes follow the ``tools/`` convention (flight_report.py,
+bench_compare.py): 0 clean, 1 findings, 2 malformed input (one-line
+error on stderr). Waive a deliberate exception inline with
+``# graftlint: disable=<rule>  -- one-line justification``.
+
+See docs/STATIC_ANALYSIS.md for the rule catalogue and each rule's
+origin story.
+"""
+
+from tools.lint.core import Finding, LintInputError, run_lint
+
+__all__ = ["Finding", "LintInputError", "run_lint"]

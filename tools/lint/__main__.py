@@ -1,0 +1,74 @@
+"""graftlint CLI: ``python -m tools.lint <paths> [--json] [--rule R]``.
+
+Exit codes mirror the other ``tools/`` entry points (flight_report.py,
+bench_compare.py; docs/OBSERVABILITY.md "Exit codes"): 0 = clean, 1 =
+findings, 2 = malformed input with a one-line error on stderr. ``--json``
+emits one machine-readable object (the CI gate uploads it as a failure
+artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# Script-style execution support: `python tools/lint/__main__.py` and
+# `python -m tools.lint` from anywhere inside the repo both resolve the
+# `tools.` package imports.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.lint.core import LintInputError, run_lint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="graftlint: repo-specific AST invariant linter "
+                    "(docs/STATIC_ANALYSIS.md). Exit 0 clean / "
+                    "1 findings / 2 malformed input.")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="NAME",
+                    help="run only this rule (repeatable); default all")
+    ap.add_argument("--json", action="store_true", default=False,
+                    help="emit findings + summary as one JSON object")
+    ap.add_argument("--list-rules", action="store_true", default=False,
+                    help="print the rule names and exit 0")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from tools.lint.rules import ALL_RULES
+        for mod in ALL_RULES:
+            doc = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{mod.NAME:<22} {doc}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given")
+
+    try:
+        findings, summary = run_lint(args.paths, rules=args.rule)
+    except LintInputError as e:
+        print(f"graftlint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        # summary's "findings" is the count — the list replaces it here
+        # (consumers read len(findings)); files/rules/waived ride along.
+        print(json.dumps(
+            {**summary, "findings": [f.to_dict() for f in findings]},
+            allow_nan=False))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"graftlint: {summary['findings']} finding(s) across "
+              f"{summary['files']} file(s) "
+              f"({summary['waived']} waived)", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""graftlint core: files, findings, waivers, and the rule runner.
+
+This is a *repo-specific* linter, not a general style checker: every
+rule in ``tools/lint/rules/`` encodes one of this codebase's documented
+hard invariants (static-shape XLA discipline, the scrape-safety
+contract, lock/signal safety, seeded determinism — see
+docs/STATIC_ANALYSIS.md for the catalogue and each rule's origin
+story). The core stays deliberately small:
+
+- :class:`SourceFile` — one parsed ``.py`` file plus its waiver map
+  (``# graftlint: disable=<rule>[,<rule>]`` comments, scanned with
+  ``tokenize`` so strings containing the marker don't count).
+- :class:`Finding` — one (rule, path, line, message) verdict.
+- :func:`run_lint` — collect files, build the shared
+  :class:`~tools.lint.graph.ProjectIndex`, run every rule, apply
+  waivers, return sorted findings.
+
+Malformed input (missing path, non-``.py`` file, syntax error) raises
+:class:`LintInputError` — the CLI maps it to exit 2 with a one-line
+error, mirroring ``flight_report.py``/``bench_compare.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import tokenize
+from typing import Iterable, Iterator
+
+WAIVER_MARK = "graftlint:"
+
+
+class LintInputError(Exception):
+    """Malformed input (bad path, unparseable file) — CLI exit 2."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule verdict, anchored to a source line."""
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _parse_waivers(source: str, path: str) -> dict[int, set[str]]:
+    """Line → waived-rule-names map from ``# graftlint:`` comments.
+
+    A trailing waiver covers its own line; a standalone comment line
+    covers the next line as well (so a justification can sit above the
+    code it waives). ``disable=a,b`` names rules; anything else in the
+    comment is the human justification and is ignored here.
+    """
+    waivers: dict[int, set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT or WAIVER_MARK not in tok.string:
+                continue
+            body = tok.string.split(WAIVER_MARK, 1)[1]
+            if "disable=" not in body:
+                raise LintInputError(
+                    f"{path}:{tok.start[0]}: graftlint comment without "
+                    f"disable=<rule>: {tok.string.strip()!r}")
+            spec = body.split("disable=", 1)[1]
+            # The rule list ends at whitespace; the rest of the comment
+            # is the justification. An EMPTY list ('disable=' with no
+            # rules) is malformed, not a crash and not a silent no-op.
+            head = spec.split()
+            rules = {r.strip() for r in head[0].split(",")
+                     if r.strip()} if head else set()
+            if not rules:
+                raise LintInputError(
+                    f"{path}:{tok.start[0]}: graftlint disable= names "
+                    f"no rules: {tok.string.strip()!r}")
+            lines = {tok.start[0]}
+            if not source.splitlines()[tok.start[0] - 1][
+                    :tok.start[1]].strip():
+                lines.add(tok.start[0] + 1)  # standalone: covers next line
+            for ln in lines:
+                waivers.setdefault(ln, set()).update(rules)
+    except tokenize.TokenError:
+        pass  # the ast parse below reports the real syntax error
+    return waivers
+
+
+class SourceFile:
+    """One parsed source file: AST + waivers + display path."""
+
+    def __init__(self, path: str, display_path: str | None = None):
+        self.path = os.path.abspath(path)
+        self.display_path = display_path or os.path.relpath(path)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                self.source = fh.read()
+        except OSError as e:
+            raise LintInputError(f"cannot read {path}: {e}") from e
+        try:
+            self.tree = ast.parse(self.source, filename=path)
+        except SyntaxError as e:
+            raise LintInputError(
+                f"{self.display_path}:{e.lineno}: syntax error: {e.msg}"
+            ) from e
+        self.waivers = _parse_waivers(self.source, self.display_path)
+
+    def waived(self, rule: str, line: int) -> bool:
+        return rule in self.waivers.get(line, ())
+
+
+def collect_files(paths: Iterable[str]) -> list[SourceFile]:
+    """Expand files/directories into parsed :class:`SourceFile`\\ s.
+
+    Directories are walked recursively for ``*.py`` (``__pycache__`` and
+    dot-dirs skipped); an explicit path that does not exist, or a file
+    without a ``.py`` suffix, is malformed input.
+    """
+    files: list[SourceFile] = []
+    seen: set[str] = set()
+
+    def add(p: str, display: str) -> None:
+        absp = os.path.abspath(p)
+        if absp not in seen:
+            seen.add(absp)
+            files.append(SourceFile(p, display))
+
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, names in os.walk(path):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__"
+                                 and not d.startswith("."))
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        full = os.path.join(root, name)
+                        add(full, os.path.normpath(full))
+        elif os.path.isfile(path):
+            if not path.endswith(".py"):
+                raise LintInputError(f"not a python file: {path}")
+            add(path, os.path.normpath(path))
+        else:
+            raise LintInputError(f"no such file or directory: {path}")
+    if not files:
+        raise LintInputError("no python files found under the given paths")
+    return files
+
+
+def run_lint(paths: Iterable[str], *,
+             rules: Iterable[str] | None = None
+             ) -> tuple[list[Finding], dict]:
+    """Lint ``paths`` and return ``(findings, summary)``.
+
+    ``rules`` restricts to a subset of rule names (unknown names are
+    malformed input). ``summary`` carries files/rules/waived counts for
+    the CLI's ``--json`` object.
+    """
+    from tools.lint.graph import ProjectIndex
+    from tools.lint.rules import ALL_RULES
+
+    by_name = {mod.NAME: mod for mod in ALL_RULES}
+    if rules is not None:
+        unknown = set(rules) - set(by_name)
+        if unknown:
+            raise LintInputError(
+                f"unknown rule(s) {sorted(unknown)} "
+                f"(known: {sorted(by_name)})")
+        selected = [by_name[r] for r in sorted(set(rules))]
+    else:
+        selected = list(ALL_RULES)
+
+    files = collect_files(paths)
+    index = ProjectIndex(files)
+    findings: list[Finding] = []
+    waived = 0
+    for mod in selected:
+        for finding in mod.check(index):
+            sf = index.file_for(finding.path)
+            if sf is not None and sf.waived(finding.rule, finding.line):
+                waived += 1
+                continue
+            findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    summary = {
+        "files": len(files),
+        "rules": [mod.NAME for mod in selected],
+        "findings": len(findings),
+        "waived": waived,
+    }
+    return findings, summary
+
+
+def iter_calls(node: ast.AST) -> Iterator[ast.Call]:
+    """Every ``ast.Call`` under ``node`` (convenience for rules)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub

@@ -1,0 +1,707 @@
+"""graftlint program index: call graph, lock graph, signals, jit marks.
+
+One shared static view of the linted file set that every rule queries:
+
+- **Functions** (including nested defs and the lambdas passed to
+  ``signal.signal``) with their outgoing call sites.
+- **Call resolution** — deliberately simple and *over-approximate*:
+  ``self.m()`` resolves inside the enclosing class (bases included);
+  ``self.attr.m()`` resolves through a constructor-assignment type map
+  (``self.attr = ClassName(...)`` anywhere in the class); module-alias
+  calls (``verify_lib.verify_checkpoint()``) resolve through the import
+  table when the module is part of the linted set; everything else
+  falls back to "all functions with that bare name". Over-approximation
+  errs toward *reporting* — the waiver mechanism handles the rare
+  deliberate exception.
+- **Locks** — ``threading.Lock``/``RLock`` assignments (module-level or
+  ``self.x = ...``), their acquisition sites (``with lock:`` /
+  ``lock.acquire()``), intra-function nesting, and the calls made while
+  a lock is held (the raw material for deadlock rules).
+- **Signal handlers** — every ``signal.signal(sig, handler)``
+  registration with the handler resolved (function, method, or lambda).
+- **Jit marks** — functions compiled by ``jax.jit`` (decorator,
+  ``functools.partial(jax.jit, ...)``, or call-form ``jax.jit(f)`` /
+  ``jax.jit(self._impl)``), with literal ``static_argnums``/
+  ``static_argnames`` so rules know which parameters are *not* traced.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Iterator
+
+from tools.lint.core import SourceFile
+
+
+def attr_chain(node: ast.AST) -> list[str] | None:
+    """``a.b.c`` → ``["a", "b", "c"]``; None when not Name-rooted."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class LockId:
+    """One lock object: where it lives and what it's called."""
+    path: str            # display path of the defining file
+    owner: str           # class name, or "<module>"
+    attr: str            # attribute / variable name
+    reentrant: bool      # RLock?
+
+    def render(self) -> str:
+        return f"{self.owner}.{self.attr}" if self.owner != "<module>" \
+            else self.attr
+
+
+@dataclasses.dataclass
+class CallSite:
+    name: str                       # terminal callee name
+    recv: tuple[str, str] | None    # ("self","")/("selfattr",a)/("var",v)
+    chain: list[str] | None         # full dotted chain when Name-rooted
+    line: int
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qualname: str                   # "path::Class.method" / "path::fn"
+    name: str                       # bare name ("<lambda>" for lambdas)
+    cls: str | None
+    parent: str | None              # enclosing function's bare name
+    file: SourceFile
+    node: ast.AST
+    line: int
+    decorators: list[str] = dataclasses.field(default_factory=list)
+    calls: list[CallSite] = dataclasses.field(default_factory=list)
+    # Lock facts (filled by the lock pass):
+    acquires: list[tuple[LockId, int]] = dataclasses.field(
+        default_factory=list)
+    nested_locks: list[tuple[LockId, LockId, int]] = dataclasses.field(
+        default_factory=list)
+    calls_with_held: list[tuple[frozenset, CallSite]] = dataclasses.field(
+        default_factory=list)
+    # Jit facts:
+    jitted: bool = False
+    static_params: set = dataclasses.field(default_factory=set)
+
+    @property
+    def params(self) -> list[str]:
+        if isinstance(self.node, ast.Lambda):
+            a = self.node.args
+        elif isinstance(self.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = self.node.args
+        else:
+            return []
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    file: SourceFile
+    bases: list[str]
+    methods: dict = dataclasses.field(default_factory=dict)
+    attr_types: dict = dataclasses.field(default_factory=dict)
+    locks: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class SignalRegistration:
+    file: SourceFile
+    line: int
+    handlers: list[FunctionInfo]    # resolved handler bodies (may be [])
+    desc: str                       # rendered handler expression
+
+
+_LOCK_CTORS = {"Lock", "RLock"}
+
+
+class ProjectIndex:
+    """The shared static view rules query (see module docstring)."""
+
+    def __init__(self, files: list[SourceFile]):
+        self.files = files
+        self.functions: dict[str, FunctionInfo] = {}
+        self.by_name: dict[str, list[FunctionInfo]] = {}
+        self.classes: dict[str, list[ClassInfo]] = {}
+        self.module_locks: dict[str, dict[str, LockId]] = {}
+        self.lock_attrs: dict[str, list[LockId]] = {}
+        self.signal_registrations: list[SignalRegistration] = []
+        self._imports: dict[str, dict[str, str]] = {}       # alias → module
+        self._from_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        self._by_module: dict[str, SourceFile] = {}
+        self._by_path: dict[str, SourceFile] = {}
+        self._lambda_info: dict[int, FunctionInfo] = {}
+        self._pending_signal: list[tuple[SourceFile, ast.Call,
+                                         FunctionInfo | None]] = []
+        self._pending_jit: list[tuple[SourceFile, ast.Call,
+                                      str | None]] = []
+
+        for sf in files:
+            self._by_path[sf.display_path] = sf
+            # Register every dotted SUFFIX of the path as a module name
+            # ("a/b/c.py" → a.b.c, b.c, c), so an absolute-path or
+            # out-of-tree invocation still resolves "from b.c import f"
+            # to the linted file — deriving one name from the display
+            # path would silently turn every cross-module import
+            # "external" (and the gate falsely green) the moment the
+            # CLI is run with absolute paths. First registration wins
+            # on a collision: files are walked in sorted order, and an
+            # occasional wrong binding errs toward over-approximation.
+            parts = [p for p in
+                     sf.display_path[:-3].split(os.sep) if p and p != "."]
+            if parts and parts[-1] == "__init__":
+                parts = parts[:-1]  # package name
+            for i in range(len(parts)):
+                self._by_module.setdefault(".".join(parts[i:]), sf)
+            self._index_imports(sf)
+        for sf in files:
+            self._index_file(sf)
+        self._resolve_pending_jit()
+        self._resolve_pending_signals()
+        for fn in self.functions.values():
+            self._index_locks_in(fn)
+
+    # -- lookups -------------------------------------------------------------
+    def file_for(self, display_path: str) -> SourceFile | None:
+        return self._by_path.get(display_path)
+
+    def funcs_named(self, name: str) -> list[FunctionInfo]:
+        return self.by_name.get(name, [])
+
+    def classes_named(self, name: str) -> list[ClassInfo]:
+        return self.classes.get(name, [])
+
+    def iter_functions(self) -> Iterator[FunctionInfo]:
+        return iter(self.functions.values())
+
+    # -- import table --------------------------------------------------------
+    def _index_imports(self, sf: SourceFile) -> None:
+        imports: dict[str, str] = {}
+        from_imports: dict[str, tuple[str, str]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    imports[alias.asname or alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    from_imports[alias.asname or alias.name] = \
+                        (node.module, alias.name)
+        self._imports[sf.display_path] = imports
+        self._from_imports[sf.display_path] = from_imports
+
+    def module_of(self, sf: SourceFile, root: str) -> str | None:
+        """The module a local name refers to: ``np`` → ``numpy``,
+        ``verify_lib`` → the from-imported submodule, else None."""
+        imp = self._imports[sf.display_path].get(root)
+        if imp is not None:
+            return imp
+        frm = self._from_imports[sf.display_path].get(root)
+        if frm is not None:
+            mod = f"{frm[0]}.{frm[1]}"
+            if mod in self._by_module:
+                return mod
+        return None
+
+    def chain_module(self, sf: SourceFile, chain: list[str]) -> str | None:
+        """Module name of a dotted chain's root (None when not an
+        import), e.g. ``np.random.randint`` → ``numpy``."""
+        return self.module_of(sf, chain[0]) if chain else None
+
+    # -- file walk -----------------------------------------------------------
+    def _index_file(self, sf: SourceFile) -> None:
+        self._walk(sf, sf.tree.body, cls=None, parent=None)
+
+    def _walk(self, sf: SourceFile, body: Iterable[ast.AST],
+              cls: ClassInfo | None, parent: FunctionInfo | None) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    name=node.name, file=sf,
+                    bases=[(attr_chain(b) or ["?"])[-1]
+                           for b in node.bases])
+                self.classes.setdefault(node.name, []).append(ci)
+                self._walk(sf, node.body, cls=ci, parent=None)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(sf, node, cls, parent)
+            else:
+                # Module/class-level statements: module locks, lambdas,
+                # signal registrations and jit calls at top level.
+                self._scan_statement(sf, node, cls, owner=None)
+
+    def _index_function(self, sf: SourceFile, node: ast.FunctionDef,
+                        cls: ClassInfo | None,
+                        parent: FunctionInfo | None) -> FunctionInfo:
+        prefix = f"{cls.name}." if cls else ""
+        if parent is not None:
+            prefix = f"{parent.name}.{prefix}"
+        qualname = f"{sf.display_path}::{prefix}{node.name}"
+        if qualname in self.functions:  # overloads/re-defs: keep distinct
+            qualname += f"@{node.lineno}"
+        fi = FunctionInfo(
+            qualname=qualname, name=node.name,
+            cls=cls.name if cls else None,
+            parent=parent.name if parent else None,
+            file=sf, node=node, line=node.lineno,
+            decorators=[(attr_chain(d.func if isinstance(d, ast.Call)
+                                    else d) or ["?"])[-1]
+                        for d in node.decorator_list])
+        self.functions[qualname] = fi
+        self.by_name.setdefault(node.name, []).append(fi)
+        if cls is not None and node.name not in cls.methods:
+            cls.methods[node.name] = fi
+        self._mark_jit_from_decorators(fi)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(sf, stmt, cls=None, parent=fi)
+            elif isinstance(stmt, ast.ClassDef):
+                self._walk(sf, [stmt], cls=None, parent=None)
+            else:
+                self._scan_statement(sf, stmt, cls, owner=fi)
+        return fi
+
+    def _scan_statement(self, sf: SourceFile, stmt: ast.AST,
+                        cls: ClassInfo | None,
+                        owner: FunctionInfo | None) -> None:
+        """Collect calls/locks/lambdas from one statement, skipping
+        nested def/class subtrees (indexed separately by the caller)."""
+        for node in self._walk_shallow(stmt, sf, cls, owner):
+            if isinstance(node, ast.Call):
+                self._note_call(sf, node, cls, owner)
+            elif isinstance(node, ast.Assign):
+                self._note_assign(sf, node, cls, owner)
+
+    def _walk_shallow(self, root: ast.AST, sf: SourceFile,
+                      cls: ClassInfo | None,
+                      owner: FunctionInfo | None) -> Iterator[ast.AST]:
+        """ast.walk that treats nested defs as separate functions and
+        indexes lambdas as anonymous functions."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            if node is not root and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._index_function(sf, node, cls=None, parent=owner)
+                continue
+            if isinstance(node, ast.Lambda):
+                self._index_lambda(sf, node, cls, owner)
+                continue
+            if node is not root and isinstance(node, ast.ClassDef):
+                self._walk(sf, [node], cls=None, parent=None)
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _index_lambda(self, sf: SourceFile, node: ast.Lambda,
+                      cls: ClassInfo | None,
+                      owner: FunctionInfo | None) -> FunctionInfo:
+        qualname = f"{sf.display_path}::<lambda>@{node.lineno}"
+        if qualname in self.functions:
+            qualname += f".{node.col_offset}"
+        fi = FunctionInfo(qualname=qualname, name="<lambda>",
+                          cls=cls.name if cls else None,
+                          parent=owner.name if owner else None,
+                          file=sf, node=node, line=node.lineno)
+        self.functions[qualname] = fi
+        self._lambda_info[id(node)] = fi
+        for sub in self._walk_shallow(node.body, sf, cls, fi):
+            if isinstance(sub, ast.Call):
+                self._note_call(sf, sub, cls, fi)
+            elif isinstance(sub, ast.Assign):
+                self._note_assign(sf, sub, cls, fi)
+        return fi
+
+    def _note_call(self, sf: SourceFile, node: ast.Call,
+                   cls: ClassInfo | None,
+                   owner: FunctionInfo | None) -> None:
+        func = node.func
+        chain = attr_chain(func)
+        if isinstance(func, ast.Name):
+            cs = CallSite(func.id, None, chain, node.lineno, node)
+        elif isinstance(func, ast.Attribute):
+            value = func.value
+            if isinstance(value, ast.Name) and value.id == "self":
+                recv = ("self", "")
+            elif (isinstance(value, ast.Attribute)
+                    and isinstance(value.value, ast.Name)
+                    and value.value.id == "self"):
+                recv = ("selfattr", value.attr)
+            elif isinstance(value, ast.Name):
+                recv = ("var", value.id)
+            else:
+                recv = ("expr", "")
+            cs = CallSite(func.attr, recv, chain, node.lineno, node)
+        else:
+            return
+        if owner is not None:
+            owner.calls.append(cs)
+        # Cross-cutting registrations live on the call site:
+        if self._is_signal_signal(sf, cs) and len(node.args) >= 2:
+            self._pending_signal.append((sf, node, owner))
+        jit_target = self._jit_call_target(sf, cs)
+        if jit_target is not None:
+            self._pending_jit.append((sf, node, jit_target))
+
+    def _note_assign(self, sf: SourceFile, node: ast.Assign,
+                     cls: ClassInfo | None,
+                     owner: FunctionInfo | None) -> None:
+        value = node.value
+        if not isinstance(value, ast.Call):
+            return
+        chain = attr_chain(value.func)
+        ctor = chain[-1] if chain else None
+        is_lock = (ctor in _LOCK_CTORS and chain is not None
+                   and self._is_threading(sf, chain))
+        for target in node.targets:
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self" and cls is not None):
+                if is_lock:
+                    lock = LockId(sf.display_path, cls.name, target.attr,
+                                  reentrant=ctor == "RLock")
+                    cls.locks[target.attr] = lock
+                    self.lock_attrs.setdefault(target.attr, []).append(lock)
+                elif ctor is not None:
+                    # Constructor-assignment type hint: resolved against
+                    # the class table lazily (the defining file may not
+                    # be walked yet); non-class ctors just never match.
+                    cls.attr_types[target.attr] = ctor
+            elif isinstance(target, ast.Name) and owner is None:
+                if is_lock:
+                    lock = LockId(sf.display_path, "<module>", target.id,
+                                  reentrant=ctor == "RLock")
+                    self.module_locks.setdefault(
+                        sf.display_path, {})[target.id] = lock
+                    self.lock_attrs.setdefault(target.id, []).append(lock)
+
+    def _is_threading(self, sf: SourceFile, chain: list[str]) -> bool:
+        if len(chain) >= 2:
+            return self.module_of(sf, chain[0]) == "threading"
+        frm = self._from_imports[sf.display_path].get(chain[0])
+        return frm is not None and frm[0] == "threading"
+
+    def _is_signal_signal(self, sf: SourceFile, cs: CallSite) -> bool:
+        if cs.name != "signal":
+            return False
+        if cs.chain and len(cs.chain) >= 2:
+            return self.module_of(sf, cs.chain[0]) == "signal"
+        frm = self._from_imports[sf.display_path].get("signal")
+        return cs.chain == ["signal"] and frm is not None \
+            and frm[0] == "signal"
+
+    # -- jit marks -----------------------------------------------------------
+    def _mark_jit_from_decorators(self, fi: FunctionInfo) -> None:
+        for dec in (fi.node.decorator_list
+                    if hasattr(fi.node, "decorator_list") else []):
+            target = dec
+            statics: set = set()
+            if isinstance(dec, ast.Call):
+                chain = attr_chain(dec.func)
+                if chain and chain[-1] == "partial" and dec.args:
+                    target = dec.args[0]
+                    statics = self._static_params(dec)
+                else:
+                    target = dec.func
+                    statics = self._static_params(dec)
+            chain = attr_chain(target)
+            if chain and chain[-1] == "jit":
+                fi.jitted = True
+                fi.static_params |= statics
+
+    def _jit_call_target(self, sf: SourceFile,
+                         cs: CallSite) -> str | None:
+        """``jax.jit(f, ...)`` call form → the target's bare name (or
+        "self.<attr>" marker), else None."""
+        if cs.name != "jit" or not cs.node.args:
+            return None
+        arg = cs.node.args[0]
+        if isinstance(arg, ast.Name):
+            return arg.id
+        if (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"):
+            return f"self.{arg.attr}"
+        return None
+
+    def _resolve_pending_jit(self) -> None:
+        for sf, node, target in self._pending_jit:
+            statics = self._static_params(node)
+            if target.startswith("self."):
+                name = target[5:]
+                cands = [f for f in self.funcs_named(name)
+                         if f.file is sf and f.cls is not None]
+            else:
+                cands = [f for f in self.funcs_named(target)
+                         if f.file is sf]
+                if not cands:
+                    cands = self.funcs_named(target)
+            for fi in cands:
+                fi.jitted = True
+                fi.static_params |= statics
+
+    @staticmethod
+    def _static_params(call: ast.Call) -> set:
+        statics: set = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                statics |= {e.value for e in kw.value.elts
+                            if isinstance(e, ast.Constant)}
+            elif kw.arg == "static_argnums" and isinstance(
+                    kw.value, ast.Constant):
+                statics.add(kw.value.value)
+            elif kw.arg == "static_argnames":
+                vals = (kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value])
+                statics |= {e.value for e in vals
+                            if isinstance(e, ast.Constant)}
+        return statics
+
+    # -- signal handlers -----------------------------------------------------
+    def _resolve_pending_signals(self) -> None:
+        for sf, node, _owner in self._pending_signal:
+            handler = node.args[1]
+            funcs: list[FunctionInfo] = []
+            if isinstance(handler, ast.Lambda):
+                fi = self._lambda_info.get(id(handler))
+                if fi is not None:
+                    funcs = [fi]
+                desc = f"<lambda>@{handler.lineno}"
+            elif isinstance(handler, ast.Name):
+                funcs = ([f for f in self.funcs_named(handler.id)
+                          if f.file is sf]
+                         or self.funcs_named(handler.id))
+                desc = handler.id
+            elif isinstance(handler, ast.Attribute):
+                chain = attr_chain(handler) or ["?"]
+                if self.chain_module(sf, chain) == "signal":
+                    continue  # SIG_DFL / SIG_IGN re-installs
+                funcs = self.funcs_named(handler.attr)
+                desc = ".".join(chain)
+            else:
+                continue
+            self.signal_registrations.append(
+                SignalRegistration(sf, node.lineno, funcs, desc))
+
+    # -- lock acquisition facts ----------------------------------------------
+    def _lock_for_expr(self, fn: FunctionInfo,
+                       expr: ast.AST) -> list[LockId]:
+        """Lock object(s) an acquisition expression refers to."""
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and fn.cls is not None:
+                for ci in self.classes_named(fn.cls):
+                    if name in ci.locks:
+                        return [ci.locks[name]]
+            return self.lock_attrs.get(name, [])
+        if isinstance(expr, ast.Name):
+            mod_locks = self.module_locks.get(fn.file.display_path, {})
+            if expr.id in mod_locks:
+                return [mod_locks[expr.id]]
+            return self.lock_attrs.get(expr.id, [])
+        return []
+
+    def _index_locks_in(self, fn: FunctionInfo) -> None:
+        """Lock nesting + calls-made-while-held, for BOTH acquisition
+        styles: ``with lock:`` holds over its block, and a bare
+        ``lock.acquire()`` holds for the rest of the enclosing
+        statement sequence until a matching ``.release()`` — the
+        acquire()/try/finally idiom is exactly how the round-13
+        deadlock shape appears when not written as a with-statement.
+        Conservative by direction: a missed release over-reports (one
+        waiver line); a missed acquire is a missed deadlock."""
+        call_sites = {id(c.node): c for c in fn.calls}
+
+        def acquire_release(node: ast.AST
+                            ) -> tuple[str | None, list[LockId]]:
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("acquire", "release")):
+                return (node.func.attr,
+                        self._lock_for_expr(fn, node.func.value))
+            return None, []
+
+        def in_order(node: ast.AST) -> Iterator[ast.AST]:
+            """Document-order walk, nested defs/classes excluded."""
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                yield from in_order(child)
+
+        def note(node: ast.AST, held: tuple[LockId, ...]) -> None:
+            """Edge/call facts for one subtree at a fixed held set."""
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # separate functions, indexed on their own
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    note(item.context_expr, held)
+                    for lock in self._lock_for_expr(
+                            fn, item.context_expr):
+                        fn.acquires.append((lock, node.lineno))
+                        for outer in held:
+                            fn.nested_locks.append(
+                                (outer, lock, node.lineno))
+                        acquired.append(lock)
+                body(node.body, held + tuple(acquired))
+                return
+            if isinstance(node, (ast.If, ast.While, ast.For,
+                                 ast.AsyncFor, ast.Try)):
+                # Branch bodies are statement SEQUENCES of their own so
+                # an acquire() inside them covers their later siblings.
+                for child in ast.iter_child_nodes(node):
+                    if not isinstance(child, (ast.stmt,
+                                              ast.excepthandler)):
+                        note(child, held)
+                for seq in (node.body, getattr(node, "orelse", []),
+                            getattr(node, "finalbody", [])):
+                    body(seq, held)
+                for handler in getattr(node, "handlers", []):
+                    body(handler.body, held)
+                return
+            kind, locks = acquire_release(node)
+            if kind == "acquire":
+                for lock in locks:
+                    fn.acquires.append((lock, node.lineno))
+                    for outer in held:
+                        fn.nested_locks.append((outer, lock,
+                                                node.lineno))
+            elif kind is None and isinstance(node, ast.Call) \
+                    and held and id(node) in call_sites:
+                fn.calls_with_held.append(
+                    (frozenset(held), call_sites[id(node)]))
+            for child in ast.iter_child_nodes(node):
+                note(child, held)
+
+        def body(stmts: Iterable[ast.AST], held: tuple[LockId, ...]
+                 ) -> tuple[LockId, ...]:
+            """One statement sequence: thread acquire()/release()
+            effects (in document order, wherever they sit inside the
+            statement) into the held set of the FOLLOWING statements."""
+            for stmt in stmts:
+                note(stmt, held)
+                for node in in_order(stmt):
+                    kind, locks = acquire_release(node)
+                    if kind == "acquire":
+                        held += tuple(lk for lk in locks
+                                      if lk not in held)
+                    elif kind == "release":
+                        held = tuple(lk for lk in held
+                                     if lk not in locks)
+            return held
+
+        if isinstance(fn.node, ast.Lambda):
+            note(fn.node.body, ())
+        else:
+            body(fn.node.body, ())
+
+    # -- call resolution / reachability --------------------------------------
+    def resolve(self, caller: FunctionInfo,
+                cs: CallSite) -> list[FunctionInfo]:
+        """Candidate callee bodies for one call site (see module
+        docstring for the resolution ladder)."""
+        sf = caller.file
+        if cs.recv is not None and cs.recv[0] == "self":
+            if caller.cls is not None:
+                found = self._method_in(caller.cls, cs.name)
+                if found:
+                    return found
+            return self.funcs_named(cs.name)
+        if cs.recv is not None and cs.recv[0] == "selfattr":
+            if caller.cls is not None:
+                for ci in self.classes_named(caller.cls):
+                    cls_name = ci.attr_types.get(cs.recv[1])
+                    if cls_name:
+                        found = self._method_in(cls_name, cs.name)
+                        if found:
+                            return found
+            return self.funcs_named(cs.name)
+        if cs.recv is not None and cs.recv[0] == "var":
+            mod = self.module_of(sf, cs.recv[1])
+            if mod is not None:
+                target_sf = self._by_module.get(mod)
+                if target_sf is None:
+                    return []  # external module (numpy, jax, ...)
+                return [f for f in self.funcs_named(cs.name)
+                        if f.file is target_sf] or []
+            return self.funcs_named(cs.name)
+        # Bare name: same file first (locals/module functions), then the
+        # import table (a from-import of a linted module resolves there;
+        # of an external module resolves to nothing), then global.
+        local = [f for f in self.funcs_named(cs.name) if f.file is sf]
+        if local:
+            return local
+        frm = self._from_imports[sf.display_path].get(cs.name)
+        if frm is not None:
+            target_sf = self._by_module.get(frm[0])
+            if target_sf is not None:
+                named = [f for f in self.funcs_named(frm[1])
+                         if f.file is target_sf]
+                if named:
+                    return named
+                return self.funcs_named(frm[1])  # __init__ re-export
+            return []  # external import (jax, numpy, stdlib)
+        return self.funcs_named(cs.name)
+
+    def _method_in(self, cls_name: str, meth: str) -> list[FunctionInfo]:
+        out = []
+        seen = set()
+        stack = [cls_name]
+        while stack:
+            cn = stack.pop()
+            if cn in seen:
+                continue
+            seen.add(cn)
+            for ci in self.classes_named(cn):
+                if meth in ci.methods:
+                    out.append(ci.methods[meth])
+                stack.extend(ci.bases)
+        return out
+
+    def reachable(self, roots: Iterable[FunctionInfo], *,
+                  same_dir: bool = False
+                  ) -> dict[str, tuple[FunctionInfo, list[str]]]:
+        """BFS over the call graph: qualname → (function, name chain).
+
+        ``same_dir`` restricts traversal to callees defined in the same
+        directory as the *root* that discovered them (the hot-path rule
+        uses this to stay inside one subsystem).
+        """
+        out: dict[str, tuple[FunctionInfo, list[str]]] = {}
+        queue: list[tuple[FunctionInfo, list[str], str]] = []
+        for r in roots:
+            root_dir = os.path.dirname(r.file.display_path)
+            if r.qualname not in out:
+                out[r.qualname] = (r, [r.qualname])
+                queue.append((r, [r.qualname], root_dir))
+        while queue:
+            fn, chain, root_dir = queue.pop(0)
+            for cs in fn.calls:
+                for callee in self.resolve(fn, cs):
+                    if callee.qualname in out:
+                        continue
+                    if same_dir and os.path.dirname(
+                            callee.file.display_path) != root_dir:
+                        continue
+                    nxt = chain + [callee.qualname]
+                    out[callee.qualname] = (callee, nxt)
+                    queue.append((callee, nxt, root_dir))
+        return out

@@ -1,0 +1,27 @@
+"""graftlint rule registry.
+
+Each rule is one module exporting ``NAME`` (the waiver token) and
+``check(index) -> Iterator[Finding]``. Adding a rule = adding a module
+here + a row in docs/STATIC_ANALYSIS.md + a positive/negative fixture
+pair in tests/test_lint.py.
+"""
+
+from tools.lint.rules import (
+    argparse_percent,
+    determinism,
+    hot_path_transfer,
+    lock_signal_safety,
+    scrape_safety,
+    static_shape,
+)
+
+ALL_RULES = [
+    hot_path_transfer,
+    scrape_safety,
+    lock_signal_safety,
+    static_shape,
+    determinism,
+    argparse_percent,
+]
+
+__all__ = ["ALL_RULES"]

@@ -1,0 +1,96 @@
+"""Rule ``argparse-percent``: no bare ``%`` in argparse help strings.
+
+Origin: the round-11 drive-by — ``resnet/jax_tpu/train.py --help``
+crashed from round 7 to round 11 because one ``--remat`` help string
+contained a bare ``%``. argparse %-formats help text at render time
+(``% dict(default=..., prog=...)``), so any ``%`` not doubled (``%%``)
+or starting a mapping spec (``%(default)s``) raises ``TypeError``/
+``ValueError`` the moment anyone asks for ``--help`` — the one surface
+nobody's tests exercise and every new user hits first. Four rounds of
+latency for a one-character bug is exactly what a static pass is for.
+
+Flags any string literal (f-strings included — their *rendered* result
+is still %-formatted by argparse) passed as the ``help=`` keyword of an
+``add_argument(...)`` call whose ``%`` is not ``%%`` or a complete
+``%(<known key>)<conversion>`` spec — ``%(approx)s`` with a key
+argparse doesn't supply KeyErrors at ``--help`` time exactly like a
+bare ``%``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.lint.core import Finding
+from tools.lint.graph import ProjectIndex
+
+NAME = "argparse-percent"
+
+# The mapping keys argparse actually supplies when %-formatting help
+# (vars(action) + prog — see argparse.HelpFormatter._expand_help): a
+# ``%(typo)s`` outside this set raises KeyError at --help time just
+# like a bare '%', so it is NOT a safe spec.
+_FORMAT_KEYS = {"prog", "default", "type", "choices", "dest", "metavar",
+                "const", "nargs", "required", "help", "option_strings"}
+_CONVERSIONS = set("diouxXeEfFgGcrsa")
+_SPEC_FLAGS = set("-+ #0123456789.")
+
+
+def _bare_percent(text: str) -> bool:
+    i = 0
+    while i < len(text):
+        if text[i] != "%":
+            i += 1
+            continue
+        nxt = text[i + 1] if i + 1 < len(text) else ""
+        if nxt == "%":
+            i += 2  # escaped pair
+            continue
+        if nxt != "(":
+            return True
+        end = text.find(")", i + 2)
+        if end < 0 or text[i + 2:end] not in _FORMAT_KEYS:
+            return True  # unknown key: KeyError at --help time
+        j = end + 1  # optional flags/width, then a conversion char
+        while j < len(text) and text[j] in _SPEC_FLAGS:
+            j += 1
+        if j >= len(text) or text[j] not in _CONVERSIONS:
+            return True
+        i = j + 1
+    return False
+
+
+def _literal_parts(node: ast.AST) -> Iterator[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, ast.JoinedStr):
+        for part in node.values:
+            if isinstance(part, ast.Constant) and isinstance(
+                    part.value, str):
+                yield part.value
+    elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        yield from _literal_parts(node.left)
+        yield from _literal_parts(node.right)
+
+
+def check(index: ProjectIndex) -> Iterator[Finding]:
+    for sf in index.files:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_argument"):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "help":
+                    continue
+                for text in _literal_parts(kw.value):
+                    if _bare_percent(text):
+                        yield Finding(
+                            NAME, sf.display_path, kw.value.lineno,
+                            "bare '%' in an argparse help string — "
+                            "argparse %-formats help at render time, "
+                            "so --help raises TypeError (the round-11 "
+                            "resnet --remat crash); write '%%' or "
+                            "'%(default)s'")
+                        break

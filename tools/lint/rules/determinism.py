@@ -1,0 +1,133 @@
+"""Rule ``determinism``: deterministic paths use seeded RNG, no wall clock.
+
+This repo's correctness proofs are bitwise: kill-and-resume equals
+uninterrupted (resilience round), batched serving equals the sequential
+generator, swap-at-iteration-k reproduces across runs. Every one of
+those collapses if a library path consults the global RNG or the wall
+clock. Flagged in any linted file outside the telemetry allowlist:
+
+- **unseeded global RNG** — ``np.random.<fn>()`` on the module-level
+  generator, stdlib ``random.<fn>()``, ``np.random.RandomState()`` /
+  ``default_rng()`` with no seed, and ``np.random.seed()`` (mutating
+  process-global state is how two runs diverge silently). The repo's
+  idiom is an explicit ``np.random.RandomState(seed)`` per consumer
+  (``data/``) or ``jax.random.fold_in`` streams (everything else).
+- **wall-clock reads** — ``time.time()``, ``datetime.now()`` and
+  friends. Telemetry timestamps its records; deterministic paths never
+  branch on calendar time. (Monotonic interval clocks —
+  ``perf_counter``/``monotonic`` — are latency measurement, not a
+  determinism hazard, and are not flagged.)
+
+Allowlist (telemetry by design): any file under an ``observability``
+directory, plus ``utils/logging.py`` and ``utils/profiling.py`` — the
+flight recorder's ``wall_time``, trace epochs, and the throughput meter
+legitimately read the clock.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator
+
+from tools.lint.core import Finding
+from tools.lint.graph import ProjectIndex, attr_chain
+
+NAME = "determinism"
+
+ALLOWLIST_DIRS = {"observability"}
+ALLOWLIST_FILES = {os.path.join("utils", "logging.py"),
+                   os.path.join("utils", "profiling.py")}
+
+_SEEDED_OK = {"RandomState", "default_rng", "Generator", "SeedSequence",
+              "get_state", "set_state", "bit_generator"}
+_STDLIB_RANDOM = {"random", "randint", "randrange", "choice", "choices",
+                  "shuffle", "sample", "uniform", "gauss", "seed",
+                  "getrandbits", "betavariate", "expovariate",
+                  "normalvariate"}
+_TIME_FUNCS = {"time", "localtime", "ctime", "gmtime", "asctime"}
+_DATETIME_FUNCS = {"now", "utcnow", "today", "fromtimestamp"}
+
+
+def _allowlisted(display_path: str) -> bool:
+    parts = display_path.split(os.sep)
+    if any(p in ALLOWLIST_DIRS for p in parts):
+        return True
+    return any(display_path.endswith(suffix) for suffix in ALLOWLIST_FILES)
+
+
+def _origin(index: ProjectIndex, sf, chain: list[str]) -> str | None:
+    """Dotted external origin of a Name-rooted chain: ``np.random.rand``
+    → ``numpy.random.rand``; None when the root isn't an import."""
+    root = chain[0]
+    imports = index._imports[sf.display_path]
+    from_imports = index._from_imports[sf.display_path]
+    if root in imports:
+        base = imports[root]
+    elif root in from_imports:
+        mod, orig = from_imports[root]
+        base = f"{mod}.{orig}"
+    else:
+        return None
+    return ".".join([base] + chain[1:])
+
+
+def check(index: ProjectIndex) -> Iterator[Finding]:
+    for sf in index.files:
+        if _allowlisted(sf.display_path):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if not chain:
+                continue
+            origin = _origin(index, sf, chain)
+            if origin is None:
+                continue
+            parts = origin.split(".")
+            fn = parts[-1]
+            if parts[:2] == ["numpy", "random"] and len(parts) == 3:
+                if fn in _SEEDED_OK:
+                    if fn in ("RandomState", "default_rng") \
+                            and not node.args and not node.keywords:
+                        yield Finding(
+                            NAME, sf.display_path, node.lineno,
+                            f"np.random.{fn}() without a seed draws "
+                            f"from OS entropy — deterministic paths "
+                            f"must thread an explicit seed "
+                            f"(data/ idiom: RandomState(seed))")
+                elif fn == "seed":
+                    yield Finding(
+                        NAME, sf.display_path, node.lineno,
+                        "np.random.seed() mutates process-global RNG "
+                        "state — use a local np.random.RandomState"
+                        "(seed) / default_rng(seed) stream instead")
+                else:
+                    yield Finding(
+                        NAME, sf.display_path, node.lineno,
+                        f"np.random.{fn}() uses the unseeded global "
+                        f"generator — two runs diverge silently; use "
+                        f"np.random.RandomState(seed) (the data/ "
+                        f"idiom) or fold a jax PRNG key")
+            elif parts[0] == "random" and len(parts) == 2 \
+                    and fn in _STDLIB_RANDOM:
+                yield Finding(
+                    NAME, sf.display_path, node.lineno,
+                    f"stdlib random.{fn}() uses the process-global "
+                    f"generator — deterministic paths must use a "
+                    f"seeded stream")
+            elif parts[0] == "time" and len(parts) == 2 \
+                    and fn in _TIME_FUNCS:
+                yield Finding(
+                    NAME, sf.display_path, node.lineno,
+                    f"wall-clock read time.{fn}() in a deterministic "
+                    f"path — calendar time belongs to telemetry "
+                    f"(observability/ is allowlisted); intervals use "
+                    f"perf_counter")
+            elif "datetime" in parts[:-1] and fn in _DATETIME_FUNCS:
+                yield Finding(
+                    NAME, sf.display_path, node.lineno,
+                    f"wall-clock read datetime.{fn}() in a "
+                    f"deterministic path — calendar time belongs to "
+                    f"telemetry (observability/ is allowlisted)")

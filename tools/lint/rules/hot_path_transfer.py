@@ -1,0 +1,120 @@
+"""Rule ``hot-path-transfer``: no hidden device↔host syncs in hot loops.
+
+The static complement of ``tests/test_transfer_guard.py``: the runtime
+guard catches *implicit* transfers on a real accelerator, but the CPU
+test mesh can't observe device→host fetches (buffers ARE host memory),
+so an ``.item()``/``float()``/``np.asarray()`` smuggled into a step
+body or the decode loop ships silently until it stalls a TPU. This rule
+flags host-materialization calls inside the codebase's hot scopes:
+
+- functions compiled by ``jax.jit`` and their same-directory callees
+  (a transfer inside traced code is a trace-time error waiting to
+  happen — or a constant-folding surprise);
+- nested step bodies defined inside ``make_*``/``build_*`` builders
+  (``train/step.py``, ``train/lm_step.py``) and their callees;
+- ``Engine.step`` and everything it reaches inside ``serving/``;
+- HTTP handler methods (``do_GET``/``do_POST``) and their callees —
+  the exporter's handler thread must never touch a device.
+
+Deliberate syncs (the engine's per-iteration token landing, the TTFT
+measurement point) carry ``# graftlint: disable=hot-path-transfer``
+waivers naming why the sync is the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from tools.lint.core import Finding
+from tools.lint.graph import FunctionInfo, ProjectIndex, attr_chain
+
+NAME = "hot-path-transfer"
+
+# Methods that ARE the hot loop, by (class, method) shape.
+HOT_ROOT_METHODS = {("Engine", "step")}
+HANDLER_NAMES = {"do_GET", "do_POST"}
+# Step builders specifically (make_train_step, make_lm_eval_fn, ...):
+# data-loader builders (build_dataloaders) are HOST pipelines by design
+# — numpy materialization there is the job, not a leak.
+BUILDER_RE = re.compile(r"^_?make_.*_(step|fn)$")
+# Attribute calls that force a device→host transfer outright.
+FETCH_ATTRS = {"item", "tolist", "block_until_ready"}
+# Scalar conversions: flagged when applied to a computed value (bare
+# name / subscript), not to config attributes or literals.
+CONVERT_FUNCS = {"float", "int", "bool"}
+
+
+def _hot_functions(index: ProjectIndex
+                   ) -> dict[str, tuple[FunctionInfo, list[str]]]:
+    roots: list[FunctionInfo] = []
+    for fn in index.iter_functions():
+        if (fn.cls, fn.name) in HOT_ROOT_METHODS:
+            roots.append(fn)
+        elif fn.name in HANDLER_NAMES:
+            roots.append(fn)
+        elif fn.jitted:
+            roots.append(fn)
+        elif fn.parent is not None and BUILDER_RE.match(fn.parent):
+            roots.append(fn)
+    return index.reachable(roots, same_dir=True)
+
+
+def _is_numpy(index: ProjectIndex, fn: FunctionInfo,
+              chain: list[str] | None) -> bool:
+    return (chain is not None and len(chain) >= 2
+            and index.module_of(fn.file, chain[0]) == "numpy")
+
+
+def _computed_arg(node: ast.Call) -> bool:
+    """Is the first argument a computed value (vs config/literal)?"""
+    if not node.args:
+        return False
+    arg = node.args[0]
+    return isinstance(arg, (ast.Name, ast.Subscript))
+
+
+def check(index: ProjectIndex) -> Iterator[Finding]:
+    for qualname, (fn, chain) in sorted(_hot_functions(index).items()):
+        root = chain[0].split("::")[-1]
+        where = (f"hot path via {root}" if len(chain) > 1
+                 else f"hot function {root}")
+        # Scalar conversions are only evidence near the device boundary
+        # (the hot-loop module itself); a cross-module helper receives
+        # host scalars — by then the sync (if any) already happened and
+        # was flagged (or waived) at the boundary.
+        root_file = chain[0].split("::")[0]
+        check_converts = fn.file.display_path == root_file
+        for cs in fn.calls:
+            node = cs.node
+            if cs.recv is not None and cs.name in FETCH_ATTRS:
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f".{cs.name}() in {where} forces a device→host "
+                    f"transfer; keep metrics device-resident and fetch "
+                    f"at flush boundaries (utils/logging.py contract)")
+            elif cs.name == "device_get":
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f"jax.device_get in {where}: explicit fetches belong "
+                    f"at flush boundaries, not in the per-step path")
+            elif (cs.name in ("asarray", "array")
+                    and _is_numpy(index, fn, cs.chain)
+                    and (_computed_arg(node)
+                         or (node.args
+                             and isinstance(node.args[0], ast.Call)))):
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f"np.{cs.name}(...) on a computed value in {where} "
+                    f"materializes it on the host (a device sync when "
+                    f"the value is a JAX array)")
+            elif (check_converts and cs.recv is None
+                    and cs.name in CONVERT_FUNCS
+                    and cs.chain == [cs.name] and _computed_arg(node)):
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f"{cs.name}(...) on a computed value in {where} "
+                    f"blocks on the device when the value is a JAX "
+                    f"array (the reference repo's per-step "
+                    f"loss.item() anti-pattern)")

@@ -1,0 +1,119 @@
+"""Rule ``lock-signal-safety``: signal frames set events; locks nest one way.
+
+Origin: the round-13 hot-swap review. The first cut of the serve CLI's
+SIGUSR1 rollback called ``HotSwapper.rollback()`` *inline in the signal
+handler* — which takes the engine's non-reentrant ``_swap_lock``, which
+the serving loop holds around the swap barrier *on the very thread the
+signal interrupts*: a self-deadlock with zero test coverage until the
+review caught it. The shipped fix (``request_rollback``) only sets
+``threading.Event``\\ s; the rollback runs on the watcher thread. This
+rule makes that pattern load-bearing:
+
+1. **signal-handler-reaches-lock** — for every ``signal.signal(sig,
+   handler)`` registration, walk the handler's call graph (lambdas
+   included); any reachable ``threading.Lock``/``RLock`` acquisition is
+   flagged. A handler interrupts an arbitrary bytecode boundary of an
+   arbitrary thread — if that thread holds the lock, the process hangs.
+2. **lock-order-inversion** — every nesting ``A held while B acquired``
+   (directly, or through a call made while holding A) contributes an
+   edge; both ``A→B`` and ``B→A`` present is a deadlock-shaped cycle.
+3. **non-reentrant re-acquire** — an ``A→A`` edge on a plain ``Lock``
+   (the inline-rollback shape, intra-thread this time) deadlocks
+   unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.lint.core import Finding
+from tools.lint.graph import FunctionInfo, LockId, ProjectIndex
+
+NAME = "lock-signal-safety"
+
+
+def _closure_locks(index: ProjectIndex, fn: FunctionInfo,
+                   memo: dict) -> set[LockId]:
+    """Locks acquired by ``fn`` or anything it (transitively) calls.
+
+    Computed over the full :meth:`ProjectIndex.reachable` set rather
+    than by memoized recursion: a recursive walk's mid-cycle cache
+    entries are *incomplete* (the cycle guard would freeze an empty set
+    for whichever function the traversal entered a cycle through), and
+    an order-dependent miss here is a missed deadlock."""
+    if fn.qualname in memo:
+        return memo[fn.qualname]
+    out: set[LockId] = set()
+    for callee, _chain in index.reachable([fn]).values():
+        out |= {lock for lock, _ in callee.acquires}
+    memo[fn.qualname] = out
+    return out
+
+
+def check(index: ProjectIndex) -> Iterator[Finding]:
+    memo: dict = {}
+
+    # 1. Signal handlers must not reach lock acquisitions.
+    for reg in index.signal_registrations:
+        reach = index.reachable(reg.handlers)
+        seen: set[LockId] = set()
+        for qualname in sorted(reach):
+            fn, chain = reach[qualname]
+            for lock, line in fn.acquires:
+                if lock in seen:
+                    continue
+                seen.add(lock)
+                via = " -> ".join(q.split("::")[-1] for q in chain)
+                yield Finding(
+                    NAME, reg.file.display_path, reg.line,
+                    f"signal handler {reg.desc!r} reaches acquisition "
+                    f"of {lock.render()} "
+                    f"({fn.file.display_path}:{line}, via {via}) — a "
+                    f"handler interrupts an arbitrary thread; if that "
+                    f"thread holds the lock this deadlocks (round-13 "
+                    f"inline-rollback bug). Handlers may only set "
+                    f"events; do the locked work on a worker thread")
+
+    # 2./3. Lock-ordering edges: direct nesting + calls-while-held.
+    edges: dict[tuple[LockId, LockId], tuple[str, int, str]] = {}
+    for fn in index.iter_functions():
+        for outer, inner, line in fn.nested_locks:
+            edges.setdefault(
+                (outer, inner),
+                (fn.file.display_path, line, fn.qualname.split("::")[-1]))
+        for held, cs in fn.calls_with_held:
+            for callee in index.resolve(fn, cs):
+                for inner in _closure_locks(index, callee, memo):
+                    for outer in held:
+                        edges.setdefault(
+                            (outer, inner),
+                            (fn.file.display_path, cs.line,
+                             f"{fn.qualname.split('::')[-1]} -> "
+                             f"{callee.qualname.split('::')[-1]}"))
+
+    reported: set[frozenset] = set()
+    for (a, b), (path, line, where) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0], kv[1][1])):
+        if a == b:
+            if not a.reentrant:
+                yield Finding(
+                    NAME, path, line,
+                    f"non-reentrant lock {a.render()} can be "
+                    f"re-acquired while held (via {where}) — "
+                    f"threading.Lock self-deadlocks; restructure to "
+                    f"snapshot-then-act outside the lock, or use the "
+                    f"one-lock-section pattern (serving/engine.py "
+                    f"rollback notes)")
+            continue
+        pair = frozenset((a, b))
+        if pair in reported:
+            continue
+        if (b, a) in edges:
+            reported.add(pair)
+            rpath, rline, rwhere = edges[(b, a)]
+            yield Finding(
+                NAME, path, line,
+                f"lock-order inversion: {a.render()} -> {b.render()} "
+                f"here (via {where}) but {b.render()} -> {a.render()} "
+                f"at {rpath}:{rline} (via {rwhere}) — two threads "
+                f"taking these in opposite orders deadlock")

@@ -1,0 +1,90 @@
+"""Rule ``scrape-safety``: the exporter handler thread only *reads*.
+
+The round-11 live-telemetry contract (``observability/exporter.py``
+module docstring): a ``/metrics``/``/healthz``/``/vars`` scrape runs on
+the HTTP handler thread while the train/decode loop is hot, so the
+handler call graph must only touch host-side state the hot loop already
+materialized. Concretely, nothing reachable from a handler or a
+snapshot provider may
+
+- **read a device** (``device_get``, ``block_until_ready``, ``.item``,
+  allocator ``memory_stats``) — a scrape that syncs the device stalls
+  the step it raced;
+- **enter a collective** (``psum``/``all_gather``/
+  ``process_allgather``/...) — one host scraping while others train is
+  a stranded barrier;
+- **mutate telemetry** (``flush``, ``mark_gap``, ``on_*`` recorder
+  hooks, ``dump``) — a scrape observes; ``Engine.flight_snapshot``
+  deliberately does NOT flush (pinned by tests/test_exporter.py) and
+  this rule keeps every future provider honest;
+- **dispatch a compiled program** (any ``jax.jit``-marked callee, or a
+  flax ``.apply``).
+
+Roots: HTTP ``do_GET``/``do_POST`` methods (and everything they reach,
+including ``MetricsExporter._handle``), plus the known snapshot-provider
+surface — functions named ``flight_snapshot``/``scrape_snapshot``/
+``health``, and the ``phase`` property of classes that expose a
+``flight_snapshot`` (the exporter's ``phase_provider`` wiring).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from tools.lint.core import Finding
+from tools.lint.graph import FunctionInfo, ProjectIndex
+
+NAME = "scrape-safety"
+
+HANDLER_NAMES = {"do_GET", "do_POST"}
+PROVIDER_NAMES = {"flight_snapshot", "scrape_snapshot", "health"}
+
+DEVICE_READS = {"device_get", "block_until_ready", "item", "tolist",
+                "memory_stats", "device_memory_metrics"}
+COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+               "all_reduce", "ppermute", "all_to_all",
+               "process_allgather", "broadcast_one_to_all",
+               "sync_global_devices", "reduce_scatter"}
+TELEMETRY_MUTATION = {"flush", "record_flush", "record_step", "mark_gap",
+                      "dump", "dump_flight", "observe", "begin_work",
+                      "end_work", "on_step", "on_flush", "on_tokens",
+                      "on_kv", "on_admitted", "on_finished",
+                      "on_iteration", "on_idle", "on_admission_blocked",
+                      "on_swap_applied", "on_swap_rejected"}
+COMPILED_DISPATCH = {"apply"}
+
+
+def _roots(index: ProjectIndex) -> list[FunctionInfo]:
+    roots = [fn for fn in index.iter_functions()
+             if fn.name in HANDLER_NAMES or fn.name in PROVIDER_NAMES]
+    for cls_list in index.classes.values():
+        for ci in cls_list:
+            if "flight_snapshot" in ci.methods and "phase" in ci.methods:
+                roots.append(ci.methods["phase"])
+    return roots
+
+
+def check(index: ProjectIndex) -> Iterator[Finding]:
+    reach = index.reachable(_roots(index))
+    for qualname in sorted(reach):
+        fn, chain = reach[qualname]
+        via = " -> ".join(q.split("::")[-1] for q in chain)
+        for cs in fn.calls:
+            kind = None
+            if cs.name in DEVICE_READS:
+                kind = "a device read"
+            elif cs.name in COLLECTIVES:
+                kind = "a collective"
+            elif cs.name in TELEMETRY_MUTATION:
+                kind = "telemetry mutation"
+            elif cs.name in COMPILED_DISPATCH or any(
+                    callee.jitted for callee in index.resolve(fn, cs)):
+                kind = "a compiled-program dispatch"
+            if kind is not None:
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f"scrape path ({via}) reaches {kind} "
+                    f"'{cs.name}()' — the exporter handler thread must "
+                    f"only read host-side state the hot loop already "
+                    f"materialized (docs/OBSERVABILITY.md, round-11 "
+                    f"contract)")

@@ -1,0 +1,145 @@
+"""Rule ``static-shape``: no Python branching on traced values in jit.
+
+The codebase's whole XLA discipline (docs/ARCHITECTURE.md, the serving
+engine's "masks, never shapes" rule) rests on every compiled program
+having ONE trace: slot membership is boolean masks, chunk lanes are
+fixed-width, eviction is a select — because a Python ``if``/``while``
+on a traced value either raises ``TracerBoolConversionError`` at trace
+time or, worse, silently bakes one branch into the compiled program and
+retraces per value. This rule flags, inside any function compiled by
+``jax.jit`` (decorator, ``partial(jax.jit, ...)``, or the call form
+``jax.jit(f)`` / ``jax.jit(self._impl)``):
+
+- ``if`` / ``while`` / ternary / ``assert`` whose test uses a traced
+  parameter as a *bare value* (``if n > 0``, ``while jnp.any(m)``,
+  ``if x:``).
+
+NOT flagged (static under tracing, the repo's idiomatic guards):
+shape/dtype attribute access (``leaf.ndim == 0``, ``x.shape[1]``),
+``is None`` / ``is not None`` identity tests, ``isinstance``/``len``/
+``hasattr`` calls, parameters named in ``static_argnums``/
+``static_argnames``, and closure variables (config captured at build
+time is static by construction).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.lint.core import Finding
+from tools.lint.graph import FunctionInfo, ProjectIndex, attr_chain
+
+NAME = "static-shape"
+
+_STATIC_GUARDS = {"len", "isinstance", "hasattr", "getattr", "type"}
+
+
+def _traced_params(fn: FunctionInfo) -> set[str]:
+    params = [p for p in fn.params if p != "self"]
+    statics: set[str] = set()
+    for s in fn.static_params:
+        if isinstance(s, str):
+            statics.add(s)
+        elif isinstance(s, int) and 0 <= s < len(params):
+            statics.add(params[s])
+    return set(params) - statics
+
+
+def _naked_uses(node: ast.AST, traced: set[str]) -> set[str]:
+    """Traced names used as *values* (not via static guards) in a test."""
+    if isinstance(node, ast.Name):
+        return {node.id} & traced
+    if isinstance(node, ast.Attribute):
+        return set()  # x.shape / x.ndim / x.dtype: static under tracing
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        if chain and chain[-1] in _STATIC_GUARDS:
+            return set()
+        out: set[str] = set()
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            out |= _naked_uses(arg, traced)
+        return out
+    if isinstance(node, ast.Compare):
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return set()
+        out = _naked_uses(node.left, traced)
+        for comp in node.comparators:
+            out |= _naked_uses(comp, traced)
+        return out
+    out = set()
+    for child in ast.iter_child_nodes(node):
+        out |= _naked_uses(child, traced)
+    return out
+
+
+def _arg_names(args: ast.arguments) -> set[str]:
+    names = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def _scan(node: ast.AST, traced: set[str]
+          ) -> Iterator[tuple[ast.AST, str, set[str]]]:
+    """(test_node, construct, offenders) for every dynamic-control-flow
+    site; nested defs/lambdas are scanned with shadowed names removed
+    (they trace in the same jit context, so outer traced names still
+    count)."""
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        traced = traced - _arg_names(node.args)
+        for child in node.body:
+            yield from _scan(child, traced)
+        return
+    if isinstance(node, ast.Lambda):
+        yield from _scan(node.body, traced - _arg_names(node.args))
+        return
+    if isinstance(node, ast.ClassDef):
+        return
+    if isinstance(node, (ast.If, ast.While)):
+        offenders = _naked_uses(node.test, traced)
+        if offenders:
+            yield (node.test,
+                   "while" if isinstance(node, ast.While) else "if",
+                   offenders)
+    elif isinstance(node, ast.Assert):
+        offenders = _naked_uses(node.test, traced)
+        if offenders:
+            yield node.test, "assert", offenders
+    elif isinstance(node, ast.IfExp):
+        offenders = _naked_uses(node.test, traced)
+        if offenders:
+            yield node.test, "ternary", offenders
+    for child in ast.iter_child_nodes(node):
+        yield from _scan(child, traced)
+
+
+def check(index: ProjectIndex) -> Iterator[Finding]:
+    for fn in index.iter_functions():
+        if not fn.jitted or isinstance(fn.node, ast.Lambda):
+            continue
+        traced = _traced_params(fn)
+        if not traced:
+            continue
+        seen: set[tuple[int, str]] = set()
+        for stmt in fn.node.body:
+            findings_here = list(_scan(stmt, traced))
+            yield from _emit(fn, findings_here, seen)
+
+
+def _emit(fn: FunctionInfo, sites: list, seen: set) -> Iterator[Finding]:
+    for test, construct, offenders in sites:
+        key = (test.lineno, construct)
+        if key in seen:
+            continue
+        seen.add(key)
+        names = ", ".join(sorted(offenders))
+        yield Finding(
+            NAME, fn.file.display_path, test.lineno,
+            f"python `{construct}` on traced value(s) {names} inside "
+            f"jitted '{fn.name}' — control flow must be static under "
+            f"XLA: use lax.cond/lax.select/jnp.where, a boolean mask, "
+            f"or mark the argument static "
+            f"(static_argnums/static_argnames)")

@@ -79,6 +79,13 @@ def add_argument() -> argparse.Namespace:
                         "boundary under the Poisson load, so the SLA "
                         "line measures swap cost (swaps_completed, "
                         "swap_blocked_s) alongside latency. 0 = off")
+    p.add_argument("--check-compiles", action="store_true", default=False,
+                   help="compiled-program sanitizer: after warm-up, pin "
+                        "the engine's program inventory (paged: 2, "
+                        "legacy: 3; docs/SERVING.md) and fail — exit 1, "
+                        "one-line error — if anything recompiles inside "
+                        "the measured window (silent retrace growth). "
+                        "Requires warm-up (ignored with --no-warmup)")
     p.add_argument("--flight-dump", type=str, default=None)
     p.add_argument("--metrics-port", type=int, default=None,
                    help="live telemetry plane: /metrics (Prometheus "
@@ -184,6 +191,26 @@ def main() -> int:
         print(f"[serve_bench] warm-up done ({warm_tokens} tokens)",
               file=sys.stderr)
 
+    compile_watch = None
+    if args.check_compiles and not args.no_warmup:
+        # Sanitizer (observability/sanitizer.py): the warm engine's
+        # program inventory must match docs/SERVING.md, and the measured
+        # window below must not compile anything at all.
+        from distributed_training_tpu.observability.sanitizer import (
+            CompileWatch,
+            RecompileError,
+            check_engine_inventory,
+        )
+
+        try:
+            inventory = check_engine_inventory(engine)
+        except RecompileError as e:
+            print(f"serve_bench: error: {e}", file=sys.stderr)
+            return 1
+        print(f"[serve_bench] compiled-program inventory OK: "
+              f"{inventory}", file=sys.stderr)
+        compile_watch = CompileWatch()
+
     n = args.requests
     load = prompts(n)
     # Poisson process: exponential inter-arrival gaps at the target rate.
@@ -225,6 +252,17 @@ def main() -> int:
     # a hard stop here used to drop tail requests from the percentiles.
     finished += len(engine.drain())
     assert finished == n, f"drained {finished} of {n} requests"
+
+    if compile_watch is not None:
+        from distributed_training_tpu.observability.sanitizer import (
+            RecompileError,
+        )
+
+        try:
+            compile_watch.check_no_growth("the measured serving window")
+        except RecompileError as e:
+            print(f"serve_bench: error: {e}", file=sys.stderr)
+            return 1
 
     stats = engine.stats()
     stats["requests"] = n
