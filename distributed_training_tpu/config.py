@@ -500,6 +500,36 @@ class ServeConfig:
     # disables.
     ttft_deadline_ms: float | None = None
     deadline_ms: float | None = None
+    # Speculative decoding (docs/SERVING.md "Speculative decoding"):
+    # per decode iteration each slot's drafter proposes up to spec_k
+    # tokens and the target model verifies all spec_k+1 positions in ONE
+    # dispatch (a fixed-width verify window — the decode step
+    # generalized from [max_batch, 1] to [max_batch, spec_k+1]).
+    # Acceptance is lossless: every emitted token is the target's own
+    # sample under the sequential fold_in(rng, position) stream, so
+    # greedy output stays bitwise token-identical to the sequential
+    # Generator and sampled output bitwise equal to the non-speculative
+    # engine — drafts only decide how many tokens one dispatch lands.
+    # 0 = off (the verify window degenerates to the plain decode step).
+    # Trade-off: larger k lands more tokens per dispatch when the
+    # drafter is right, but pays k+1 positions of target compute per
+    # iteration regardless; past the drafter's typical run length the
+    # extra width is pure waste.
+    spec_k: int = 0
+    # Drafter backend: "ngram" = self-contained prompt-lookup drafter
+    # (zero extra params, no extra compiled program — the default);
+    # "gpt" = a GPT draft model proposing greedily over a fixed
+    # spec_draft_window token window (adds ONE compiled 'draft' program;
+    # defaults to self-drafting with the serving model's own weights,
+    # kept fresh across hot-swaps — a separate small draft model plugs
+    # in via Engine(..., drafter=GPTDrafter(model, params))).
+    spec_drafter: str = "ngram"
+    # Longest context suffix the n-gram drafter matches (it backs off
+    # max..1 and proposes the continuation of the most recent match).
+    spec_ngram: int = 3
+    # GPT drafter: context tokens re-run per draft step (right-aligned,
+    # pad-filled); must fit the draft model's positional table.
+    spec_draft_window: int = 16
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -539,6 +569,21 @@ class ServeConfig:
             raise ValueError(
                 f"max_len must be >= 2 (one prompt token + one generated), "
                 f"got {self.max_len}")
+        if self.spec_k < 0:
+            raise ValueError(
+                f"spec_k must be >= 0 (0 = speculation off), "
+                f"got {self.spec_k}")
+        if self.spec_drafter not in ("ngram", "gpt"):
+            raise ValueError(
+                f"spec_drafter must be 'ngram' or 'gpt', "
+                f"got {self.spec_drafter!r}")
+        if self.spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1, got {self.spec_ngram}")
+        if self.spec_draft_window < 1:
+            raise ValueError(
+                f"spec_draft_window must be >= 1, "
+                f"got {self.spec_draft_window}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -320,8 +320,10 @@ def init_decode_cache(model: "TransformerLM", params: Any,
     shared by every decode slot — routing state (page tables, write
     positions) is per-call :class:`~distributed_training_tpu.parallel.
     ring_attention.PagedKV` input, not cache state, so the same pool
-    pytree serves both the [max_batch, 1] decode batch and the
-    [1, prefill_chunk] chunk inside the engine's fused step.
+    pytree serves the [max_batch, 1] decode batch, the
+    [1, prefill_chunk] chunk inside the engine's fused step, and the
+    [max_batch, spec_k + 1] speculative verify window — window width is
+    a call shape, never cache state.
     """
     paged = getattr(model, "kv_page_size", None) is not None
 

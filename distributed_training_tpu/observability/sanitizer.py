@@ -147,7 +147,10 @@ def jit_cache_size(fn) -> int | None:
 # The documented serving inventory (docs/SERVING.md): program counts
 # per engine mode, and the per-program shape pins. Legacy prefill is
 # bucketed — one shape per prompt bucket actually served — so its shape
-# count is workload-dependent and pinned by the caller.
+# count is workload-dependent and pinned by the caller. Speculation
+# (serving/speculative.py) leaves both counts alone — the verify window
+# IS the decode program at a wider fixed shape — except a GPT drafter,
+# which contributes exactly one extra single-shape 'draft' program.
 PAGED_PROGRAMS = 2
 LEGACY_PROGRAMS = 3
 _MULTI_SHAPE_OK = {"prefill"}
@@ -158,13 +161,15 @@ def check_engine_inventory(engine, *, prefill_shapes: int | None = None
     """Pin a serving engine's compiled programs against the docs.
 
     Checks (via ``Engine.compiled_programs()``): the program COUNT is
-    exactly 2 (paged) / 3 (legacy), and every program that has run
-    holds exactly one compiled shape — except legacy ``prefill``,
-    whose bucket count is pinned by ``prefill_shapes`` when given.
-    Returns the observed ``{name: shapes}`` inventory for logging.
+    exactly 2 (paged) / 3 (legacy) — plus the drafter's ``draft``
+    program when one reports it — and every program that has run holds
+    exactly one compiled shape, except legacy ``prefill``, whose bucket
+    count is pinned by ``prefill_shapes`` when given. Returns the
+    observed ``{name: shapes}`` inventory for logging.
     """
     progs = engine.compiled_programs()
     expected = PAGED_PROGRAMS if engine.paged else LEGACY_PROGRAMS
+    expected += 1 if "draft" in progs else 0
     mode = "paged" if engine.paged else "legacy"
     if len(progs) != expected:
         raise RecompileError(

@@ -35,8 +35,11 @@ class PagedKV(NamedTuple):
     the KV cache is the paged pool (``kv_page_size`` set): the cache
     collection then holds only the position-free page pool, while WHICH
     pool rows a batch row reads/writes travels here — so a decode batch
-    of ``max_batch`` slots and a ``[1, chunk]`` prefill chunk can share
-    one pool inside one compiled step despite different batch shapes.
+    of ``max_batch`` slots, a ``[1, chunk]`` prefill chunk, and a
+    ``[max_batch, spec_k + 1]`` speculative verify window (the decode
+    batch widened with per-slot draft tokens, ``serving/speculative.py``)
+    all share one pool inside one compiled step despite different batch
+    shapes — the attend is general over the incoming window width.
 
     - ``table`` int32 [B, pages_per_slot]: each row's logical→physical
       page map. Unallocated logical pages point at physical page 0, the
@@ -387,6 +390,14 @@ class RingSelfAttention(nn.Module):
         null page) is masked to -inf exactly like the contiguous tail —
         so greedy outputs stay token-identical to the sequential
         ``Generator`` (pinned by tests/test_serving.py).
+
+        The engine's speculative verify window rides this same
+        generality: ``T_in = spec_k + 1`` rows per slot (incoming token
+        + drafts), scatter-before-gather meaning each draft row attends
+        the rows before it in the SAME call — which is what lets a
+        rejected draft suffix be overwritten by the next window before
+        any valid query can see it (tests/test_speculative.py pins the
+        resulting bitwise oracle).
         """
         b, t_in = q.shape[0], q.shape[1]
         if self.kv_pages is None:

@@ -18,6 +18,12 @@ restated for XLA's static-shape world:
   prefill-chunk+decode step and a decode-only step over one shared page
   pool), the legacy contiguous slot-axis trio behind
   ``kv_page_size=None``, and the admit→prefill→decode→evict loop.
+- :mod:`speculative` — draft-and-verify speculative decoding: a per-slot
+  drafter (prompt-lookup n-gram by default, or a GPT draft model)
+  proposes ``spec_k`` tokens and the engine's decode step widens to a
+  fixed ``[max_batch, spec_k + 1]`` verify window with a mask-based
+  lossless accept — emitted tokens stay bitwise identical to the
+  sequential path, one dispatch lands up to ``spec_k + 1`` of them.
 - :mod:`metrics` — TTFT/TPOT/throughput/queue-depth SLA telemetry through
   the round-7 flight recorder, plus KV/slot utilization accounting
   (reserved-vs-written cache positions, queue-wait vs prefill breakdown,
@@ -60,3 +66,8 @@ from distributed_training_tpu.serving.request import (  # noqa: F401
     Request,
 )
 from distributed_training_tpu.serving.scheduler import SlotScheduler  # noqa: F401
+from distributed_training_tpu.serving.speculative import (  # noqa: F401
+    Drafter,
+    GPTDrafter,
+    NGramDrafter,
+)

@@ -46,9 +46,23 @@ Shared discipline either way — masks, never shapes:
   decode is token-identical to the sequential ``Generator`` (pinned by
   ``tests/test_serving.py``).
 
+**Speculative decoding** (``ServeConfig.spec_k`` > 0;
+``serving/speculative.py``, docs/SERVING.md "Speculative decoding"): a
+per-slot drafter proposes up to ``spec_k`` tokens each iteration and
+the decode lane widens to a fixed ``[max_batch, spec_k + 1]`` verify
+window — the target model verifies every position in the one dispatch
+it was already paying for. Acceptance is an argmax over a mismatch
+mask (static shape) and is lossless by construction: each position's
+token is the target's own sample under the sequential
+``fold_in(rng, position)`` stream, so emitted tokens are bitwise
+identical to the non-speculative engine and the sequential
+``Generator`` — drafts only set how many of them land per dispatch.
+The compiled-program inventory is unchanged (the window IS the decode
+step); a GPT drafter adds one single-shape ``draft`` program.
+
 SLA telemetry (TTFT / TPOT / throughput / queue depth / KV-page
-utilization) flows through the round-7 flight recorder via
-:class:`ServeTelemetry`; ``dump_flight`` writes a
+utilization / draft acceptance) flows through the round-7 flight
+recorder via :class:`ServeTelemetry`; ``dump_flight`` writes a
 ``tools/flight_report.py``-readable record.
 """
 
@@ -78,6 +92,10 @@ from distributed_training_tpu.serving.pages import PagePool, pages_for
 from distributed_training_tpu.serving.queue import RequestQueue
 from distributed_training_tpu.serving.request import FinishedRequest, Request
 from distributed_training_tpu.serving.scheduler import SlotScheduler
+from distributed_training_tpu.serving.speculative import (
+    make_drafter,
+    truncate_at_eos,
+)
 
 
 class Engine:
@@ -103,7 +121,7 @@ class Engine:
     """
 
     def __init__(self, model: Any, params: Any, cfg: ServeConfig, *,
-                 trace=None, weights_epoch: int = -1):
+                 trace=None, weights_epoch: int = -1, drafter=None):
         check_unsharded(model)
         self.cfg = cfg
         self.trace = trace
@@ -114,6 +132,19 @@ class Engine:
                 f"plus a generated token")
         self.paged = cfg.kv_page_size is not None
         self.params = params
+        # Speculative decoding (serving/speculative.py): the decode step
+        # becomes a [max_batch, spec_k + 1] verify window — spec_k drafts
+        # per slot verified alongside the incoming token in one dispatch,
+        # with a mask-based accept so every shape stays static. spec_k=0
+        # degenerates to the plain one-token step (spec_width 1).
+        self.spec_k = int(cfg.spec_k)
+        self.spec_width = self.spec_k + 1
+        if drafter is not None and not self.spec_k:
+            raise ValueError(
+                "a drafter requires spec_k >= 1 (speculation is off)")
+        self.drafter = (drafter if drafter is not None
+                        else make_drafter(cfg, model, params)
+                        ) if self.spec_k else None
         # Live weight hot-swap state (serving/hotswap.py). The engine
         # serves exactly one params version at a time; a staged
         # candidate waits under the lock until the next iteration
@@ -158,12 +189,30 @@ class Engine:
             # padding compute.
             self.prefill_chunk = min(int(cfg.prefill_chunk),
                                      max(self.budget - 1, 1))
+            # Gather width of one slot's page-table view; verify-window
+            # padding rows clamp their positions under this so the
+            # per-row overflow poison never fires on a masked lane.
+            self._l_all = self.pages_per_slot * ps
         else:
             self.page_size = None
             self.pool = None
             # One clone with the serving cache length; every compiled
-            # program below derives its shapes from it.
-            self.model = model.clone(cache_len=self.budget)
+            # program below derives its shapes from it. Speculation
+            # needs spec_k slack positions past the admission budget:
+            # the contiguous write (dynamic_update_slice) lands ALL
+            # spec_width rows — padding included — so a full window
+            # starting at the last admissible write head must fit, or
+            # the cache's overflow poison fires on a legal request.
+            cache_len = self.budget + self.spec_k
+            if cache_len > int(model.max_len):
+                raise ValueError(
+                    f"spec_k={self.spec_k} on the legacy contiguous "
+                    f"path needs budget + spec_k <= the positional "
+                    f"table (got {self.budget} + {self.spec_k} > "
+                    f"{model.max_len}); lower max_len or use the paged "
+                    f"cache (kv_page_size), whose window padding is "
+                    f"validity-masked instead of written")
+            self.model = model.clone(cache_len=cache_len)
 
         self.queue = RequestQueue(
             self.budget, default_max_new_tokens=cfg.max_new_tokens,
@@ -216,37 +265,69 @@ class Engine:
             self._admit = jax.jit(
                 self._admit_impl,
                 donate_argnums=(0, 1, 2, 3) if donate else ())
-            self._decode = jax.jit(
-                self._decode_impl,
-                donate_argnums=(1, 2, 3) if donate else ())
+            # Speculation swaps the decode program for the verify-window
+            # variant (host-authoritative write heads, W-wide lanes);
+            # the inventory stays three programs either way.
+            if self.spec_k:
+                self._decode = jax.jit(
+                    self._verify_legacy_impl,
+                    donate_argnums=(1,) if donate else ())
+            else:
+                self._decode = jax.jit(
+                    self._decode_impl,
+                    donate_argnums=(1, 2, 3) if donate else ())
 
     # -- compiled pieces: paged KV + chunked prefill -------------------------
-    def _decode_step(self, params, cache, tok, pos, active, rngs, tables):
-        """One token for every active slot through the paged pool.
+    def _decode_step(self, params, cache, tok, pos, valid, rngs, tables):
+        """One verify window for every slot through the paged pool.
 
-        ``tok``/``pos``/``active``/``rngs`` are [B]-shaped host state;
-        ``tables`` [B, pages_per_slot]. Inactive lanes still compute
-        (static shapes) but write the null page and sample pad — their
-        slot's pages are untouched, so a freed slot's pool pages stay
-        bitwise intact until the allocator reuses them. Each lane's
-        row arithmetic matches the sequential ``Generator``'s one-token
-        step exactly (the [B, 1] batch extends batch dims only, never
-        the M dimension of any matmul — the bitwise-stability boundary).
+        ``tok``/``pos``/``valid`` are [B, W] host state (W = spec_k + 1;
+        W = 1 is the plain decode step), ``rngs`` [B], ``tables``
+        [B, pages_per_slot]. Row 0 of each lane is the slot's incoming
+        token; rows 1..W-1 are its drafter's proposals. Invalid rows
+        (inactive slots, budget-clamped or short proposals) still
+        compute (static shapes) but write the null page and sample pad —
+        a freed slot's pool pages stay bitwise intact until the
+        allocator reuses them. Each valid row's arithmetic matches the
+        sequential ``Generator``'s one-token step exactly: the window
+        extends the same per-row-independent dimension chunked prefill
+        already extends (pinned bitwise), and the per-position sample
+        uses the sequential ``fold_in(rng, position)`` stream — so every
+        emitted token IS the sequential stream's token, drafts only
+        decide how many of them this dispatch computes.
+
+        Accept length is computed HERE, static-shape: the first
+        mismatching draft position via argmax over a [W] mismatch mask
+        with a sentinel column (all-match accepts spec_k). Invalid rows
+        count as mismatches, so accept never crosses the valid width.
+        Returns (cache, targets [B, W], accept [B]).
         """
-        pages = PagedKV(table=tables, positions=pos[:, None],
-                        valid=active[:, None])
+        pages = PagedKV(table=tables, positions=pos, valid=valid)
         logits, vars_out = self.model.apply(
-            {"params": params, "cache": cache}, tok[:, None],
-            positions=pos[:, None], train=False, decode=True,
+            {"params": params, "cache": cache}, tok,
+            positions=pos, train=False, decode=True,
             mutable=["cache"], pages=pages)
 
-        def lane(rng_s, pos_s, row):
-            return sample_token(jax.random.fold_in(rng_s, pos_s),
-                                row[None], self.sample_cfg)[0]
+        def lane(rng_s, pos_row, rows):
+            def one(pos_s, row):
+                return sample_token(jax.random.fold_in(rng_s, pos_s),
+                                    row[None], self.sample_cfg)[0]
 
-        nxt = jax.vmap(lane)(rngs, pos, logits[:, -1, :])
-        nxt = jnp.where(active, nxt, jnp.int32(self.sample_cfg.pad_id))
-        return vars_out["cache"], nxt
+            return jax.vmap(one)(pos_row, rows)
+
+        t = jax.vmap(lane)(rngs, pos, logits)
+        t = jnp.where(valid, t, jnp.int32(self.sample_cfg.pad_id))
+        return vars_out["cache"], t, self._accept_len(tok, t, valid)
+
+    def _accept_len(self, tok, t, valid):
+        """[B] accepted-draft counts from a verify window (see
+        :meth:`_decode_step`); pure ops, no control flow on traced
+        values — the mask-based formulation the static-shape discipline
+        requires."""
+        mismatch = (tok[:, 1:] != t[:, :-1]) | ~valid[:, 1:]
+        sentinel = jnp.ones((tok.shape[0], 1), bool)
+        return jnp.argmax(jnp.concatenate([mismatch, sentinel], axis=1),
+                          axis=1).astype(jnp.int32)
 
     def _chunk_step(self, params, cache, toks, pos, valid, table, rng):
         """One prefill chunk ``[1, C]`` for the oldest prefilling slot.
@@ -272,24 +353,25 @@ class Engine:
         sampled = jax.vmap(row)(pos, logits[0])
         return vars_out["cache"], sampled
 
-    def _fused_impl(self, params, cache, d_tok, d_pos, d_active, d_rngs,
+    def _fused_impl(self, params, cache, d_tok, d_pos, d_valid, d_rngs,
                     tables, c_tok, c_pos, c_valid, c_table, c_rng):
         """The fused iteration: one prefill chunk piggybacks onto the
-        decode batch inside one compiled program (Sarathi-Serve), so an
-        admission costs decode ZERO extra dispatches and never blocks
-        it. The two sub-applies touch disjoint pages (the chunk's slot
-        is not decoding), so their order is arithmetic-free."""
+        decode batch's verify window inside one compiled program
+        (Sarathi-Serve), so an admission costs decode ZERO extra
+        dispatches and never blocks it. The two sub-applies touch
+        disjoint pages (the chunk's slot is not decoding), so their
+        order is arithmetic-free."""
         cache, c_sampled = self._chunk_step(params, cache, c_tok, c_pos,
                                             c_valid, c_table, c_rng)
-        cache, nxt = self._decode_step(params, cache, d_tok, d_pos,
-                                       d_active, d_rngs, tables)
-        return cache, nxt, c_sampled
+        cache, nxt, accept = self._decode_step(
+            params, cache, d_tok, d_pos, d_valid, d_rngs, tables)
+        return cache, nxt, accept, c_sampled
 
-    def _decode_only_impl(self, params, cache, d_tok, d_pos, d_active,
+    def _decode_only_impl(self, params, cache, d_tok, d_pos, d_valid,
                           d_rngs, tables):
         """Iterations with no prefill pending skip the chunk lane's
         compute entirely (the second compiled program)."""
-        return self._decode_step(params, cache, d_tok, d_pos, d_active,
+        return self._decode_step(params, cache, d_tok, d_pos, d_valid,
                                  d_rngs, tables)
 
     # -- compiled pieces: legacy contiguous slots ----------------------------
@@ -366,6 +448,46 @@ class Engine:
         pos = jnp.where(active, pos + 1, pos)
         return new_cache, nxt, pos
 
+    def _verify_legacy_impl(self, params, cache, tok, pos0, valid, rngs):
+        """Speculative verify window on the contiguous slot cache:
+        ``tok``/``valid`` [B, W], ``pos0`` [B] (each lane's write head,
+        host-authoritative). Forcing each lane's ``cache_index`` to the
+        host head IS the speculative rewind: a rejected suffix simply
+        never advances the head, and the next window's leading rows
+        overwrite the stale K/V (contiguous writes land all W rows, so
+        padding rows park garbage at positions strictly past every
+        valid query — masked now, overwritten later). Accept length is
+        the same mask/argmax as the paged step; inactive lanes compute
+        but the active mask discards their cache like plain decode.
+        """
+
+        def lane(cache_s, tok_row, pos0_s, rng_s):
+            cache_s = jax.tree.map(
+                lambda leaf: (pos0_s.astype(leaf.dtype)
+                              if leaf.ndim == 0 else leaf), cache_s)
+            positions = pos0_s + jnp.arange(tok_row.shape[0])
+            logits, vars_out = self.model.apply(
+                {"params": params, "cache": cache_s}, tok_row[None, :],
+                positions=positions[None], train=False, decode=True,
+                mutable=["cache"])
+
+            def one(pos_s, row):
+                return sample_token(jax.random.fold_in(rng_s, pos_s),
+                                    row[None], self.sample_cfg)[0]
+
+            return vars_out["cache"], jax.vmap(one)(positions, logits[0])
+
+        new_cache, t = jax.vmap(lane)(cache, tok, pos0, rngs)
+        active = valid[:, 0]
+
+        def keep(new, old):
+            mask = active.reshape((-1,) + (1,) * (new.ndim - 1))
+            return jnp.where(mask, new, old)
+
+        new_cache = jax.tree.map(keep, new_cache, cache)
+        t = jnp.where(valid, t, jnp.int32(self.sample_cfg.pad_id))
+        return new_cache, t, self._accept_len(tok, t, valid)
+
     # -- host-side lifecycle -------------------------------------------------
     def submit(self, prompt, max_new_tokens: int | None = None,
                arrival_t: float | None = None) -> Request:
@@ -429,6 +551,86 @@ class Engine:
         first = int(tok)
         t = time.perf_counter()
         self._note_first_token(seq, first, t)
+
+    def _draft_window(self, decoding):
+        """Assemble the [max_batch, spec_width] verify-window inputs for
+        one iteration (host-side numpy, like all slot routing).
+
+        Row 0 of a decoding slot's lane is its incoming token at write
+        head ``p``; rows 1..useful are its drafter's proposals at
+        ``p+1..p+useful``, where ``useful = min(spec_k, remaining
+        completion budget - 1, proposal length)`` — the budget clamp
+        keeps every VALID write inside the request's worst-case page
+        commitment (paged) / admission budget (legacy), so speculation
+        never grows what admission promised. Padding rows are
+        validity-masked; on the paged path their positions additionally
+        clamp under the page-table width so the per-row overflow poison
+        cannot fire on a masked lane. Returns ``(tok, pos, valid,
+        useful_by_slot, drafted)``.
+        """
+        s = self.cfg.max_batch
+        w = self.spec_width
+        d_tok = np.full((s, w), self.sample_cfg.pad_id, np.int32)
+        d_pos = np.zeros((s, w), np.int32)
+        d_valid = np.zeros((s, w), bool)
+        useful_by_slot: dict[int, int] = {}
+        drafted = 0
+        for seq in decoding:
+            p = seq.request.prompt.size + len(seq.tokens) - 1
+            useful = 0
+            if self.spec_k:
+                cap = seq.request.max_new_tokens - len(seq.tokens) - 1
+                useful = min(self.spec_k, max(cap, 0))
+            if useful > 0:
+                ctx = np.concatenate([
+                    seq.request.prompt,
+                    np.asarray(seq.tokens, np.int32)])
+                # graftlint: disable=hot-path-transfer -- drafter proposals are host numpy by protocol; this normalizes third-party drafter output, no device value involved
+                props = np.asarray(
+                    self.drafter.propose(ctx, self.spec_k),
+                    np.int32).reshape(-1)
+                useful = min(useful, props.size)
+                d_tok[seq.slot, 1:1 + useful] = props[:useful]
+            d_tok[seq.slot, 0] = seq.tokens[-1]
+            win_pos = p + np.arange(w)
+            if self.paged:
+                win_pos = np.minimum(win_pos, self._l_all - 1)
+            d_pos[seq.slot] = win_pos
+            d_valid[seq.slot, :useful + 1] = True
+            useful_by_slot[seq.slot] = useful
+            drafted += useful
+        return d_tok, d_pos, d_valid, useful_by_slot, drafted
+
+    def _apply_accepts(self, decoding, toks, accepts, useful_by_slot,
+                       t: float) -> tuple[int, int]:
+        """Land one verify window's results: each slot emits its
+        verified prefix plus the bonus/correction token (``accept + 1``
+        tokens, EOS-truncated — the sequential loop would have stopped
+        there). The rejected suffix needs no device work to roll back:
+        the host write head (derived from ``len(tokens)``) simply does
+        not advance past the accepted prefix, and the next window's
+        leading valid rows overwrite the stale K/V before any valid
+        query can attend it. Returns ``(tokens emitted, drafts
+        accepted)``; also draws the per-slot accept marks on the trace.
+        """
+        emitted = 0
+        accepted = 0
+        eos = self.sample_cfg.eos_id
+        for seq in decoding:
+            # graftlint: disable=hot-path-transfer -- accepts already landed host-side with the iteration sync; this indexes a numpy array
+            a = int(accepts[seq.slot])
+            emit = truncate_at_eos(toks[seq.slot, :a + 1], eos)
+            for tk in emit:
+                seq.note_token(tk, t)
+            emitted += emit.size
+            accepted += emit.size - 1
+            if self.trace is not None and self.spec_k:
+                self.trace.instant(
+                    "spec.accept", track=f"slot {seq.slot}", t=t,
+                    uid=seq.request.uid,
+                    drafted=useful_by_slot.get(seq.slot, 0),
+                    accepted=emit.size - 1)
+        return emitted, accepted
 
     def _note_first_token(self, seq, first: int, t: float) -> None:
         """Shared first-token bookkeeping: the TTFT measurement point.
@@ -555,6 +757,14 @@ class Engine:
             self._install_params(params)
             # graftlint: disable=hot-path-transfer -- epoch is a staged host int, not a device value
             self.weights_epoch = int(epoch)
+        if self.drafter is not None:
+            # No stale-drafter window: a self-drafting (mirror) drafter
+            # re-points its params snapshot at the freshly installed
+            # tree inside the same barrier, so the very next draft
+            # proposes from the weights the verifier now serves
+            # (serving/speculative.py; pinned by tests). epoch is
+            # already a host int (arm_swap stages it as one).
+            self.drafter.on_weights_swap(params, epoch)
         dt = time.perf_counter() - t0
         self.telemetry.recorder.mark_gap()
         self.telemetry.on_swap_applied(dt)
@@ -631,18 +841,20 @@ class Engine:
 
         if chunk_seq is not None or decoding:
             t_step0 = time.perf_counter()
-            s = self.cfg.max_batch
-            d_tok = np.zeros((s,), np.int32)
-            d_pos = np.zeros((s,), np.int32)
-            d_active = np.zeros((s,), bool)
+            # Verify-window assembly (plain one-token decode when
+            # spec_k=0): incoming token + drafts per decoding slot;
+            # pages ensured only for the VALID width, so speculation
+            # draws nothing beyond the admission commitment.
+            d_tok, d_pos, d_valid, useful_by_slot, drafted = \
+                self._draft_window(decoding)
             for seq in decoding:
-                # Write position of the incoming token = tokens already
-                # cached (prompt + generated minus the uncached last).
+                # Write positions of this window = tokens already
+                # cached (prompt + generated minus the uncached last)
+                # through the last valid draft row.
                 p = seq.request.prompt.size + len(seq.tokens) - 1
-                self._ensure_pages(seq.slot, p + 1)
-                d_tok[seq.slot] = seq.tokens[-1]
-                d_pos[seq.slot] = p
-                d_active[seq.slot] = True
+                self._ensure_pages(
+                    seq.slot, p + useful_by_slot[seq.slot] + 1)
+            t_draft1 = time.perf_counter()
             c = 0
             if chunk_seq is not None:
                 n = chunk_seq.request.prompt.size
@@ -656,26 +868,35 @@ class Engine:
                 c_tok[:c] = chunk_seq.request.prompt[start:start + c]
                 c_pos[:c] = np.arange(start, start + c)
                 c_valid[:c] = True
-                self._cache, nxt, c_sampled = self._fused(
+                self._cache, nxt, acc, c_sampled = self._fused(
                     self.params, self._cache, jnp.asarray(d_tok),
-                    jnp.asarray(d_pos), jnp.asarray(d_active),
+                    jnp.asarray(d_pos), jnp.asarray(d_valid),
                     jnp.asarray(self._slot_rng),
                     jnp.asarray(self._tables), jnp.asarray(c_tok),
                     jnp.asarray(c_pos), jnp.asarray(c_valid),
                     jnp.asarray(self._tables[chunk_seq.slot][None]),
                     jnp.asarray(self._slot_rng[chunk_seq.slot]))
             else:
-                self._cache, nxt = self._decode(
+                self._cache, nxt, acc = self._decode(
                     self.params, self._cache, jnp.asarray(d_tok),
-                    jnp.asarray(d_pos), jnp.asarray(d_active),
+                    jnp.asarray(d_pos), jnp.asarray(d_valid),
                     jnp.asarray(self._slot_rng),
                     jnp.asarray(self._tables))
             # graftlint: disable=hot-path-transfer -- THE per-iteration sync: tokens must land (docs/SERVING.md)
             toks = np.asarray(nxt)
+            # graftlint: disable=hot-path-transfer -- per-slot accept lengths ride the same iteration sync
+            accepts = np.asarray(acc)
             t = time.perf_counter()
-            for seq in decoding:
-                seq.note_token(toks[seq.slot], t)
-            self.telemetry.on_tokens(len(decoding), t)
+            emitted, accepted = self._apply_accepts(
+                decoding, toks, accepts, useful_by_slot, t)
+            if self.spec_k:
+                # Host-side accept/rewind bookkeeping cost, attributed
+                # explicitly like admission_blocked_s/swap_blocked_s.
+                self.telemetry.on_spec(
+                    drafted=drafted, accepted=accepted,
+                    rollback_s=time.perf_counter() - t)
+            self.telemetry.on_decode(lanes=len(decoding), tokens=emitted)
+            self.telemetry.on_tokens(emitted, t)
             if chunk_seq is not None:
                 start = chunk_seq.prefill_pos
                 chunk_seq.prefill_pos = start + c
@@ -714,6 +935,18 @@ class Engine:
             if blocked_t0 is not None:
                 self.telemetry.on_admission_blocked(t - blocked_t0)
             if self.trace is not None:
+                if self.spec_k and decoding:
+                    # Draft (proposal assembly, host) and verify (the
+                    # batched target dispatch) phases of the iteration;
+                    # the per-slot accept marks land in _apply_accepts.
+                    self.trace.complete("draft", t_step0, t_draft1,
+                                        track="engine", iteration=it,
+                                        tokens=drafted,
+                                        slots=len(decoding))
+                    self.trace.complete("verify", t_draft1, t,
+                                        track="engine", iteration=it,
+                                        drafted=drafted,
+                                        accepted=accepted)
                 self.trace.complete("decode", t_step0, t, track="engine",
                                     iteration=it, active=len(decoding),
                                     # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg
@@ -760,17 +993,54 @@ class Engine:
         active_seqs = self.scheduler.active()
         if active_seqs:
             t_decode = time.perf_counter()
-            mask = self.scheduler.active_mask()
-            self._cache, nxt, self._pos = self._decode(
-                self.params, self._cache, self._tok, self._pos,
-                jnp.asarray(mask), self._rngs)
-            self._tok = nxt
-            # graftlint: disable=hot-path-transfer -- THE per-iteration sync: tokens must land (docs/SERVING.md)
-            toks = np.asarray(nxt)
-            t = time.perf_counter()
-            for seq in active_seqs:
-                seq.note_token(toks[seq.slot], t)
-            self.telemetry.on_tokens(len(active_seqs), t)
+            if self.spec_k:
+                # Verify-window variant: slot routing (write heads,
+                # tokens, drafts) is host-assembled like the paged path;
+                # the compiled lane forces each slot's cache_index to
+                # the host head, which IS the speculative rewind.
+                d_tok, d_pos, d_valid, useful_by_slot, drafted = \
+                    self._draft_window(active_seqs)
+                t_draft1 = time.perf_counter()
+                self._cache, nxt, acc = self._decode(
+                    self.params, self._cache, jnp.asarray(d_tok),
+                    jnp.asarray(d_pos[:, 0]), jnp.asarray(d_valid),
+                    self._rngs)
+                # graftlint: disable=hot-path-transfer -- THE per-iteration sync: tokens must land (docs/SERVING.md)
+                toks = np.asarray(nxt)
+                # graftlint: disable=hot-path-transfer -- per-slot accept lengths ride the same iteration sync
+                accepts = np.asarray(acc)
+                t = time.perf_counter()
+                emitted, accepted = self._apply_accepts(
+                    active_seqs, toks, accepts, useful_by_slot, t)
+                self.telemetry.on_spec(
+                    drafted=drafted, accepted=accepted,
+                    rollback_s=time.perf_counter() - t)
+                self.telemetry.on_decode(lanes=len(active_seqs),
+                                         tokens=emitted)
+                self.telemetry.on_tokens(emitted, t)
+                if self.trace is not None:
+                    self.trace.complete("draft", t_decode, t_draft1,
+                                        track="engine", iteration=it,
+                                        tokens=drafted,
+                                        slots=len(active_seqs))
+                    self.trace.complete("verify", t_draft1, t,
+                                        track="engine", iteration=it,
+                                        drafted=drafted,
+                                        accepted=accepted)
+            else:
+                mask = self.scheduler.active_mask()
+                self._cache, nxt, self._pos = self._decode(
+                    self.params, self._cache, self._tok, self._pos,
+                    jnp.asarray(mask), self._rngs)
+                self._tok = nxt
+                # graftlint: disable=hot-path-transfer -- THE per-iteration sync: tokens must land (docs/SERVING.md)
+                toks = np.asarray(nxt)
+                t = time.perf_counter()
+                for seq in active_seqs:
+                    seq.note_token(toks[seq.slot], t)
+                self.telemetry.on_decode(lanes=len(active_seqs),
+                                         tokens=len(active_seqs))
+                self.telemetry.on_tokens(len(active_seqs), t)
             # KV utilization, host-side only: a slot's occupied cache
             # positions equal prompt + decode-written tokens — the
             # device cache_index reconstructed without a device read;
@@ -908,8 +1178,11 @@ class Engine:
         (docs/SERVING.md): paged = ``fused`` + ``decode`` (2 programs,
         one shape each once warm); legacy = ``prefill`` + ``admit`` +
         ``decode`` (3 programs; prefill holds one shape per prompt
-        bucket served). Values are None when the running jax doesn't
-        expose the jit cache."""
+        bucket served). Speculation does not change these counts — the
+        verify window replaces the decode lane at a wider fixed shape —
+        but a GPT drafter contributes its own single-shape ``draft``
+        program. Values are None when the running jax doesn't expose
+        the jit cache."""
         from distributed_training_tpu.observability.sanitizer import (
             jit_cache_size,
         )
@@ -918,7 +1191,10 @@ class Engine:
         else:
             progs = {"prefill": self._prefill, "admit": self._admit,
                      "decode": self._decode}
-        return {name: jit_cache_size(fn) for name, fn in progs.items()}
+        out = {name: jit_cache_size(fn) for name, fn in progs.items()}
+        if self.drafter is not None:
+            out.update(self.drafter.compiled_programs())
+        return out
 
     # -- telemetry surface ---------------------------------------------------
     def stats(self) -> dict[str, Any]:

@@ -100,6 +100,24 @@ class ServeTelemetry:
         self.swaps_completed = 0
         self.swaps_rejected = 0
         self.swap_blocked_s = 0.0
+        # Speculative decoding accounting (serving/speculative.py):
+        # drafts proposed vs drafts that became emitted tokens, and the
+        # host-side accept/rewind bookkeeping wall time. Both token
+        # counters are workload-deterministic (a slot's drafts and
+        # accepts are pure functions of its own token stream, never of
+        # batch neighbors), so the bench gate holds them zero-drift
+        # like the KV counters.
+        self.tokens_drafted = 0
+        self.tokens_accepted = 0
+        self.spec_rollback_s = 0.0
+        # Decode dispatch economics: slot-lane dispatches vs tokens they
+        # landed. Their ratio is the speculation speedup factor at
+        # fixed dispatch cost (1.0 with speculation off) — DETERMINISTIC
+        # (a pure function of each request's token stream), which is
+        # what lets CI gate the speedup on shared hardware where
+        # wall-clock throughput jitters ±2x.
+        self.decode_lanes = 0
+        self.decode_tokens = 0
         self.tokens_emitted = 0
         self.requests_finished = 0
         self.finish_reasons: dict[str, int] = {}
@@ -187,6 +205,26 @@ class ServeTelemetry:
         self.swaps_completed += 1
         self.swap_blocked_s += max(float(blocked_s), 0.0)
 
+    def on_decode(self, *, lanes: int, tokens: int) -> None:
+        """One decode iteration's dispatch economics: ``lanes``
+        slot-lane verifications landed ``tokens`` emitted tokens
+        (equal without speculation; tokens/lanes is the per-dispatch
+        speedup with it)."""
+        self.decode_lanes += int(lanes)
+        self.decode_tokens += int(tokens)
+
+    def on_spec(self, *, drafted: int, accepted: int,
+                rollback_s: float) -> None:
+        """One speculative iteration's draft economics: ``drafted``
+        proposal tokens entered the verify window, ``accepted`` of them
+        became emitted tokens (the bonus/correction token is target
+        compute, not a draft, so it counts in neither), and the host
+        spent ``rollback_s`` on accept/rewind bookkeeping — attributed
+        explicitly like ``admission_blocked_s``."""
+        self.tokens_drafted += int(drafted)
+        self.tokens_accepted += int(accepted)
+        self.spec_rollback_s += max(float(rollback_s), 0.0)
+
     def on_swap_rejected(self) -> None:
         """A swap candidate died somewhere in the pipeline (verify /
         stage / validate / arm); the engine kept its old weights."""
@@ -273,6 +311,23 @@ class ServeTelemetry:
             "swaps_completed": self.swaps_completed,
             "swaps_rejected": self.swaps_rejected,
             "swap_blocked_s": self.swap_blocked_s,
+            # Speculative decoding (serving/speculative.py): the draft
+            # economics the bench gate reads. drafted/accepted are
+            # zero-drift workload-deterministic; acceptance_rate is
+            # their ratio (0.0 with speculation off).
+            "drafted_tokens": int(self.tokens_drafted),
+            "accepted_tokens": int(self.tokens_accepted),
+            "spec_acceptance_rate": (
+                self.tokens_accepted / self.tokens_drafted
+                if self.tokens_drafted else 0.0),
+            # Tokens landed per decode slot-lane dispatch: the
+            # deterministic speedup factor the CI speculation gate
+            # asserts (1.0 speculation-off; wall-clock throughput on
+            # shared runners is too noisy to carry the >= 1.3x claim).
+            "spec_tokens_per_dispatch": (
+                self.decode_tokens / self.decode_lanes
+                if self.decode_lanes else 0.0),
+            "spec_rollback_s": self.spec_rollback_s,
         }
 
     def _serving_section(self, stats: dict[str, Any] | None

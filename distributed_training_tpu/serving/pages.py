@@ -21,6 +21,18 @@ sequence can never hit pool exhaustion mid-flight — overload queues at
 admission (or raises the typed :class:`~distributed_training_tpu.
 inference.sampler.CacheBudgetError` at submit when a request could
 never fit the pool), it does not corrupt a running batch.
+
+Speculative decoding changes nothing here by design
+(``serving/speculative.py``): a verify window's VALID writes stop at
+``prompt + len(tokens) - 1 + useful`` where ``useful`` is clamped to
+the remaining completion budget minus one — i.e. at most position
+``prompt + max_new_tokens - 2``, the same worst-case write the
+commitment already covers — and window padding rows write the null
+page. A rejected draft suffix never frees pages early either: its
+pages stay with the slot (they are inside the commitment) and the next
+window overwrites them, so accept-rewind cycles keep
+:meth:`PagePool.check_balanced` green (pinned by
+``tests/test_speculative.py``).
 """
 
 from __future__ import annotations

@@ -14,6 +14,14 @@ every iteration boundary the scheduler
 Mid-iteration the slot set is immutable — the decode step sees a boolean
 active mask and per-slot cache write heads, nothing else. All state here
 is host-side Python; no jax imports.
+
+Speculative decoding is invisible to the scheduler: a slot may emit
+several tokens per iteration (the engine's verify window,
+``serving/speculative.py``), but membership still only changes at
+boundaries, and :meth:`SlotScheduler.evict_finished` reads the same
+``tokens``/EOS/budget state — a mid-window EOS is truncated by the
+engine before it lands here, so ``tokens[-1]`` remains the finishing
+token exactly as in one-token decode.
 """
 
 from __future__ import annotations

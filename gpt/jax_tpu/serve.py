@@ -65,6 +65,31 @@ def add_argument() -> argparse.Namespace:
                         help="LEGACY prefill (--kv-page-size 0): prompt "
                              "lengths pad to a multiple of this (bounds "
                              "prefill compile count)")
+    # Speculative decoding (docs/SERVING.md "Speculative decoding").
+    parser.add_argument("--spec-k", type=int, default=0,
+                        help="speculative decoding: draft tokens "
+                             "proposed per slot per iteration and "
+                             "verified by the serving model in one "
+                             "fixed-width dispatch; acceptance is "
+                             "lossless (greedy output stays bitwise "
+                             "identical to sequential decode, sampled "
+                             "output distribution-identical). 0 = off")
+    parser.add_argument("--spec-drafter", type=str, default="ngram",
+                        choices=["ngram", "gpt"],
+                        help="'ngram' = prompt-lookup drafter (zero "
+                             "extra params); 'gpt' = greedy draft "
+                             "model over a --spec-draft-window token "
+                             "window, self-drafting with the serving "
+                             "weights (hot-swap keeps it fresh). A "
+                             "separately trained draft checkpoint "
+                             "plugs in via the Engine API "
+                             "(serving/speculative.py::GPTDrafter)")
+    parser.add_argument("--spec-ngram", type=int, default=3,
+                        help="longest context suffix the n-gram "
+                             "drafter matches (backs off to 1)")
+    parser.add_argument("--spec-draft-window", type=int, default=16,
+                        help="gpt drafter: context tokens re-run per "
+                             "draft step")
     # Graceful degradation (resilience round; docs/RESILIENCE.md).
     parser.add_argument("--max-queue-depth", type=int, default=None,
                         help="bounded admission: a submit beyond this "
@@ -206,6 +231,10 @@ def main() -> int:
         kv_pages=args.kv_pages,
         prefill_chunk=args.prefill_chunk,
         prefill_bucket=args.prefill_bucket,
+        spec_k=args.spec_k,
+        spec_drafter=args.spec_drafter,
+        spec_ngram=args.spec_ngram,
+        spec_draft_window=args.spec_draft_window,
         max_queue_depth=args.max_queue_depth,
         ttft_deadline_ms=args.ttft_deadline_ms,
         deadline_ms=args.deadline_ms,

@@ -142,3 +142,45 @@ class TestEngineInventory:
         # test that only touches warm code can assert silence.
         assert compile_watch.compiles == 0
         compile_watch.check_no_growth("fixture smoke")
+
+
+class TestSpeculationInventory:
+    """Speculation-on counts (docs/SERVING.md): the verify window IS
+    the decode program at a wider fixed shape — the n-gram drafter
+    changes NO count, a GPT drafter adds exactly one single-shape
+    'draft' program, and the warm speculative steady state compiles
+    nothing (varying accept lengths and proposal widths are masks,
+    never shapes)."""
+
+    def test_paged_spec_ngram_keeps_two_programs(self, lm):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=2, max_new_tokens=6, temperature=0.0,
+            prefill_chunk=4, spec_k=2))
+        _submit(eng, [3, 5, 7])
+        assert len(eng.run()) == 3
+        progs = eng.compiled_programs()
+        assert progs == {"fused": 1, "decode": 1}
+        assert check_engine_inventory(eng) == progs
+        # Warm speculative serving: accept lengths vary per iteration,
+        # shapes never do.
+        with CompileWatch() as watch:
+            _submit(eng, [3, 5, 7], seed=1)
+            assert len(eng.run()) == 3
+        watch.check_no_growth("warm speculative serving")
+
+    def test_gpt_drafter_adds_one_draft_program(self, lm):
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=2, max_new_tokens=6, temperature=0.0,
+            prefill_chunk=4, spec_k=2, spec_drafter="gpt",
+            spec_draft_window=8))
+        _submit(eng, [3, 5])
+        assert len(eng.run()) == 2
+        progs = eng.compiled_programs()
+        assert progs == {"fused": 1, "decode": 1, "draft": 1}
+        assert check_engine_inventory(eng) == progs
+        with CompileWatch() as watch:
+            _submit(eng, [3, 5], seed=1)
+            assert len(eng.run()) == 2
+        watch.check_no_growth("warm gpt-drafted serving")

@@ -83,6 +83,19 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     # zero baseline (see compare()).
     "swaps_completed": ("both", 0.0),
     "swaps_rejected": ("lower", 0.0),
+    # speculative decoding (serving/speculative.py): drafts and accepts
+    # are pure functions of each request's own token stream (never of
+    # batch neighbors or host timing), so both counters are zero-drift
+    # workload-deterministic like the KV accounting; acceptance-rate
+    # falling is the drafter getting worse — a real regression even
+    # when throughput jitter hides it
+    "drafted_tokens": ("both", 0.0),
+    "accepted_tokens": ("both", 0.0),
+    "spec_acceptance_rate": ("higher", 0.25),
+    # tokens landed per decode dispatch — the deterministic speculation
+    # speedup factor (derived from the zero-drift counters, so it only
+    # moves when the accept economics really change)
+    "spec_tokens_per_dispatch": ("higher", 0.05),
 }
 
 

@@ -61,6 +61,23 @@ def add_argument() -> argparse.Namespace:
                         "decode iteration (paged mode)")
     p.add_argument("--prefill-bucket", type=int, default=16,
                    help="LEGACY prefill bucketing (--kv-page-size 0)")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: drafts proposed per slot "
+                        "per iteration, verified in one fixed-width "
+                        "[max_batch, k+1] dispatch with lossless accept "
+                        "(docs/SERVING.md). 0 = off")
+    p.add_argument("--spec-drafter", type=str, default="ngram",
+                   choices=["ngram", "gpt"],
+                   help="drafter backend: 'ngram' = prompt-lookup, zero "
+                        "extra params; 'gpt' = greedy draft model over "
+                        "a fixed window (self-drafts with the serving "
+                        "weights; adds one compiled 'draft' program)")
+    p.add_argument("--spec-ngram", type=int, default=3,
+                   help="longest context suffix the n-gram drafter "
+                        "matches (backs off to 1)")
+    p.add_argument("--spec-draft-window", type=int, default=16,
+                   help="gpt drafter: context tokens re-run per draft "
+                        "step")
     # Tiny random-weight model (no checkpoint: this benches the ENGINE —
     # scheduling, prefill/decode latency — not model quality).
     p.add_argument("--vocab-size", type=int, default=256)
@@ -145,7 +162,11 @@ def main() -> int:
         kv_page_size=args.kv_page_size or None,
         kv_pages=args.kv_pages,
         prefill_chunk=args.prefill_chunk,
-        prefill_bucket=args.prefill_bucket, seed=args.seed), trace=trace)
+        prefill_bucket=args.prefill_bucket,
+        spec_k=args.spec_k, spec_drafter=args.spec_drafter,
+        spec_ngram=args.spec_ngram,
+        spec_draft_window=args.spec_draft_window,
+        seed=args.seed), trace=trace)
 
     # Live telemetry plane: the measured window is scrapeable while it
     # runs.
@@ -174,18 +195,24 @@ def main() -> int:
         # two shapes — the fused chunk+decode step and the decode-only
         # step — so two short requests cover them; legacy mode walks
         # every prefill bucket.
+        # Speculation needs at least one drafted decode iteration in
+        # the warm-up (remaining budget > 1) so a GPT drafter's
+        # 'draft' program compiles outside the measured window; the
+        # verify window itself is one fixed shape either way.
+        warm_new = 4 if args.spec_k else 2
         if engine.paged:
             for _ in range(2):
                 engine.submit(rng.randint(0, args.vocab_size,
                                           size=2).astype(np.int32),
-                              max_new_tokens=2)
+                              max_new_tokens=warm_new)
         else:
             for lb in range(args.prefill_bucket, 2 * args.prompt_len - 1 +
                             args.prefill_bucket, args.prefill_bucket):
-                lb = min(lb, engine.budget - 2)  # keep warm-ups admissible
+                # keep warm-ups admissible
+                lb = min(lb, engine.budget - warm_new)
                 engine.submit(rng.randint(0, args.vocab_size,
                                           size=lb).astype(np.int32),
-                              max_new_tokens=2)
+                              max_new_tokens=warm_new)
         warm_tokens = sum(f.tokens.size for f in engine.run())
         engine.reset_stats()
         print(f"[serve_bench] warm-up done ({warm_tokens} tokens)",
@@ -252,6 +279,11 @@ def main() -> int:
     # a hard stop here used to drop tail requests from the percentiles.
     finished += len(engine.drain())
     assert finished == n, f"drained {finished} of {n} requests"
+    if engine.paged:
+        # Leak audit: every page back on the free list, no stranded
+        # commitment — speculation's accept-rewind included (the CI
+        # speculation leg runs on this assertion).
+        engine.pool.check_balanced()
 
     if compile_watch is not None:
         from distributed_training_tpu.observability.sanitizer import (
