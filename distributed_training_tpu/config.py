@@ -530,6 +530,37 @@ class ServeConfig:
     # GPT drafter: context tokens re-run per draft step (right-aligned,
     # pad-filled); must fit the draft model's positional table.
     spec_draft_window: int = 16
+    # SLO tiers + multi-tenant fairness (docs/SERVING.md "Tiered
+    # scheduling & preemption"). Requests carry priority 0 (highest,
+    # interactive) .. num_tiers-1 (best-effort); admission is strictly
+    # tier-ordered, FIFO within a (tier, tenant) lane, and weighted-fair
+    # across tenants within a tier. 1 = the old single-FIFO behavior.
+    num_tiers: int = 1
+    # Max concurrently SEATED sequences per tenant (None = uncapped). A
+    # quota-saturated tier falls through to the next tier so slots never
+    # idle on a fairness cap.
+    tenant_quota: int | None = None
+    # tenant -> weighted-fair share (missing tenants weigh 1.0): each
+    # seat charges its worst-case token footprint / weight, and the
+    # least-charged eligible tenant seats next.
+    tenant_weights: dict | None = None
+    # Overload headroom reserved for tier 0: requests of priority > 0
+    # only seat while MORE than tier_reserved_slots slots are free, and
+    # (paged engine) only while committing them would leave at least
+    # tier_reserved_pages pool pages uncommitted — so a high-tier
+    # arrival finds capacity without even needing a preemption. Tier 0
+    # ignores both reserves.
+    tier_reserved_slots: int = 0
+    tier_reserved_pages: int = 0
+    # Lossless preempt-and-requeue (only meaningful with num_tiers > 1):
+    # when a higher-tier request cannot seat (slots or pages), evict the
+    # worst strictly-lower-tier ACTIVE sequence — its pages are freed
+    # and it requeues carrying its emitted tokens; the re-seat
+    # re-prefills prompt+emitted and continues the same
+    # fold_in(rng, position) stream, so the final output is bitwise
+    # identical to an uninterrupted run (pinned by
+    # tests/test_preemption.py). False = tiers only order the queue.
+    preempt: bool = True
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -584,6 +615,26 @@ class ServeConfig:
             raise ValueError(
                 f"spec_draft_window must be >= 1, "
                 f"got {self.spec_draft_window}")
+        if self.num_tiers < 1:
+            raise ValueError(
+                f"num_tiers must be >= 1, got {self.num_tiers}")
+        if self.tenant_quota is not None and self.tenant_quota < 1:
+            raise ValueError(
+                f"tenant_quota must be >= 1, got {self.tenant_quota}")
+        if self.tenant_weights is not None:
+            for t, w in self.tenant_weights.items():
+                if not w > 0:
+                    raise ValueError(
+                        f"tenant weight must be > 0, got {t!r}: {w}")
+        if not 0 <= self.tier_reserved_slots < self.max_batch:
+            raise ValueError(
+                f"tier_reserved_slots must be in [0, max_batch-1] (a "
+                f"full reserve would starve every non-top tier), got "
+                f"{self.tier_reserved_slots} of {self.max_batch} slots")
+        if self.tier_reserved_pages < 0:
+            raise ValueError(
+                f"tier_reserved_pages must be >= 0, "
+                f"got {self.tier_reserved_pages}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -5,15 +5,20 @@ The first consumer-facing layer of the framework (ROADMAP north star:
 iteration-level batching + vLLM-style fixed-slot cache management,
 restated for XLA's static-shape world:
 
-- :mod:`queue` — thread-safe arrival-ordered admission with a per-request
-  cache-budget guard in page-based accounting (typed rejection, not a
-  wedged queue head).
+- :mod:`queue` — thread-safe SLO-tiered admission (priority 0 = highest;
+  FIFO within a (tier, tenant) lane, weighted-fair across tenants,
+  tier-aware shedding on a full queue) with a per-request cache-budget
+  guard in page-based accounting (typed rejection, not a wedged queue
+  head).
 - :mod:`pages` — the fixed-size KV page pool (PagedAttention's memory
   model, host half): free-list allocator with commitment-based
   admission safety; physical page 0 reserved as the device null page.
-- :mod:`scheduler` — fixed decode slots; FIFO refill (page-aware via a
-  ``can_seat`` gate) and EOS/length eviction at iteration boundaries;
-  active masks instead of shape changes.
+- :mod:`scheduler` — fixed decode slots; tier-strict tenant-fair refill
+  (page-aware via a ``can_seat`` gate), LOSSLESS preempt-and-requeue of
+  lower tiers under pressure (the evicted sequence re-prefills its
+  emitted tokens and continues the same RNG stream — bitwise identical
+  to an uninterrupted run), and EOS/length/deadline eviction at
+  iteration boundaries; active masks instead of shape changes.
 - :mod:`engine` — paged KV + chunked prefill by default (a fused
   prefill-chunk+decode step and a decode-only step over one shared page
   pool), the legacy contiguous slot-axis trio behind
@@ -37,7 +42,10 @@ restated for XLA's static-shape world:
   re-arms the previous weights.
 
 Surfaces: ``gpt/jax_tpu/serve.py`` (interactive/file serving CLI) and
-``tools/serve_bench.py`` (Poisson load generator). See docs/SERVING.md.
+``tools/serve_bench.py`` driving the seeded traffic-scenario library
+(``tools/traffic.py``: Poisson/bursty/diurnal arrivals, heavy-tailed
+sizes, multi-tenant SLO-tier mixes, preemption storms — composable
+with hot-swap and speculation chaos drills). See docs/SERVING.md.
 """
 
 from distributed_training_tpu.resilience.errors import (  # noqa: F401
@@ -60,6 +68,8 @@ from distributed_training_tpu.serving.queue import RequestQueue  # noqa: F401
 from distributed_training_tpu.serving.request import (  # noqa: F401
     FINISH_EOS,
     FINISH_LENGTH,
+    FINISH_PREEMPT_TIMEOUT,
+    FINISH_SHED,
     FINISH_TIMEOUT,
     ActiveSequence,
     FinishedRequest,

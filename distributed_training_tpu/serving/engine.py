@@ -90,7 +90,14 @@ from distributed_training_tpu.resilience.errors import SwapError
 from distributed_training_tpu.serving.metrics import ServeTelemetry
 from distributed_training_tpu.serving.pages import PagePool, pages_for
 from distributed_training_tpu.serving.queue import RequestQueue
-from distributed_training_tpu.serving.request import FinishedRequest, Request
+from distributed_training_tpu.serving.request import (
+    FINISH_PREEMPT_TIMEOUT,
+    FINISH_SHED,
+    FINISH_TIMEOUT,
+    ActiveSequence,
+    FinishedRequest,
+    Request,
+)
 from distributed_training_tpu.serving.scheduler import SlotScheduler
 from distributed_training_tpu.serving.speculative import (
     make_drafter,
@@ -220,10 +227,19 @@ class Engine:
             ttft_deadline_ms=cfg.ttft_deadline_ms,
             deadline_ms=cfg.deadline_ms, trace=trace,
             page_size=self.page_size,
-            pool_pages=self.pool_pages if self.paged else None)
-        self.scheduler = SlotScheduler(s)
+            pool_pages=self.pool_pages if self.paged else None,
+            num_tiers=cfg.num_tiers, tenant_quota=cfg.tenant_quota,
+            tenant_weights=cfg.tenant_weights)
+        self.scheduler = SlotScheduler(
+            s, reserved_slots=cfg.tier_reserved_slots,
+            preempt=cfg.preempt)
         self._drained = False
-        self.telemetry = ServeTelemetry(cfg.ring_size)
+        # Overload latch for /healthz: True while the last admission
+        # pass left work queued that could not seat (head-of-line
+        # blocked on slots/pages even after any preemption).
+        self._overloaded = False
+        self.telemetry = ServeTelemetry(cfg.ring_size,
+                                        num_tiers=cfg.num_tiers)
         self._base_rng = jax.random.PRNGKey(cfg.seed)
         self._iteration = 0
 
@@ -490,17 +506,21 @@ class Engine:
 
     # -- host-side lifecycle -------------------------------------------------
     def submit(self, prompt, max_new_tokens: int | None = None,
-               arrival_t: float | None = None) -> Request:
-        """Enqueue a request (thread-safe). Raises
-        :class:`~distributed_training_tpu.inference.sampler.
-        CacheBudgetError` when it can never fit a slot's page table (or
-        the legacy contiguous budget)."""
+               arrival_t: float | None = None, priority: int = 0,
+               tenant: str = "default") -> Request:
+        """Enqueue a request (thread-safe). ``priority`` is its SLO tier
+        (0 = highest, < ``cfg.num_tiers``), ``tenant`` its fairness
+        principal. Raises :class:`~distributed_training_tpu.inference.
+        sampler.CacheBudgetError` when it can never fit a slot's page
+        table (or the legacy contiguous budget)."""
         return self.queue.submit(prompt, max_new_tokens=max_new_tokens,
-                                 arrival_t=arrival_t)
+                                 arrival_t=arrival_t, priority=priority,
+                                 tenant=tenant)
 
     @property
     def idle(self) -> bool:
-        return len(self.queue) == 0 and self.scheduler.num_active == 0
+        return (len(self.queue) == 0 and self.scheduler.num_active == 0
+                and not self.queue.has_shed_pending)
 
     def _bucket(self, n: int) -> int:
         b = self.cfg.prefill_bucket
@@ -533,13 +553,142 @@ class Engine:
         self._slot_commit_left[slot] = 0
         self._tables[slot, :] = 0
 
+    # -- tier-aware admission (shared by both step paths) --------------------
+    def _queue_evict_finish(self, entry, reason: str) -> FinishedRequest:
+        """Complete an entry evicted FROM THE QUEUE (tier-aware shed or
+        deadline expiry): a fresh request carries nothing; a requeued
+        resumption keeps its emitted tokens and reports the
+        preemption-attributed reason."""
+        if isinstance(entry, ActiveSequence):
+            return FinishedRequest.from_active(entry, reason, slot=None)
+        return FinishedRequest.rejected_in_queue(entry, reason)
+
+    def _expire_queue(self, finished: list, now: float) -> None:
+        """Deadline sweep BEFORE admission: a queued entry already past
+        its TTFT/total deadline must not consume a prefill — it
+        completes with finish reason ``timeout`` (fresh) or
+        ``preempted_timeout`` (a resumption whose clock ran down while
+        it waited for a re-seat)."""
+        for entry in self.queue.pop_expired(now):
+            finished.append(self._queue_evict_finish(
+                entry, FINISH_PREEMPT_TIMEOUT
+                if isinstance(entry, ActiveSequence) else FINISH_TIMEOUT))
+
+    def _admit_pass(self, finished: list) -> list[ActiveSequence]:
+        """One tier-aware admission pass: complete pending tier-aware
+        shed victims, then seat candidates (preempting lower tiers when
+        a higher tier cannot otherwise seat). Returns the newly seated
+        sequences; the engine prefills each (resumptions re-prefill
+        their carried prefix and continue the same RNG stream).
+
+        Paged resource gate: a candidate seats only when the pool can
+        commit its worst case — and, for non-top tiers, only when that
+        commitment leaves ``tier_reserved_pages`` of headroom (waived
+        when the pool is completely idle, so a lone best-effort request
+        on an empty engine cannot deadlock against its own reserve).
+        The commitment itself happens in ``on_seat``, so a multi-seat
+        pass sees its own earlier reservations.
+        """
+        for entry in self.queue.take_shed():
+            finished.append(self._queue_evict_finish(entry, FINISH_SHED))
+
+        def can_seat(entry) -> bool:
+            if not self.paged:
+                return True
+            req = (entry.request if isinstance(entry, ActiveSequence)
+                   else entry)
+            n_pages = self._req_pages(req)
+            if not self.pool.can_commit(n_pages):
+                return False
+            if (req.priority > 0 and self.cfg.tier_reserved_pages
+                    and self.pool.available - n_pages
+                    < self.cfg.tier_reserved_pages
+                    and not (self.pool.num_allocated == 0
+                             and self.pool.committed == 0)):
+                return False
+            return True
+
+        def on_seat(seq: ActiveSequence) -> None:
+            if not self.paged:
+                return
+            slot = seq.slot
+            self.pool.commit(self._req_pages(seq.request))
+            self._slot_pages[slot] = []
+            self._slot_commit_left[slot] = self._req_pages(seq.request)
+            self._tables[slot, :] = 0
+            # graftlint: disable=hot-path-transfer -- admission-boundary key landing: slot routing is host-side numpy by design
+            self._slot_rng[slot] = np.asarray(
+                jax.random.fold_in(self._base_rng, seq.request.uid))
+
+        def on_preempt(seq: ActiveSequence) -> None:
+            # Recompute debt: cache positions the eviction frees and the
+            # re-seat must prefill again (the whole preemption cost —
+            # the tokens themselves are never lost). Branch on the
+            # PREFILLING state, not on emitted tokens: a resumption
+            # preempted again mid-RE-prefill has only written
+            # prefill_pos positions this seat, not its full prefix.
+            recompute = (seq.prefill_pos if seq.prefilling
+                         else seq.request.prompt.size
+                         + len(seq.tokens) - 1)
+            if self.paged:
+                self._free_slot_pages(seq.slot)
+            self.telemetry.on_preempted(recompute,
+                                        seq.request.priority)
+            if self.trace is not None:
+                self.trace.instant(
+                    "request.preempted", track=f"slot {seq.slot}",
+                    uid=seq.request.uid, tier=seq.request.priority,
+                    tokens_emitted=len(seq.tokens),
+                    # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg (prompt.size/prefill_pos arithmetic, no device value)
+                    recompute_tokens=int(recompute))
+
+        def preempt_helps(entry, victims) -> bool:
+            # Futility bound: would evicting EVERY strictly-lower-tier
+            # active ever let this candidate seat? On the legacy path a
+            # freed slot is all a candidate can need; paged, the
+            # preemptible pool must cover the candidate's worst-case
+            # commitment (a victim returns its held pages PLUS its
+            # unused commitment = exactly its own worst case), with the
+            # same reserved-page headroom can_seat applies. Without
+            # this bound a too-large candidate would evict best-effort
+            # work one sequence at a time for zero admission gained.
+            if not self.paged:
+                return True
+            req = (entry.request if isinstance(entry, ActiveSequence)
+                   else entry)
+            need = self._req_pages(req)
+            freeable = sum(self._req_pages(v.request) for v in victims)
+            headroom = (self.cfg.tier_reserved_pages
+                        if req.priority > 0 else 0)
+            return self.pool.available + freeable >= need + headroom
+
+        seated = self.scheduler.admit(self.queue, can_seat,
+                                      on_seat=on_seat,
+                                      on_preempt=on_preempt,
+                                      preempt_helps=preempt_helps)
+        # Anything still queued is head-of-line blocked on slots or
+        # pages until the next boundary (preemption included) — the
+        # /healthz "overloaded" signal.
+        self._overloaded = len(self.queue) > 0
+        return seated
+
     def _prefill_request(self, seq) -> None:
-        """Legacy path: one bucketed batch-1 prefill + slot scatter."""
+        """Legacy path: one bucketed batch-1 prefill + slot scatter.
+
+        A resumption re-prefills prompt + previously emitted tokens
+        minus the last (``seq.prefill_tokens``); its "first token"
+        sample at position ``n'-1`` recomputes the last emitted token
+        bitwise (same logits row, same ``fold_in(rng, pos)``), which is
+        exactly the incoming-token/write-head state an uninterrupted
+        run would hold — so it is NOT re-emitted, just landed in the
+        slot state by the same scatter.
+        """
         req = seq.request
-        n = req.prompt.size
+        toks = seq.prefill_tokens
+        n = toks.size
         padded = np.full((1, self._bucket(n)), self.sample_cfg.pad_id,
                          np.int32)
-        padded[0, :n] = req.prompt
+        padded[0, :n] = toks
         req_rng = jax.random.fold_in(self._base_rng, req.uid)
         new_cache, tok = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(n), req_rng)
@@ -547,6 +696,8 @@ class Engine:
             self._cache, self._tok, self._pos, self._rngs,
             jnp.int32(seq.slot), new_cache, tok, jnp.int32(n), req_rng)
         seq.prefill_pos = n
+        if seq.tokens:
+            return  # resumed mid-decode: no new token was emitted
         # graftlint: disable=hot-path-transfer -- the one deliberate sync: TTFT is measured here
         first = int(tok)
         t = time.perf_counter()
@@ -794,34 +945,19 @@ class Engine:
                      or self.cfg.deadline_ms is not None)
         finished: list[FinishedRequest] = []
         if deadlines:
-            for req in self.queue.pop_expired(time.perf_counter()):
-                finished.append(FinishedRequest.timed_out_in_queue(req))
+            self._expire_queue(finished, time.perf_counter())
 
         had_work = not self.idle
         if had_work:
             self.telemetry.begin_work()
-        # Page-aware admission: the queue head seats only when the pool
-        # can commit its worst-case page count — strictly FIFO, so the
-        # check is on the head alone (see SlotScheduler.admit). The gate
-        # COMMITS as it accepts, so a multi-seat pass sees its own
-        # earlier reservations. Seating costs NO device work here; the
-        # prompt prefills chunk-by-chunk below, riding the decode
-        # iterations.
-        def seat_and_commit(req: Request) -> bool:
-            n_pages = self._req_pages(req)
-            if not self.pool.can_commit(n_pages):
-                return False
-            self.pool.commit(n_pages)
-            return True
-
-        for seq in self.scheduler.admit(self.queue, seat_and_commit):
-            slot = seq.slot
-            self._slot_pages[slot] = []
-            self._slot_commit_left[slot] = self._req_pages(seq.request)
-            self._tables[slot, :] = 0
-            # graftlint: disable=hot-path-transfer -- admission-boundary key landing: slot routing is host-side numpy by design
-            self._slot_rng[slot] = np.asarray(
-                jax.random.fold_in(self._base_rng, seq.request.uid))
+        # Tier-aware, page-aware admission (_admit_pass): candidates
+        # seat in tier-strict tenant-fair order when the pool can commit
+        # their worst case; a blocked higher tier preempts the worst
+        # lower-tier active sequence instead of waiting behind it.
+        # Seating costs NO device work here; the prompt (or a
+        # resumption's carried prefix) prefills chunk-by-chunk below,
+        # riding the decode iterations.
+        self._admit_pass(finished)
         # Head-of-line blocking: anything still queued after the
         # admission pass is blocked on a slot OR on pool pages until the
         # next boundary — bill the rest of this iteration as
@@ -857,7 +993,12 @@ class Engine:
             t_draft1 = time.perf_counter()
             c = 0
             if chunk_seq is not None:
-                n = chunk_seq.request.prompt.size
+                # prefill_tokens == the prompt for a fresh seat; for a
+                # resumption it carries prompt + emitted-minus-last, so
+                # the re-prefill rebuilds exactly the cache prefix the
+                # preemption freed (same positions, same fold_in RNG).
+                pre_toks = chunk_seq.prefill_tokens
+                n = pre_toks.size
                 start = chunk_seq.prefill_pos
                 c = min(self.prefill_chunk, n - start)
                 self._ensure_pages(chunk_seq.slot, start + c)
@@ -865,7 +1006,7 @@ class Engine:
                 c_tok = np.full((cw,), self.sample_cfg.pad_id, np.int32)
                 c_pos = np.zeros((cw,), np.int32)
                 c_valid = np.zeros((cw,), bool)
-                c_tok[:c] = chunk_seq.request.prompt[start:start + c]
+                c_tok[:c] = pre_toks[start:start + c]
                 c_pos[:c] = np.arange(start, start + c)
                 c_valid[:c] = True
                 self._cache, nxt, acc, c_sampled = self._fused(
@@ -908,13 +1049,22 @@ class Engine:
                         uid=chunk_seq.request.uid, start=int(start),
                         # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg
                         tokens=int(c))
-                if chunk_seq.prefill_pos == chunk_seq.request.prompt.size:
-                    # Final chunk: its last valid row is the request's
-                    # first token (same RNG fold and logits row as a
-                    # full-prompt prefill).
-                    # graftlint: disable=hot-path-transfer -- the deliberate sync: the chunked-path TTFT measurement point
-                    first = int(np.asarray(c_sampled)[c - 1])
-                    self._note_first_token(chunk_seq, first, t)
+                if chunk_seq.prefill_pos == chunk_seq.prefill_tokens.size:
+                    if chunk_seq.tokens:
+                        # Resumed mid-decode: the final chunk's sample
+                        # recomputes the last emitted token bitwise
+                        # (same logits row, same fold_in position) — it
+                        # was already emitted before the preemption, so
+                        # nothing lands; the slot just resumes decoding
+                        # with it as the incoming token.
+                        pass
+                    else:
+                        # Final chunk: its last valid row is the
+                        # request's first token (same RNG fold and
+                        # logits row as a full-prompt prefill).
+                        # graftlint: disable=hot-path-transfer -- the deliberate sync: the chunked-path TTFT measurement point
+                        first = int(np.asarray(c_sampled)[c - 1])
+                        self._note_first_token(chunk_seq, first, t)
             # KV utilization, host-side only: reserved = pages actually
             # held by occupied slots (the paged win — compare the legacy
             # path's active × full budget), written = live cache
@@ -971,23 +1121,22 @@ class Engine:
         # its TTFT/total deadline must not consume a prefill — it
         # completes with finish reason 'timeout' and zero tokens.
         if deadlines:
-            for req in self.queue.pop_expired(time.perf_counter()):
-                finished.append(FinishedRequest.timed_out_in_queue(req))
+            self._expire_queue(finished, time.perf_counter())
 
         had_work = not self.idle
         if had_work:
             self.telemetry.begin_work()
-        for seq in self.scheduler.admit(self.queue):
+        for seq in self._admit_pass(finished):
             self._prefill_request(seq)
         # Prefill-time completions: a 1-token budget or an instant EOS
         # never joins a decode iteration.
         finished.extend(self.scheduler.evict_finished(eos))
-        # Head-of-line blocking: requests still queued with every slot
-        # busy wait out the whole iteration (admission is boundary-only)
-        # — bill the rest of this iteration as admission-blocked time.
-        blocked_t0 = (time.perf_counter()
-                      if len(self.queue) > 0
-                      and self.scheduler.num_active == self.cfg.max_batch
+        # Head-of-line blocking: requests still queued after the
+        # admission pass cannot seat (slots, reserved headroom, or tier
+        # quota) and wait out the whole iteration (admission is
+        # boundary-only) — bill the rest of this iteration as
+        # admission-blocked time.
+        blocked_t0 = (time.perf_counter() if len(self.queue) > 0
                       else None)
 
         active_seqs = self.scheduler.active()
@@ -1093,11 +1242,12 @@ class Engine:
     def _trace_finish(self, fin: FinishedRequest) -> None:
         """One request's terminal trace events: the decode span (first →
         last token on its slot track) and a finish mark carrying the
-        reason. Queue-side timeouts never held a slot — they mark on the
-        'queue' track instead."""
+        reason. Queue-side evictions (timeout / shed / expired
+        resumption) never hold a slot — they mark on the 'queue' track
+        instead."""
         if fin.slot is None:
-            self.trace.instant("request.timeout", track="queue",
-                               uid=fin.uid)
+            self.trace.instant(f"request.{fin.finish_reason}",
+                               track="queue", uid=fin.uid)
             return
         track = f"slot {fin.slot}"
         if (fin.first_token_t is not None and fin.last_token_t is not None
@@ -1148,10 +1298,15 @@ class Engine:
     @property
     def phase(self) -> str:
         """Coarse lifecycle phase for the /healthz endpoint:
-        serving ⇄ swapping → draining → drained (idle = alive, nothing
-        queued). ``swapping`` = a staged weight candidate is armed and
-        waiting for the next iteration boundary to apply it — the window
-        a rollout driver sees between arming and the barrier."""
+        serving ⇄ swapping ⇄ overloaded → draining → drained (idle =
+        alive, nothing queued). ``swapping`` = a staged weight candidate
+        is armed and waiting for the next iteration boundary to apply it
+        — the window a rollout driver sees between arming and the
+        barrier. ``overloaded`` = the last admission pass left work
+        queued that could not seat even after preemption — selective
+        degradation (tier-aware shed/preempt) is active, and a load
+        balancer should prefer another replica for best-effort traffic.
+        """
         if self._drained:
             return "drained"
         if self.queue.closed:
@@ -1159,17 +1314,24 @@ class Engine:
         with self._swap_lock:
             if self._pending_swap is not None:
                 return "swapping"
+        if self._overloaded and len(self.queue) > 0:
+            return "overloaded"
         return "idle" if self.idle else "serving"
 
     def health(self) -> dict[str, Any]:
-        """Hot-swap-aware extras for the exporter's /healthz payload:
-        the deployed weights epoch and swap counters ride alongside
-        ``phase`` so a rollout driver can confirm (or abort) a deploy
-        from the health endpoint alone, without parsing /metrics."""
+        """Hot-swap- and overload-aware extras for the exporter's
+        /healthz payload: the deployed weights epoch, swap counters, and
+        the graceful-degradation counters ride alongside ``phase`` so a
+        rollout driver (or load balancer) can confirm a deploy — or see
+        that best-effort traffic is being shed/preempted — from the
+        health endpoint alone, without parsing /metrics."""
         return {
             "weights_epoch": int(self.weights_epoch),
             "swaps_completed": self.telemetry.swaps_completed,
             "swaps_rejected": self.telemetry.swaps_rejected,
+            "requests_preempted": self.telemetry.requests_preempted,
+            "requests_shed": self.queue.shed,
+            "queue_depth": len(self.queue),
         }
 
     def compiled_programs(self) -> dict[str, int | None]:
@@ -1212,6 +1374,11 @@ class Engine:
         # engine completed a drain (admission closed + everything
         # accepted was finished).
         stats["requests_shed"] = self.queue.shed
+        # Per-tier shed breakdown (tier-aware degradation evidence: the
+        # CI overload drill asserts tier 0 stays at zero while
+        # best-effort tiers absorb the pressure).
+        for t, n in enumerate(self.queue.shed_by_tier):
+            stats[f"tier{t}_requests_shed"] = int(n)
         stats["requests_drain_rejected"] = self.queue.drain_rejected
         stats["drained"] = bool(self._drained)
         # Live weight hot-swap: the deployed epoch joins the telemetry's
@@ -1223,7 +1390,8 @@ class Engine:
         """Fresh telemetry window (e.g. after a compile warm-up pass);
         compiled programs, slot state, and page allocations are
         untouched."""
-        self.telemetry = ServeTelemetry(self.cfg.ring_size)
+        self.telemetry = ServeTelemetry(self.cfg.ring_size,
+                                        num_tiers=self.cfg.num_tiers)
         self.queue.reset_counters()
         self._iteration = 0
 

@@ -33,6 +33,15 @@ what that costs instead of asserting it:
 - **admission_blocked_s**: wall-time with requests queued while every
   slot was busy — the head-of-line blocking chunked prefill removes.
 
+Tiered-scheduling accounting (docs/SERVING.md "Tiered scheduling &
+preemption"): per-SLO-tier TTFT/TPOT fixed-bucket histograms (the
+selective-degradation evidence — tier 0 must hold while best-effort
+tiers absorb overload), ``requests_preempted`` /
+``preempted_token_recompute`` (lossless preempt-and-requeue count and
+its recompute debt in cache positions), per-tier finished/preempted
+counts, and ``requests_preempt_timed_out`` (deadline misses attributed
+to preemption pressure rather than service time).
+
 The engine drives the same two touch points the trainers use
 (``observability/hooks.py`` shape): :meth:`on_iteration` per decode
 iteration (one host timestamp into the :class:`FlightRecorder` ring — so
@@ -68,12 +77,33 @@ class ServeTelemetry:
     and the bench SLA line agree on the same bucket-resolution numbers.
     """
 
-    def __init__(self, ring_size: int = 4096):
+    def __init__(self, ring_size: int = 4096, num_tiers: int = 1):
         self.recorder = FlightRecorder(ring_size)
+        self.num_tiers = max(int(num_tiers), 1)
         self.ttft_ms: list[float] = []
         self.tpot_ms: list[float] = []
         self.ttft_hist = FixedHistogram()
         self.tpot_hist = FixedHistogram()
+        # Per-SLO-tier latency views (tier 0 = highest): the selective-
+        # degradation evidence — under overload the high tier's TTFT/
+        # TPOT histograms must hold while best-effort tiers absorb the
+        # shed/preemption pressure. Same fixed buckets as the global
+        # histograms, so per-tier and global quantiles are comparable.
+        self.tier_ttft_hist = [FixedHistogram()
+                               for _ in range(self.num_tiers)]
+        self.tier_tpot_hist = [FixedHistogram()
+                               for _ in range(self.num_tiers)]
+        self.tier_finished = [0] * self.num_tiers
+        self.tier_preempted = [0] * self.num_tiers
+        # Lossless preempt-and-requeue accounting (scheduler/engine):
+        # how many evictions happened and the cache positions they
+        # freed — which the re-seat must prefill AGAIN. The recompute
+        # counter is the preemption cost in token units (the tokens
+        # themselves are never lost); both are workload-deterministic
+        # under the bench's virtual-time drive, so the CI overload
+        # drill holds them zero-drift.
+        self.requests_preempted = 0
+        self.preempted_token_recompute = 0
         # Admission-latency breakdown: queueing vs prefill compute.
         self.queue_wait_ms: list[float] = []
         self.prefill_ms: list[float] = []
@@ -230,16 +260,30 @@ class ServeTelemetry:
         stage / validate / arm); the engine kept its old weights."""
         self.swaps_rejected += 1
 
+    def on_preempted(self, recompute_tokens: int, tier: int) -> None:
+        """One lossless preemption: a ``tier`` sequence was evicted to
+        seat a higher tier and requeued; ``recompute_tokens`` cache
+        positions were freed and will be re-prefilled at the re-seat
+        (the preemption's entire cost — no token is ever lost)."""
+        self.requests_preempted += 1
+        self.preempted_token_recompute += int(recompute_tokens)
+        t = min(max(int(tier), 0), self.num_tiers - 1)
+        self.tier_preempted[t] += 1
+
     def on_finished(self, fin: FinishedRequest) -> None:
         self.requests_finished += 1
         self.finish_reasons[fin.finish_reason] = \
             self.finish_reasons.get(fin.finish_reason, 0) + 1
+        tier = min(max(int(fin.priority), 0), self.num_tiers - 1)
+        self.tier_finished[tier] += 1
         if fin.ttft_ms is not None:  # queue-side timeouts carry no sample
             self.ttft_ms.append(fin.ttft_ms)
             self.ttft_hist.observe(fin.ttft_ms)
+            self.tier_ttft_hist[tier].observe(fin.ttft_ms)
         if fin.tpot_ms is not None:
             self.tpot_ms.append(fin.tpot_ms)
             self.tpot_hist.observe(fin.tpot_ms)
+            self.tier_tpot_hist[tier].observe(fin.tpot_ms)
 
     def flush(self, iteration: int, queue_depth: int, active: int) -> None:
         self.recorder.record_flush(iteration, {
@@ -262,9 +306,32 @@ class ServeTelemetry:
         if self._seg_t0 is not None and self._busy_t1 is not None:
             busy_s += max(self._busy_t1 - self._seg_t0, 0.0)
         tput = self.tokens_emitted / busy_s if busy_s > 0 else 0.0
-        from distributed_training_tpu.serving.request import FINISH_TIMEOUT
+        from distributed_training_tpu.serving.request import (
+            FINISH_PREEMPT_TIMEOUT,
+            FINISH_TIMEOUT,
+        )
+
+        # Per-SLO-tier SLA view: fixed-bucket TTFT/TPOT quantiles plus
+        # finished/preempted counts for every configured tier (one tier
+        # = the global view restated, so downstream consumers read one
+        # key shape regardless of config).
+        tiers: dict[str, Any] = {}
+        for t in range(self.num_tiers):
+            tiers[f"tier{t}_ttft_hist_p50_ms"] = \
+                self.tier_ttft_hist[t].quantile(0.50)
+            tiers[f"tier{t}_ttft_hist_p95_ms"] = \
+                self.tier_ttft_hist[t].quantile(0.95)
+            tiers[f"tier{t}_ttft_hist_p99_ms"] = \
+                self.tier_ttft_hist[t].quantile(0.99)
+            tiers[f"tier{t}_tpot_hist_p50_ms"] = \
+                self.tier_tpot_hist[t].quantile(0.50)
+            tiers[f"tier{t}_tpot_hist_p95_ms"] = \
+                self.tier_tpot_hist[t].quantile(0.95)
+            tiers[f"tier{t}_requests_finished"] = self.tier_finished[t]
+            tiers[f"tier{t}_requests_preempted"] = self.tier_preempted[t]
 
         return {
+            **tiers,
             "throughput_tok_s": tput,
             "ttft_p50_ms": pct(self.ttft_ms, 50),
             "ttft_p95_ms": pct(self.ttft_ms, 95),
@@ -281,6 +348,16 @@ class ServeTelemetry:
             "queue_depth_max": int(self.queue_depth_max),
             "requests_finished": self.requests_finished,
             "requests_timed_out": self.finish_reasons.get(FINISH_TIMEOUT, 0),
+            # Preempted-then-timed-out is attributed separately: the
+            # clock ran down while the sequence waited requeued, so the
+            # miss belongs to preemption pressure, not service time.
+            "requests_preempt_timed_out":
+                self.finish_reasons.get(FINISH_PREEMPT_TIMEOUT, 0),
+            # Lossless preempt-and-requeue economics (deterministic
+            # under the bench's virtual-time drive; CI-gated zero-drift).
+            "requests_preempted": int(self.requests_preempted),
+            "preempted_token_recompute":
+                int(self.preempted_token_recompute),
             "tokens_emitted": self.tokens_emitted,
             "busy_seconds": busy_s,
             # Utilization accounting (see module docstring): the
@@ -343,6 +420,15 @@ class ServeTelemetry:
             "queue_wait_ms": self.queue_wait_hist.to_dict(),
             "prefill_ms": self.prefill_hist.to_dict(),
         }
+        if self.num_tiers > 1:
+            # Full per-tier latency histograms (mergeable, Prometheus-
+            # exportable) — only under a multi-tier config, where they
+            # differ from the global pair above.
+            for t in range(self.num_tiers):
+                serving["histograms"][f"ttft_ms_tier{t}"] = \
+                    self.tier_ttft_hist[t].to_dict()
+                serving["histograms"][f"tpot_ms_tier{t}"] = \
+                    self.tier_tpot_hist[t].to_dict()
         return serving
 
     def snapshot(self, *, reason: str = "scrape",

@@ -1,9 +1,16 @@
-"""Thread-safe arrival-ordered request queue with admission control.
+"""Thread-safe tiered request queue with admission control and fairness.
 
 Producers (CLI readers, the bench load generator, RPC handlers) submit
-from any thread; the engine drains from its scheduling loop. Admission
-applies three typed guards at submit time, so a request that can never
-be served (or should not be) fails fast in the producer instead of
+from any thread; the engine drains from its scheduling loop. The queue
+is ONE logical admission structure holding ``num_tiers`` SLO tiers
+(priority 0 = highest), each an arrival-ordered deque — FIFO within a
+``(tier, tenant)`` pair, weighted-fair across tenants within a tier,
+strict tier order across tiers. Preempted sequences requeue into their
+tier in arrival (uid) order, so a resumption re-seats ahead of younger
+work of its own tier.
+
+Admission applies typed guards at submit time, so a request that can
+never be served (or should not be) fails fast in the producer instead of
 wedging or bloating the queue:
 
 - **budget** — the request's whole-lifetime KV footprint must be
@@ -14,16 +21,22 @@ wedging or bloating the queue:
   raise the typed :class:`~distributed_training_tpu.inference.sampler.
   CacheBudgetError` with page-based accounting (pages needed vs the
   pool/table capacity); it would never become admissible, so queueing
-  it would wedge the FIFO head forever.
-- **depth** — an optional ``max_depth`` bounds the queue; a submit that
-  would exceed it is SHED with :class:`~distributed_training_tpu.
-  resilience.errors.QueueFullError` (every queued request's TTFT grows
-  with depth — past the SLA horizon, rejecting early beats accepting
-  work that is already doomed to time out).
+  it would wedge its tier's head forever.
+- **depth** — an optional ``max_depth`` bounds the queue (all tiers
+  summed). The shed is TIER-AWARE: when a higher-tier request arrives
+  on a full queue, the NEWEST queued request of the lowest tier below
+  it is dropped instead (it surfaces through :meth:`take_shed` as a
+  ``shed`` completion), so best-effort work degrades first. Only when
+  nothing lower-tier is queued is the incoming request itself shed
+  with :class:`~distributed_training_tpu.resilience.errors.
+  QueueFullError` (every queued request's TTFT grows with depth — past
+  the SLA horizon, rejecting early beats accepting work that is
+  already doomed to time out).
 - **drain** — :meth:`close` flips admission off for graceful shutdown;
   subsequent submits raise :class:`~distributed_training_tpu.resilience.
   errors.DrainingError` while the engine finishes what it already
-  accepted.
+  accepted (requeued preempted sequences included — they were admitted
+  once and drain() owes them their completion).
 """
 
 from __future__ import annotations
@@ -39,19 +52,39 @@ from distributed_training_tpu.resilience.errors import (
     DrainingError,
     QueueFullError,
 )
-from distributed_training_tpu.serving.request import Request
+from distributed_training_tpu.serving.request import ActiveSequence, Request
+
+
+def _request_of(entry):
+    """Queue entries are fresh :class:`Request`\\ s or requeued
+    :class:`ActiveSequence` resumptions; admission logic reads the
+    underlying request either way."""
+    return entry.request if isinstance(entry, ActiveSequence) else entry
 
 
 class RequestQueue:
-    """FIFO of :class:`Request` with typed admission guards.
+    """Tiered FIFO of :class:`Request` with typed admission guards.
 
     ``budget`` is the per-slot KV-cache capacity in tokens; ``submit``
     enforces ``prompt_len + max_new_tokens <= budget``. ``depth_max``
     tracks the high-water queue depth for SLA telemetry; ``shed`` /
-    ``drain_rejected`` count the load-shedding and drain rejections.
+    ``drain_rejected`` count the load-shedding and drain rejections
+    (``shed_by_tier`` breaks sheds down per SLO tier).
     ``ttft_deadline_ms`` / ``deadline_ms`` stamp every admitted request
     with absolute deadlines (the engine evicts violators with finish
-    reason ``timeout``).
+    reason ``timeout`` — or ``preempted_timeout`` for a requeued
+    resumption whose clock ran out).
+
+    Fairness state: ``tenant_weights`` (missing tenants weigh 1.0) and
+    an accumulated per-tenant service counter starting at zero — each
+    seat charges the request's worst-case token footprint / weight, and
+    :meth:`next_candidate` always offers the eligible tenant with the
+    LEAST accumulated weighted service (deterministic ties: tenant
+    name, then uid). A preemption refunds its seat's charge at requeue,
+    so an evicted tenant is not billed twice for the same work.
+    ``tenant_quota`` caps concurrently seated requests per tenant; a
+    quota-blocked tier falls through to the next tier rather than
+    idling slots.
 
     ``trace`` (a TraceSession or None) marks every admission decision on
     the timeline's 'queue' track: arrivals as instants (at the request's
@@ -64,11 +97,18 @@ class RequestQueue:
                  ttft_deadline_ms: float | None = None,
                  deadline_ms: float | None = None,
                  trace=None, page_size: int | None = None,
-                 pool_pages: int | None = None):
+                 pool_pages: int | None = None, num_tiers: int = 1,
+                 tenant_quota: int | None = None,
+                 tenant_weights: dict[str, float] | None = None):
         if budget < 2:
             raise ValueError(f"budget must be >= 2, got {budget}")
         if max_depth is not None and max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        if num_tiers < 1:
+            raise ValueError(f"num_tiers must be >= 1, got {num_tiers}")
+        if tenant_quota is not None and tenant_quota < 1:
+            raise ValueError(
+                f"tenant_quota must be >= 1, got {tenant_quota}")
         self.budget = int(budget)
         # Paged-KV admission accounting: when set, the fail-fast check
         # (and its error message) is in pages — a request whose
@@ -80,23 +120,41 @@ class RequestQueue:
         self.max_depth = max_depth
         self.ttft_deadline_ms = ttft_deadline_ms
         self.deadline_ms = deadline_ms
+        self.num_tiers = int(num_tiers)
+        self.tenant_quota = tenant_quota
+        self.tenant_weights = dict(tenant_weights or {})
+        for t, w in self.tenant_weights.items():
+            if not w > 0:
+                raise ValueError(
+                    f"tenant weight must be > 0, got {t!r}: {w}")
         self.trace = trace
         self._lock = threading.Lock()
-        self._q: collections.deque[Request] = collections.deque()
+        self._tiers: list[collections.deque] = [
+            collections.deque() for _ in range(self.num_tiers)]
+        # Tier-aware shed victims awaiting pickup by the engine (they
+        # complete with finish reason "shed"; see take_shed).
+        self._shed_out: list = []
+        # Weighted-fair service accumulator: tenant -> tokens/weight
+        # already seated (deficit-round-robin shape: least weighted
+        # service seats next; a preemption refunds its charge).
+        self._tenant_service: dict[str, float] = {}
         self._closed = False
         self._next_uid = 0
         self.depth_max = 0
         self.submitted = 0
         self.rejected = 0
         self.shed = 0
+        self.shed_by_tier = [0] * self.num_tiers
         self.drain_rejected = 0
 
     def submit(self, prompt, max_new_tokens: int | None = None,
-               arrival_t: float | None = None) -> Request:
+               arrival_t: float | None = None, priority: int = 0,
+               tenant: str = "default") -> Request:
         """Enqueue one request; returns its admission record.
 
         Raises :class:`CacheBudgetError` when the request can never fit a
-        slot, :class:`QueueFullError` when the bounded queue is full, and
+        slot, :class:`QueueFullError` when the bounded queue is full and
+        nothing lower-tier can be shed instead, and
         :class:`DrainingError` after :meth:`close`. ``arrival_t``
         defaults to now (perf_counter) — the bench passes its scheduled
         arrival so queueing delay is measured from the intended arrival,
@@ -106,6 +164,11 @@ class RequestQueue:
                                       dtype=np.int32)
         if tokens.size < 1:
             raise ValueError("empty prompt (need at least one token)")
+        prio = int(priority)
+        if not 0 <= prio < self.num_tiers:
+            raise ValueError(
+                f"priority must be in [0, {self.num_tiers - 1}] "
+                f"(num_tiers={self.num_tiers}), got {prio}")
         mnt = (self.default_max_new_tokens
                if max_new_tokens is None else int(max_new_tokens))
         if mnt < 1:
@@ -153,13 +216,17 @@ class RequestQueue:
                     "engine is draining: admission is closed while "
                     "in-flight requests complete; submit to another "
                     "replica or retry after restart")
-            if self.max_depth is not None and len(self._q) >= self.max_depth:
+            if (self.max_depth is not None
+                    and self._depth() >= self.max_depth
+                    and not self._shed_lower_tier(prio)):
                 self.shed += 1
+                self.shed_by_tier[prio] += 1
                 if self.trace is not None:
                     self.trace.instant("request.shed", track="queue",
-                                       depth=len(self._q))
+                                       depth=self._depth(), tier=prio)
                 raise QueueFullError(
-                    f"request queue is at max_depth={self.max_depth}; "
+                    f"request queue is at max_depth={self.max_depth} "
+                    f"with nothing below tier {prio} to shed; "
                     f"shedding load instead of growing the queue (and "
                     f"every queued request's TTFT) without bound")
             req = Request(
@@ -168,16 +235,136 @@ class RequestQueue:
                 ttft_deadline_t=(arrival + self.ttft_deadline_ms / 1e3
                                  if self.ttft_deadline_ms else None),
                 deadline_t=(arrival + self.deadline_ms / 1e3
-                            if self.deadline_ms else None))
+                            if self.deadline_ms else None),
+                priority=prio, tenant=str(tenant))
             self._next_uid += 1
-            self._q.append(req)
+            self._tiers[prio].append(req)
             self.submitted += 1
-            self.depth_max = max(self.depth_max, len(self._q))
+            self.depth_max = max(self.depth_max, self._depth())
             if self.trace is not None:
                 self.trace.instant("request.arrival", track="queue",
-                                   t=arrival, uid=req.uid,
+                                   t=arrival, uid=req.uid, tier=prio,
                                    prompt_len=int(tokens.size))
         return req
+
+    # -- internal (callers hold self._lock) ----------------------------------
+    def _depth(self) -> int:
+        return sum(len(q) for q in self._tiers)
+
+    def _shed_lower_tier(self, prio: int) -> bool:
+        """Drop the NEWEST queued entry of the lowest tier strictly
+        below ``prio`` (tier-aware shed); True if one was dropped. The
+        victim surfaces through :meth:`take_shed` so the engine can
+        complete it with finish reason ``shed`` (a requeued resumption
+        keeps the tokens it already emitted)."""
+        for tier in range(self.num_tiers - 1, prio, -1):
+            if self._tiers[tier]:
+                victim = self._tiers[tier][-1]
+                del self._tiers[tier][-1]
+                self._shed_out.append(victim)
+                self.shed += 1
+                self.shed_by_tier[tier] += 1
+                if self.trace is not None:
+                    self.trace.instant(
+                        "request.shed", track="queue", tier=tier,
+                        uid=_request_of(victim).uid, for_tier=prio)
+                return True
+        return False
+
+    def _weight(self, tenant: str) -> float:
+        return float(self.tenant_weights.get(tenant, 1.0))
+
+    # -- scheduler interface -------------------------------------------------
+    def next_candidate(self, tenant_active: dict[str, int] | None = None):
+        """The entry the scheduler should try to seat next, or None.
+
+        Tier order is strict: the highest-priority nonempty tier whose
+        tenants are not all quota-blocked wins (a quota-saturated tier
+        falls through so slots never idle on a fairness cap, but a
+        RESOURCE-blocked head never falls through — the scheduler stops
+        there, preserving the no-size-skipping anti-starvation rule in
+        tier form). Within the tier: the eligible tenant with the least
+        accumulated weighted service, then that tenant's oldest entry.
+        Single tenant, single tier = the old strict FIFO.
+        """
+        active = tenant_active or {}
+        with self._lock:
+            for tier in self._tiers:
+                if not tier:
+                    continue
+                heads: dict[str, object] = {}  # tenant -> oldest entry
+                for entry in tier:
+                    ten = _request_of(entry).tenant
+                    if ten not in heads:
+                        heads[ten] = entry
+                if self.tenant_quota is not None:
+                    heads = {t: e for t, e in heads.items()
+                             if active.get(t, 0) < self.tenant_quota}
+                    if not heads:
+                        continue  # tier fully quota-blocked: fall through
+                best = min(
+                    heads.items(),
+                    key=lambda te: (self._tenant_service.get(te[0], 0.0)
+                                    / self._weight(te[0]), te[0],
+                                    _request_of(te[1]).uid))
+                return best[1]
+        return None
+
+    def take(self, entry) -> bool:
+        """Remove ``entry`` (a :meth:`next_candidate` result) and charge
+        its tenant's weighted-fair service with the request's worst-case
+        token footprint. Returns False — nothing removed, nothing
+        charged — when the entry is already gone: a producer-side
+        tier-aware shed can race the scheduler between
+        :meth:`next_candidate` and here (both are separate lock
+        sections), and the scheduler simply re-polls."""
+        req = _request_of(entry)
+        with self._lock:
+            try:
+                self._tiers[req.priority].remove(entry)
+            except ValueError:
+                return False  # concurrently shed by a producer thread
+            cost = (req.prompt.size + req.max_new_tokens) \
+                / self._weight(req.tenant)
+            self._tenant_service[req.tenant] = \
+                self._tenant_service.get(req.tenant, 0.0) + cost
+            return True
+
+    def requeue(self, seq: ActiveSequence) -> None:
+        """Return a preempted sequence to its tier, in arrival (uid)
+        order — it re-seats ahead of younger same-tier work. The seat
+        that is being undone refunds its weighted-fair service charge
+        (the re-seat will charge it again), and the requeue bypasses
+        ``max_depth``: the request was already admitted once, and
+        dropping it here would break the lossless-preemption contract.
+        """
+        req = seq.request
+        with self._lock:
+            tier = self._tiers[req.priority]
+            idx = len(tier)
+            for i, entry in enumerate(tier):
+                if _request_of(entry).uid > req.uid:
+                    idx = i
+                    break
+            tier.insert(idx, seq)
+            cost = (req.prompt.size + req.max_new_tokens) \
+                / self._weight(req.tenant)
+            if req.tenant in self._tenant_service:
+                self._tenant_service[req.tenant] -= cost
+            self.depth_max = max(self.depth_max, self._depth())
+
+    def take_shed(self) -> list:
+        """Drain the tier-aware shed victims (entries dropped from the
+        queue to admit higher-tier work); the engine completes each with
+        finish reason ``shed``."""
+        with self._lock:
+            out, self._shed_out = self._shed_out, []
+        return out
+
+    @property
+    def has_shed_pending(self) -> bool:
+        with self._lock:
+            return bool(self._shed_out)
 
     def close(self) -> None:
         """Close admission (idempotent): the graceful-drain gate. Queued
@@ -198,41 +385,63 @@ class RequestQueue:
         so a compile warm-up pass doesn't contaminate the measured SLA
         window."""
         with self._lock:
-            self.depth_max = len(self._q)
+            self.depth_max = self._depth()
             self.submitted = 0
             self.rejected = 0
             self.shed = 0
+            self.shed_by_tier = [0] * self.num_tiers
             self.drain_rejected = 0
 
-    def pop(self) -> Request | None:
-        """Oldest queued request, or None when empty (never blocks — the
-        engine polls at iteration boundaries, it does not park a thread)."""
+    def pop(self):
+        """Oldest entry of the highest-priority nonempty tier, or None
+        when empty (never blocks — the engine polls at iteration
+        boundaries, it does not park a thread)."""
         with self._lock:
-            return self._q.popleft() if self._q else None
+            for tier in self._tiers:
+                if tier:
+                    return tier.popleft()
+        return None
 
-    def peek(self) -> Request | None:
-        """The queue head without popping it — the page-aware admission
-        gate inspects the head's footprint before committing pool pages
-        (scheduler.admit's ``can_seat``)."""
+    def peek(self):
+        """The effective queue head without popping it — the page-aware
+        admission gate inspects the head's footprint before committing
+        pool pages."""
         with self._lock:
-            return self._q[0] if self._q else None
+            for tier in self._tiers:
+                if tier:
+                    return tier[0]
+        return None
 
-    def pop_expired(self, now: float) -> list[Request]:
-        """Remove and return every queued request already past its TTFT
+    def pop_expired(self, now: float) -> list:
+        """Remove and return every queued entry already past its TTFT
         or total deadline — they will never make their SLA, so they must
-        not consume a prefill (the engine completes them with finish
-        reason ``timeout``)."""
+        not consume a prefill. The engine completes fresh requests with
+        finish reason ``timeout`` and requeued resumptions with
+        ``preempted_timeout`` (their clock ran while they waited for a
+        re-seat)."""
+        expired: list = []
         with self._lock:
-            expired = [r for r in self._q
-                       if (r.ttft_deadline_t is not None
-                           and now >= r.ttft_deadline_t)
-                       or (r.deadline_t is not None and now >= r.deadline_t)]
-            if expired:
-                dead = set(id(r) for r in expired)
-                self._q = collections.deque(
-                    r for r in self._q if id(r) not in dead)
+            for t, tier in enumerate(self._tiers):
+                dead = []
+                for entry in tier:
+                    req = _request_of(entry)
+                    # A resumption that already emitted its first token
+                    # is only bound by the TOTAL deadline (TTFT was met
+                    # before the preemption).
+                    has_first = (isinstance(entry, ActiveSequence)
+                                 and entry.first_token_t is not None)
+                    if ((req.ttft_deadline_t is not None and not has_first
+                         and now >= req.ttft_deadline_t)
+                            or (req.deadline_t is not None
+                                and now >= req.deadline_t)):
+                        dead.append(entry)
+                if dead:
+                    ids = set(id(e) for e in dead)
+                    self._tiers[t] = collections.deque(
+                        e for e in tier if id(e) not in ids)
+                    expired.extend(dead)
         return expired
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._q)
+            return self._depth()

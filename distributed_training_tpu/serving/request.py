@@ -1,11 +1,17 @@
 """Request lifecycle datatypes for the serving engine.
 
-A request moves queue → slot → finished:
+A request moves queue → slot → finished — and, under overload, may take
+the preemption detour slot → queue → slot again:
 
 - :class:`Request` is the immutable admission record (tokens + budget +
-  arrival timestamp).
+  arrival timestamp + SLO tier + tenant).
 - :class:`ActiveSequence` is a slot's host-side bookkeeping while the
-  sequence decodes (emitted tokens, first/last token timestamps).
+  sequence decodes (emitted tokens, first/last token timestamps). When a
+  higher-tier request needs its slot or pages, :meth:`prepare_resume`
+  turns it into a queued *resumption*: the emitted tokens ride along and
+  are re-prefilled on the next seat, so the preemption is LOSSLESS —
+  the continued token stream is bitwise identical to an uninterrupted
+  run (see docs/SERVING.md "Tiered scheduling & preemption").
 - :class:`FinishedRequest` is the completed result with its SLA numbers
   (TTFT from arrival to first emitted token; TPOT as the mean inter-token
   interval over the decode phase).
@@ -21,18 +27,35 @@ import numpy as np
 FINISH_EOS = "eos"        # emitted the configured eos_id
 FINISH_LENGTH = "length"  # hit its max_new_tokens budget
 FINISH_TIMEOUT = "timeout"  # missed its TTFT/total deadline (evicted)
+# Tier-aware load shedding: a queued lower-tier request dropped to make
+# room for a higher-tier arrival on a full queue (serving/queue.py).
+FINISH_SHED = "shed"
+# A preempted-and-requeued sequence whose deadline expired before it
+# could re-seat (or finish after re-seating). Kept distinct from plain
+# ``timeout`` so telemetry attributes the miss to preemption pressure,
+# not to the request's own service time.
+FINISH_PREEMPT_TIMEOUT = "preempted_timeout"
 
 
 @dataclasses.dataclass(frozen=True)
 class Request:
     """One admitted generation request (arrival-ordered by ``uid``).
 
+    ``priority`` is the SLO tier: 0 is the highest (interactive) tier,
+    larger numbers degrade first under load (``ServeConfig.num_tiers``
+    bounds it). ``tenant`` names the submitting principal for the
+    per-tenant quota/weighted-fair admission in
+    :class:`~distributed_training_tpu.serving.queue.RequestQueue`.
+
     ``ttft_deadline_t`` / ``deadline_t`` are absolute ``perf_counter``
     deadlines (None = none): a request past its TTFT deadline with no
     first token yet (still queued, or seated mid-chunked-prefill), or
     still decoding past its total deadline, is evicted with finish
     reason ``timeout`` instead of holding a slot or queue position
-    forever under overload.
+    forever under overload. The clock keeps running while a preempted
+    sequence waits requeued — that eviction reports
+    ``preempted_timeout`` instead, so the miss is attributed to
+    preemption pressure.
     """
 
     uid: int
@@ -41,24 +64,29 @@ class Request:
     arrival_t: float          # perf_counter at submit
     ttft_deadline_t: float | None = None
     deadline_t: float | None = None
+    priority: int = 0         # SLO tier, 0 = highest
+    tenant: str = "default"
 
 
 @dataclasses.dataclass
 class ActiveSequence:
-    """Host-side state of one occupied decode slot."""
+    """Host-side state of one occupied decode slot (or, after a
+    preemption, of one requeued resumption awaiting a slot)."""
 
     request: Request
     slot: int
     tokens: list = dataclasses.field(default_factory=list)  # emitted ids
     # When the scheduler seated the request into its slot (perf_counter):
     # arrival→seated is the queueing span, seated→first token the prefill
-    # span on the trace timeline (serving/engine.py).
+    # span on the trace timeline (serving/engine.py). A re-seat after
+    # preemption re-stamps it, so the TTFT decomposition
+    # (queue_wait + prefill == TTFT) stays telescoping.
     seated_t: float | None = None
     first_token_t: float | None = None
     last_token_t: float | None = None
-    # Chunked-prefill progress (paged engine): prompt tokens already
+    # Chunked-prefill progress (paged engine): prefill tokens already
     # written to the KV pool. A seated sequence decodes only once
-    # prefill_pos reaches the prompt length AND its first token landed;
+    # prefill_pos reaches the prefill length AND its first token landed;
     # until then it occupies its slot as "prefilling".
     prefill_pos: int = 0
     # Wall-time a live weight hot-swap barrier blocked this sequence's
@@ -68,14 +96,52 @@ class ActiveSequence:
     # swap pause is deployment cost the engine attributes explicitly
     # rather than smearing over whichever requests were in flight.
     swap_pause_s: float = 0.0
+    # Lossless preemption state: how many times this sequence was
+    # evicted mid-flight to make room for a higher tier, and — when it
+    # had already emitted tokens — the token prefix (prompt + emitted
+    # minus the uncached last token) the next seat must re-prefill.
+    # The re-prefill recomputes exactly the cache positions the
+    # eviction freed, and the continuation samples the same
+    # fold_in(rng, position) stream, so the final output is bitwise
+    # identical to an uninterrupted run.
+    preempts: int = 0
+    resume_prefix: np.ndarray | None = None
+
+    @property
+    def prefill_tokens(self) -> np.ndarray:
+        """What prefill must write: the original prompt, or — resuming
+        after a preemption — prompt + emitted tokens except the last
+        (the last emitted token is never cached; it re-enters as the
+        next decode step's incoming token, exactly as it would have
+        uninterrupted)."""
+        return (self.request.prompt if self.resume_prefix is None
+                else self.resume_prefix)
 
     @property
     def prefilling(self) -> bool:
         """Seated but not yet decoding (paged engine's chunked prefill);
         always False on the legacy path, whose batch-1 prefill emits the
         first token before the sequence ever reaches the slot state."""
-        return (self.prefill_pos < self.request.prompt.size
+        return (self.prefill_pos < self.prefill_tokens.size
                 or not self.tokens)
+
+    def prepare_resume(self) -> None:
+        """Preemption bookkeeping: snapshot the re-prefill prefix from
+        the tokens emitted so far and rewind the prefill cursor. The
+        snapshot is taken NOW (not derived lazily) because ``tokens``
+        keeps growing after the re-seat — the prefill target must stay
+        what was cached at eviction time."""
+        if self.tokens:
+            self.resume_prefix = np.concatenate([
+                self.request.prompt,
+                # graftlint: disable=hot-path-transfer -- emitted tokens are host ints by contract (note_token casts at landing); no device value involved
+                np.asarray(self.tokens[:-1], np.int32)])
+        # else: preempted mid-prefill — restart from the original prompt
+        # (resume_prefix stays None; nothing was emitted, so nothing to
+        # carry).
+        self.prefill_pos = 0
+        self.preempts += 1
+        self.slot = -1
 
     def note_token(self, token: int, t: float) -> None:
         self.tokens.append(int(token))
@@ -90,14 +156,20 @@ class ActiveSequence:
         EOS and budget win over a deadline landing on the same token (a
         naturally-finished request is not a timeout); ``now`` enables the
         total-deadline check — callers without deadlines pass nothing.
+        A deadline miss on a sequence that was ever preempted reports
+        ``preempted_timeout``: its clock kept running while it sat
+        requeued, so the miss belongs to preemption pressure, not to the
+        request's own service time.
         """
         if eos_id is not None and self.tokens and self.tokens[-1] == eos_id:
             return FINISH_EOS
         if len(self.tokens) >= self.request.max_new_tokens:
             return FINISH_LENGTH
+        timeout = (FINISH_PREEMPT_TIMEOUT if self.preempts
+                   else FINISH_TIMEOUT)
         dl = self.request.deadline_t
         if now is not None and dl is not None and now >= dl:
-            return FINISH_TIMEOUT
+            return timeout
         # TTFT deadline, mid-prefill: chunked prefill holds a slot for
         # ceil(prompt/chunk) iterations before the first token, so a
         # request can now miss its TTFT SLA while SEATED (impossible on
@@ -110,7 +182,7 @@ class ActiveSequence:
         tdl = self.request.ttft_deadline_t
         if (now is not None and tdl is not None and now >= tdl
                 and self.first_token_t is None):
-            return FINISH_TIMEOUT
+            return timeout
         return None
 
 
@@ -121,25 +193,33 @@ class FinishedRequest:
     A queue-side deadline eviction completes with zero tokens and no
     latency samples (``ttft_ms``/``first_token_t`` None): the request
     never produced a first token, so it contributes to the timeout
-    counter, not to the TTFT percentiles.
+    counter, not to the TTFT percentiles. A shed or expired resumption
+    (preempted, then dropped from the queue) DOES carry the tokens it
+    had emitted before eviction.
     """
 
     uid: int
     prompt: np.ndarray
-    tokens: np.ndarray        # int32 [n]; n >= 1 except queue timeouts
-    finish_reason: str        # FINISH_EOS | FINISH_LENGTH | FINISH_TIMEOUT
+    tokens: np.ndarray        # int32 [n]; n >= 1 except queue evictions
+    finish_reason: str        # FINISH_* above
     ttft_ms: float | None     # arrival → first emitted token
     tpot_ms: float | None     # mean inter-token ms; None for <2 tokens
     arrival_t: float          # perf_counter timestamps (fairness audits)
     first_token_t: float | None
-    # Trace-timeline fields (None for queue-side timeouts): the slot the
-    # request decoded in and its last token's landing time — the engine
-    # closes the slot track's decode span from these at eviction.
+    # Trace-timeline fields (None for queue-side evictions): the slot
+    # the request decoded in and its last token's landing time — the
+    # engine closes the slot track's decode span from these at eviction.
     last_token_t: float | None = None
     slot: int | None = None
+    priority: int = 0         # SLO tier (per-tier SLA histograms)
+    tenant: str = "default"
 
     @staticmethod
-    def from_active(seq: ActiveSequence, reason: str) -> "FinishedRequest":
+    def from_active(seq: ActiveSequence, reason: str,
+                    slot: int | None = -1) -> "FinishedRequest":
+        """``slot`` defaults to the sequence's own; queue-side evictions
+        of a requeued resumption pass ``slot=None`` (it holds no slot,
+        so its trace marks belong on the queue track)."""
         n = len(seq.tokens)
         tpot = None
         if n > 1:
@@ -162,20 +242,31 @@ class FinishedRequest:
             arrival_t=seq.request.arrival_t,
             first_token_t=seq.first_token_t,
             last_token_t=seq.last_token_t,
-            slot=seq.slot,
+            slot=seq.slot if slot == -1 else slot,
+            priority=seq.request.priority,
+            tenant=seq.request.tenant,
+        )
+
+    @staticmethod
+    def rejected_in_queue(req: Request, reason: str) -> "FinishedRequest":
+        """A request evicted from the queue (deadline expiry or a
+        tier-aware shed) — it never reached a slot, so it carries no
+        tokens and no latency samples."""
+        return FinishedRequest(
+            uid=req.uid,
+            prompt=req.prompt,
+            tokens=np.zeros((0,), np.int32),
+            finish_reason=reason,
+            ttft_ms=None,
+            tpot_ms=None,
+            arrival_t=req.arrival_t,
+            first_token_t=None,
+            priority=req.priority,
+            tenant=req.tenant,
         )
 
     @staticmethod
     def timed_out_in_queue(req: Request) -> "FinishedRequest":
         """A request evicted from the queue past its deadline — it never
         reached a slot, so it carries no tokens and no latency samples."""
-        return FinishedRequest(
-            uid=req.uid,
-            prompt=req.prompt,
-            tokens=np.zeros((0,), np.int32),
-            finish_reason=FINISH_TIMEOUT,
-            ttft_ms=None,
-            tpot_ms=None,
-            arrival_t=req.arrival_t,
-            first_token_t=None,
-        )
+        return FinishedRequest.rejected_in_queue(req, FINISH_TIMEOUT)

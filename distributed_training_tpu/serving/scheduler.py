@@ -5,11 +5,20 @@ must never change (a retrace costs seconds), so the batch is
 ``max_batch`` fixed SLOTS rather than a dynamic list of sequences. At
 every iteration boundary the scheduler
 
-- **admits**: pops queued requests FIFO into however many slots are free
-  (each admission triggers one prefill that scatters into the freed
-  slot's cache rows), and
-- **evicts**: returns finished sequences (EOS emitted, or completion
-  budget spent) to the caller and marks their slots free.
+- **admits**: seats queue candidates (highest SLO tier first, weighted
+  tenant-fair within a tier — :meth:`RequestQueue.next_candidate` owns
+  that order) into however many slots are free, gated by the engine's
+  page-commitment predicate,
+- **preempts**: when a candidate outranks active work and cannot seat
+  (no slot, reserved headroom, or no pages), the WORST active sequence
+  of a strictly lower tier is evicted and requeued — losslessly: its
+  emitted tokens ride back to the queue and are re-prefilled on the
+  next seat, continuing the same ``fold_in(rng, position)`` stream, so
+  the final output is bitwise identical to an uninterrupted run
+  (vLLM-style preempt-and-recompute; docs/SERVING.md), and
+- **evicts**: returns finished sequences (EOS emitted, completion
+  budget spent, or deadline missed) to the caller and marks their
+  slots free.
 
 Mid-iteration the slot set is immutable — the decode step sees a boolean
 active mask and per-slot cache write heads, nothing else. All state here
@@ -21,7 +30,9 @@ several tokens per iteration (the engine's verify window,
 boundaries, and :meth:`SlotScheduler.evict_finished` reads the same
 ``tokens``/EOS/budget state — a mid-window EOS is truncated by the
 engine before it lands here, so ``tokens[-1]`` remains the finishing
-token exactly as in one-token decode.
+token exactly as in one-token decode. It composes with preemption the
+same way: a preempted slot's drafts simply never happen, and the
+resumption drafts again from its (identical) token stream.
 """
 
 from __future__ import annotations
@@ -38,12 +49,28 @@ from distributed_training_tpu.serving.request import (
 
 
 class SlotScheduler:
-    """Fixed decode slots, FIFO refill, boundary eviction."""
+    """Fixed decode slots; tier-aware refill + preemption, boundary
+    eviction.
 
-    def __init__(self, num_slots: int):
+    ``reserved_slots`` holds that many slots back from non-top tiers
+    (``priority > 0``): a best-effort request only seats while MORE than
+    ``reserved_slots`` slots are free, so a high-tier arrival always
+    finds headroom without even needing a preemption. Tier 0 ignores
+    the reserve. ``preempt=False`` disables mid-flight eviction (tiers
+    then only order the queue).
+    """
+
+    def __init__(self, num_slots: int, *, reserved_slots: int = 0,
+                 preempt: bool = True):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if not 0 <= reserved_slots < num_slots:
+            raise ValueError(
+                f"reserved_slots must be in [0, num_slots-1], got "
+                f"{reserved_slots} of {num_slots}")
         self.num_slots = int(num_slots)
+        self.reserved_slots = int(reserved_slots)
+        self.preempt = bool(preempt)
         self._slots: list[ActiveSequence | None] = [None] * self.num_slots
 
     # -- views ---------------------------------------------------------------
@@ -65,39 +92,110 @@ class SlotScheduler:
             raise KeyError(f"slot {slot} is free")
         return seq
 
+    def tenant_active(self) -> dict[str, int]:
+        """tenant -> seated-sequence count (the queue's quota input)."""
+        counts: dict[str, int] = {}
+        for s in self._slots:
+            if s is not None:
+                t = s.request.tenant
+                counts[t] = counts.get(t, 0) + 1
+        return counts
+
     # -- iteration boundaries ------------------------------------------------
-    def admit(self, queue, can_seat=None) -> list[ActiveSequence]:
-        """Fill free slots from ``queue`` in strict arrival order.
+    def _victim_slot(self, priority: int) -> int | None:
+        """The slot to preempt for a ``priority`` candidate: the active
+        sequence of the numerically LARGEST (worst) tier strictly below
+        the candidate, newest (largest uid) first — the least sunk cost
+        within the worst tier, and a deterministic rule either way.
+        None when nothing outrankable is active."""
+        best: int | None = None
+        for slot, seq in enumerate(self._slots):
+            if seq is None or seq.request.priority <= priority:
+                continue
+            if best is None or (
+                    (seq.request.priority, seq.request.uid)
+                    > (self._slots[best].request.priority,
+                       self._slots[best].request.uid)):
+                best = slot
+        return best
 
-        Lowest free slot first — slot choice is cosmetic (slots are
-        independent lanes), but a deterministic rule keeps batched runs
-        reproducible. Returns the newly seated sequences; the engine
-        prefills each one.
+    def admit(self, queue, can_seat=None, *, on_seat=None,
+              on_preempt=None, preempt_helps=None
+              ) -> list[ActiveSequence]:
+        """One admission pass; returns the newly seated sequences (the
+        engine prefills each — resumptions re-prefill their carried
+        prefix).
 
-        ``can_seat`` (paged engine) is the page-aware admission gate: a
-        predicate over the queue HEAD, consulted before each pop. When
-        the head's worst-case page commitment does not fit the pool,
-        admission stops — strictly FIFO, never skipping ahead to a
-        smaller request, so a long-context request cannot starve behind
-        a stream of short ones (the legacy ``max_len``-sum behavior,
-        restated in pages).
+        ``can_seat`` is the engine's resource gate (page commitment +
+        reserved-page headroom), consulted per candidate; ``on_seat``
+        runs engine-side seat bookkeeping (commit pages, slot RNG);
+        ``on_preempt`` runs eviction bookkeeping (free pages, counters)
+        BEFORE the sequence is requeued. Candidate order is the queue's
+        (tier-strict, tenant-fair). A resource-blocked candidate first
+        tries to PREEMPT the worst strictly-lower-tier active sequence —
+        but only when ``preempt_helps(cand, victims)`` (the engine's
+        futility bound: could evicting EVERY strictly-lower-tier active
+        ever free enough?) says yes, so a candidate too large for its
+        preemptible pool cannot throw away best-effort progress for
+        nothing. When nothing is (usefully) preemptible, admission
+        STOPS — lower tiers never skip past a blocked higher tier (the
+        anti-starvation / anti-priority-inversion rule), and within a
+        (tier, tenant) lane order stays strictly FIFO.
+
+        Every loop step either seats a candidate (queue shrinks) or
+        preempts a strictly-lower-tier active (num_active shrinks, and
+        the victim can only re-seat after this candidate), so the pass
+        terminates; preemption cannot cycle because it is strictly
+        rank-ordered. (A candidate vanishing between the queue's
+        ``next_candidate`` and ``take`` — a producer-side tier-aware
+        shed racing this pass — just re-polls.)
         """
         seated: list[ActiveSequence] = []
-        for slot in range(self.num_slots):
-            if self._slots[slot] is not None:
-                continue
-            if can_seat is not None:
-                head = queue.peek()
-                if head is None or not can_seat(head):
-                    break
-            req: Request | None = queue.pop()
-            if req is None:
+        while True:
+            cand = queue.next_candidate(self.tenant_active())
+            if cand is None:
                 break
-            # seated_t closes the request's queueing interval (arrival →
-            # seat); the engine's trace emits it as the 'queued' span.
-            seq = ActiveSequence(request=req, slot=slot,
-                                 seated_t=time.perf_counter())
+            req: Request = (cand.request
+                            if isinstance(cand, ActiveSequence) else cand)
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            slot_ok = bool(free) and (
+                req.priority == 0 or len(free) > self.reserved_slots)
+            if not slot_ok or (can_seat is not None
+                              and not can_seat(cand)):
+                victim = (self._victim_slot(req.priority)
+                          if self.preempt else None)
+                if victim is None:
+                    break
+                if preempt_helps is not None:
+                    victims = [s for s in self._slots
+                               if s is not None
+                               and s.request.priority > req.priority]
+                    if not preempt_helps(cand, victims):
+                        break
+                seq = self._slots[victim]
+                self._slots[victim] = None
+                if on_preempt is not None:
+                    on_preempt(seq)
+                seq.prepare_resume()
+                queue.requeue(seq)
+                continue
+            if not queue.take(cand):
+                continue  # candidate shed concurrently: re-poll
+            slot = free[0]
+            # seated_t closes (or re-opens, after a preemption) the
+            # request's queueing interval; the engine's trace emits it
+            # as the 'queued' span.
+            now = time.perf_counter()
+            if isinstance(cand, ActiveSequence):
+                seq = cand
+                seq.slot = slot
+                seq.seated_t = now
+            else:
+                seq = ActiveSequence(request=cand, slot=slot,
+                                     seated_t=now)
             self._slots[slot] = seq
+            if on_seat is not None:
+                on_seat(seq)
             seated.append(seq)
         return seated
 
@@ -110,9 +208,10 @@ class SlotScheduler:
         a decode iteration. ``now`` additionally evicts slots past their
         total deadline (partial tokens returned) — and, chunked prefill,
         slots past their TTFT deadline with no first token yet — with
-        finish reason ``timeout``: a slot is serving capacity, and a
-        request that already missed its SLA must hand it to one that can
-        still make its own.
+        finish reason ``timeout`` (``preempted_timeout`` when the
+        sequence's clock ran down while it sat preempted): a slot is
+        serving capacity, and a request that already missed its SLA must
+        hand it to one that can still make its own.
         """
         done: list[FinishedRequest] = []
         for slot in range(self.num_slots):

@@ -90,12 +90,44 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--spec-draft-window", type=int, default=16,
                         help="gpt drafter: context tokens re-run per "
                              "draft step")
+    # SLO tiers + multi-tenant fairness (docs/SERVING.md "Tiered
+    # scheduling & preemption").
+    parser.add_argument("--num-tiers", type=int, default=1,
+                        help="SLO tiers: priority 0 = highest "
+                             "(interactive); larger tiers are shed and "
+                             "preempted first under overload. 1 = the "
+                             "single-FIFO behavior")
+    parser.add_argument("--priority", type=int, default=0,
+                        help="SLO tier for this CLI's prompts (a "
+                             "multi-tier deployment submits per-request "
+                             "via Engine.submit(priority=, tenant=))")
+    parser.add_argument("--tenant", type=str, default="default",
+                        help="tenant principal for this CLI's prompts "
+                             "(per-tenant quota + weighted-fair "
+                             "admission)")
+    parser.add_argument("--tenant-quota", type=int, default=None,
+                        help="max concurrently seated requests per "
+                             "tenant (None = uncapped)")
+    parser.add_argument("--tier-reserved-slots", type=int, default=0,
+                        help="decode slots held back from non-top "
+                             "tiers so tier-0 arrivals always find "
+                             "headroom")
+    parser.add_argument("--tier-reserved-pages", type=int, default=0,
+                        help="KV pool pages held back from non-top "
+                             "tiers (paged engine)")
+    parser.add_argument("--no-preempt", action="store_true",
+                        default=False,
+                        help="disable lossless preempt-and-requeue of "
+                             "lower tiers (tiers then only order the "
+                             "queue)")
     # Graceful degradation (resilience round; docs/RESILIENCE.md).
     parser.add_argument("--max-queue-depth", type=int, default=None,
-                        help="bounded admission: a submit beyond this "
-                             "queue depth is shed with a typed "
-                             "QueueFullError instead of growing TTFT "
-                             "without bound")
+                        help="bounded admission: beyond this depth the "
+                             "newest queued lower-tier request is shed "
+                             "to admit higher-tier work; the incoming "
+                             "request itself is shed with a typed "
+                             "QueueFullError when nothing lower-tier "
+                             "is queued")
     parser.add_argument("--ttft-deadline-ms", type=float, default=None,
                         help="evict requests still queued past this "
                              "time-to-first-token deadline (finish "
@@ -235,6 +267,11 @@ def main() -> int:
         spec_drafter=args.spec_drafter,
         spec_ngram=args.spec_ngram,
         spec_draft_window=args.spec_draft_window,
+        num_tiers=args.num_tiers,
+        tenant_quota=args.tenant_quota,
+        tier_reserved_slots=args.tier_reserved_slots,
+        tier_reserved_pages=args.tier_reserved_pages,
+        preempt=not args.no_preempt,
         max_queue_depth=args.max_queue_depth,
         ttft_deadline_ms=args.ttft_deadline_ms,
         deadline_ms=args.deadline_ms,
@@ -309,7 +346,9 @@ def main() -> int:
                       f"{args.vocab_size}): {text!r}", file=sys.stderr)
                 continue
             try:
-                req = engine.submit(tokens.astype(np.int32))
+                req = engine.submit(tokens.astype(np.int32),
+                                    priority=args.priority,
+                                    tenant=args.tenant)
             except DrainingError as e:
                 print(f"[serve] DRAINING, reject {text!r}: {e}",
                       file=sys.stderr)

@@ -96,6 +96,24 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     # speedup factor (derived from the zero-drift counters, so it only
     # moves when the accept economics really change)
     "spec_tokens_per_dispatch": ("higher", 0.05),
+    # SLO-tiered scheduling (docs/SERVING.md "Tiered scheduling &
+    # preemption"): under the bench's --virtual-dt drive the whole
+    # admission/preempt/shed schedule is a pure function of the seeded
+    # scenario, so these counters are zero-drift workload-deterministic
+    # — ANY movement is a scheduling-policy change, not noise. In a
+    # clean (single-tier) smoke all of them are zero, and the
+    # zero-baseline zero-tolerance semantics keep growth from hiding.
+    "requests_preempted": ("both", 0.0),
+    "preempted_token_recompute": ("both", 0.0),
+    "requests_preempt_timed_out": ("lower", 0.0),
+    "requests_shed": ("both", 0.0),
+    "tier0_requests_shed": ("lower", 0.0),
+    "tier0_requests_finished": ("both", 0.0),
+    "tier1_requests_shed": ("both", 0.0),
+    "tier1_requests_finished": ("both", 0.0),
+    # high-tier latency SLO (wall-clock: cliff thresholds only)
+    "tier0_ttft_hist_p99_ms": ("lower", 3.0),
+    "tier0_tpot_hist_p95_ms": ("lower", 3.0),
 }
 
 

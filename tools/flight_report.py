@@ -156,11 +156,16 @@ def render(summary: dict) -> str:
                 f"{srv.get('admission_blocked_s', 0.0):.2f}s")
         degraded = {k: srv.get(k, 0) for k in (
             "requests_timed_out", "requests_shed",
-            "requests_drain_rejected")}
+            "requests_drain_rejected", "requests_preempted",
+            "requests_preempt_timed_out")}
         if any(degraded.values()):
             add(f"    degradation: timed out {degraded['requests_timed_out']}"
                 f"  shed {degraded['requests_shed']}"
-                f"  drain-rejected {degraded['requests_drain_rejected']}")
+                f"  drain-rejected {degraded['requests_drain_rejected']}"
+                f"  preempted {degraded['requests_preempted']}"
+                f" (expired {degraded['requests_preempt_timed_out']}, "
+                f"recompute "
+                f"{srv.get('preempted_token_recompute', 0):.0f} tok)")
     hosts = summary.get("hosts")
     if hosts:
         line = f"  hosts: {hosts['num_hosts']}"

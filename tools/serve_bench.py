@@ -1,10 +1,13 @@
 #!/usr/bin/env python
 """Synthetic-load benchmark for the continuous-batching serving engine.
 
-Drives ``distributed_training_tpu/serving/`` with a Poisson arrival
-process (exponential inter-arrival times at ``--rate`` req/s) over
-random-token prompts against a random-weight GPT, and prints ONE
-strict-JSON line with the SLA summary:
+Drives ``distributed_training_tpu/serving/`` with a seeded traffic
+scenario (``tools/traffic.py``; ``--scenario poisson`` is the classic
+exponential-inter-arrival process at ``--rate`` req/s, others add
+bursts, diurnal cycles, heavy-tailed sizes, multi-tenant SLO-tier
+mixes, and engineered preemption storms) over random-token prompts
+against a random-weight GPT, and prints ONE strict-JSON line with the
+SLA summary:
 
     {"throughput_tok_s": ..., "ttft_p50_ms": ..., "ttft_p95_ms": ...,
      "tpot_p50_ms": ..., "tpot_p95_ms": ..., "ttft_hist_p50_ms": ...,
@@ -41,6 +44,42 @@ def add_argument() -> argparse.Namespace:
                    help="measured requests")
     p.add_argument("--rate", type=float, default=50.0,
                    help="mean arrival rate, requests/second")
+    p.add_argument("--scenario", type=str, default="poisson",
+                   help="traffic scenario (tools/traffic.py): poisson, "
+                        "bursty, diurnal, heavy_tail, multi_tenant, "
+                        "two_tier_burst, preempt_storm. Multi-tier "
+                        "scenarios raise --num-tiers and apply their "
+                        "tenant weights automatically; compose chaos "
+                        "drills with --swap-at-request / --spec-k")
+    p.add_argument("--virtual-dt", type=float, default=0.0,
+                   help="deterministic drive: release scenario arrivals "
+                        "on a virtual clock advancing this many ms per "
+                        "engine iteration instead of wall time — the "
+                        "whole admission/preempt/shed schedule becomes "
+                        "a pure function of (--scenario, --seed), so "
+                        "the scheduling counters are bitwise "
+                        "reproducible across runs and machines (the CI "
+                        "overload drill gates on this). 0 = wall clock")
+    p.add_argument("--num-tiers", type=int, default=0,
+                   help="SLO tiers (0 = the scenario's own tier count); "
+                        "priority 0 = highest, larger tiers degrade "
+                        "first under load")
+    p.add_argument("--tenant-quota", type=int, default=None,
+                   help="max concurrently seated requests per tenant")
+    p.add_argument("--tier-reserved-slots", type=int, default=0,
+                   help="decode slots held back from non-top tiers so "
+                        "tier-0 arrivals always find headroom")
+    p.add_argument("--tier-reserved-pages", type=int, default=0,
+                   help="KV pool pages held back from non-top tiers")
+    p.add_argument("--no-preempt", action="store_true", default=False,
+                   help="disable lossless preempt-and-requeue (tiers "
+                        "then only order the queue)")
+    p.add_argument("--max-queue-depth", type=int, default=None,
+                   help="bounded admission: beyond this depth the "
+                        "NEWEST queued best-effort request is shed to "
+                        "admit higher-tier work (the incoming request "
+                        "itself is shed when nothing lower-tier is "
+                        "queued)")
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-len", type=int, default=None,
                    help="per-slot KV budget; default model max-len")
@@ -141,6 +180,26 @@ def main() -> int:
             f"prompt in the {budget}-token per-slot budget "
             f"(--max-len/--model-max-len)")
 
+    # Scenario first (tools/traffic.py): it decides the tier count and
+    # tenant weights the engine config needs, and generating it is
+    # jax-free. Deterministic in (--scenario, --seed).
+    from tools.traffic import SCENARIOS, make_scenario
+
+    if args.scenario not in SCENARIOS:
+        raise SystemExit(
+            f"unknown --scenario {args.scenario!r} "
+            f"(have: {', '.join(sorted(SCENARIOS))})")
+    scen = SCENARIOS[args.scenario]
+    # Never below what the scenario submits: an explicit smaller
+    # --num-tiers would make every higher-numbered arrival die in
+    # submit() with a priority ValueError mid-measurement.
+    num_tiers = max(args.num_tiers, scen.num_tiers)
+    load = make_scenario(
+        args.scenario, seed=args.seed, requests=args.requests,
+        rate=args.rate, mean_prompt_len=args.prompt_len,
+        max_prompt_len=max_prompt, max_new_tokens=args.max_new_tokens,
+        vocab_size=args.vocab_size, budget=budget)
+
     model = get_model(
         "transformer_lm", num_classes=args.vocab_size,
         num_layers=args.num_layers, num_heads=args.num_heads,
@@ -166,6 +225,12 @@ def main() -> int:
         spec_k=args.spec_k, spec_drafter=args.spec_drafter,
         spec_ngram=args.spec_ngram,
         spec_draft_window=args.spec_draft_window,
+        num_tiers=num_tiers, tenant_quota=args.tenant_quota,
+        tenant_weights=scen.tenant_weights,
+        tier_reserved_slots=args.tier_reserved_slots,
+        tier_reserved_pages=args.tier_reserved_pages,
+        preempt=not args.no_preempt,
+        max_queue_depth=args.max_queue_depth,
         seed=args.seed), trace=trace)
 
     # Live telemetry plane: the measured window is scrapeable while it
@@ -182,12 +247,6 @@ def main() -> int:
 
     rng = np.random.RandomState(args.seed)
 
-    def prompts(n):
-        hi = min(2 * args.prompt_len, max_prompt + 1)
-        lens = rng.randint(1, max(hi, 2), size=n)
-        return [rng.randint(0, args.vocab_size, size=int(l)).astype(np.int32)
-                for l in lens]
-
     if not args.no_warmup:
         # Compile on the measured engine itself (compiles are
         # per-jit-closure, so a throwaway engine would not warm this
@@ -199,12 +258,19 @@ def main() -> int:
         # the warm-up (remaining budget > 1) so a GPT drafter's
         # 'draft' program compiles outside the measured window; the
         # verify window itself is one fixed shape either way.
+        # Each warm-up request runs to completion before the next
+        # submits: a tight --max-queue-depth must not shed (crash) the
+        # warm-up, and one request per shape covers every compiled
+        # program either way (shapes are fixed-width, independent of
+        # how many slots are active).
         warm_new = 4 if args.spec_k else 2
+        warm_tokens = 0
         if engine.paged:
             for _ in range(2):
                 engine.submit(rng.randint(0, args.vocab_size,
                                           size=2).astype(np.int32),
                               max_new_tokens=warm_new)
+                warm_tokens += sum(f.tokens.size for f in engine.run())
         else:
             for lb in range(args.prefill_bucket, 2 * args.prompt_len - 1 +
                             args.prefill_bucket, args.prefill_bucket):
@@ -213,7 +279,7 @@ def main() -> int:
                 engine.submit(rng.randint(0, args.vocab_size,
                                           size=lb).astype(np.int32),
                               max_new_tokens=warm_new)
-        warm_tokens = sum(f.tokens.size for f in engine.run())
+                warm_tokens += sum(f.tokens.size for f in engine.run())
         engine.reset_stats()
         print(f"[serve_bench] warm-up done ({warm_tokens} tokens)",
               file=sys.stderr)
@@ -239,9 +305,6 @@ def main() -> int:
         compile_watch = CompileWatch()
 
     n = args.requests
-    load = prompts(n)
-    # Poisson process: exponential inter-arrival gaps at the target rate.
-    arrivals = np.cumsum(rng.exponential(1.0 / args.rate, size=n))
 
     # Mid-run hot-swap mode: the staged tree is built BEFORE the
     # measured window (staging is off the engine's hot path in real
@@ -255,30 +318,64 @@ def main() -> int:
         swap_params = model.init(jax.random.PRNGKey(args.seed + 1),
                                  np.zeros((1, 8), np.int32))["params"]
 
-    t0 = time.perf_counter()
+    from distributed_training_tpu.resilience.errors import QueueFullError
+
     submitted = 0
     finished = 0
-    while submitted < n:
-        now = time.perf_counter() - t0
-        while submitted < n and arrivals[submitted] <= now:
-            engine.submit(load[submitted],
-                          arrival_t=t0 + arrivals[submitted])
-            submitted += 1
-            if swap_params is not None and \
-                    submitted == args.swap_at_request:
-                engine.arm_swap(swap_params,
-                                epoch=engine.weights_epoch + 1)
-        if engine.idle and submitted < n:
-            # Ahead of the arrival process: sleep to the next arrival
-            # instead of spinning empty iterations.
-            time.sleep(min(arrivals[submitted] - now, 0.05))
-            continue
-        finished += len(engine.step())
+    shed_at_submit = 0
+
+    def submit_next(arrival_t=None):
+        """Submit the next scenario arrival; a bounded-queue shed of the
+        INCOMING request counts here (a shed of a queued lower-tier
+        victim instead surfaces as a 'shed' completion from step())."""
+        nonlocal submitted, shed_at_submit
+        r = load[submitted]
+        try:
+            engine.submit(r.prompt, max_new_tokens=r.max_new_tokens,
+                          arrival_t=arrival_t, priority=r.priority,
+                          tenant=r.tenant)
+        except QueueFullError:
+            shed_at_submit += 1
+        submitted += 1
+        if swap_params is not None and submitted == args.swap_at_request:
+            engine.arm_swap(swap_params, epoch=engine.weights_epoch + 1)
+
+    if args.virtual_dt > 0:
+        # Deterministic drive: arrivals release on a virtual clock that
+        # advances --virtual-dt ms per engine iteration. Token streams
+        # are deterministic, so the full admission/preempt/shed schedule
+        # is a pure function of (scenario, seed) — bitwise reproducible
+        # across runs AND machines. TTFT/TPOT keep wall semantics
+        # (arrival_t = the submit instant); only release timing is
+        # virtualized, so latency stats remain real, merely paced by
+        # iterations instead of seconds.
+        it = 0
+        while submitted < n:
+            vnow = it * args.virtual_dt / 1e3
+            while submitted < n and load[submitted].arrival_s <= vnow:
+                submit_next()
+            finished += len(engine.step())
+            it += 1
+    else:
+        t0 = time.perf_counter()
+        while submitted < n:
+            now = time.perf_counter() - t0
+            while submitted < n and load[submitted].arrival_s <= now:
+                submit_next(arrival_t=t0 + load[submitted].arrival_s)
+            if engine.idle and submitted < n:
+                # Ahead of the arrival process: sleep to the next
+                # arrival instead of spinning empty iterations.
+                time.sleep(min(load[submitted].arrival_s - now, 0.05))
+                continue
+            finished += len(engine.step())
     # End through a graceful drain: admission closes and every accepted
-    # request completes and is COUNTED before the SLA line is emitted —
-    # a hard stop here used to drop tail requests from the percentiles.
+    # request completes — preempted-and-requeued sequences included —
+    # and is COUNTED before the SLA line is emitted; a hard stop here
+    # used to drop tail requests from the percentiles.
     finished += len(engine.drain())
-    assert finished == n, f"drained {finished} of {n} requests"
+    assert finished + shed_at_submit == n, (
+        f"drained {finished} + {shed_at_submit} shed-at-submit "
+        f"of {n} requests")
     if engine.paged:
         # Leak audit: every page back on the free list, no stranded
         # commitment — speculation's accept-rewind included (the CI
@@ -300,6 +397,8 @@ def main() -> int:
     stats["requests"] = n
     stats["arrival_rate_req_s"] = args.rate
     stats["max_batch"] = args.max_batch
+    stats["scenario"] = args.scenario
+    stats["shed_at_submit"] = shed_at_submit
     if args.flight_dump:
         engine.dump_flight(args.flight_dump, reason="serve_bench")
         print(f"[serve_bench] flight record: {args.flight_dump}",

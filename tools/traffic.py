@@ -1,0 +1,302 @@
+"""Traffic-scenario library for the serving bench (tools/serve_bench.py).
+
+Production traffic is not one Poisson knob: it is tiered (interactive
+vs batch SLOs), multi-tenant, bursty on several timescales, and
+heavy-tailed in both prompt and completion length. This module factors
+``serve_bench``'s load generator into SEEDED scenario builders so the
+same realistic shapes drive benchmarks, the CI overload drill, and the
+chaos-composition tests — deterministically: every scenario is a pure
+function of ``(seed, params)``, uses one ``np.random.RandomState``, and
+never reads a clock, so two runs of a drill submit byte-identical work
+(the property the bench's ``--virtual-dt`` drive turns into zero-drift
+scheduling counters).
+
+A scenario is a list of :class:`TrafficRequest` sorted by arrival time;
+``serve_bench --scenario NAME`` drives the engine with it. Chaos
+compositions (a burst landing mid-hot-swap, a preemption storm during
+speculation) are scenario × engine-flag products: pick the arrival
+shape here and add ``--swap-at-request`` / ``--spec-k`` on the bench.
+
+Every prompt/completion pair is clamped to the engine budget the caller
+passes (``prompt + max_new <= budget``), so a generated request can
+never die with a CacheBudgetError mid-measurement.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficRequest:
+    """One scheduled arrival: WHEN, WHAT, and on WHOSE behalf."""
+
+    arrival_s: float          # seconds from the start of the run
+    prompt: np.ndarray        # int32 [T]
+    max_new_tokens: int
+    priority: int = 0         # SLO tier, 0 = highest
+    tenant: str = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioParams:
+    """Shared knobs every scenario builder receives (from the bench
+    CLI): request count, mean arrival rate, prompt-length scale and the
+    admissibility clamps."""
+
+    requests: int
+    rate: float               # mean arrival rate, req/s
+    mean_prompt_len: int
+    max_prompt_len: int       # so prompt + max_new fits the budget
+    max_new_tokens: int
+    vocab_size: int
+    budget: int               # per-slot token budget (prompt + output)
+
+
+def _clamp(p: ScenarioParams, prompt_len: int,
+           max_new: int) -> tuple[int, int]:
+    """Admissibility: 1 <= prompt <= max_prompt and
+    prompt + max_new <= budget (with max_new >= 1)."""
+    plen = int(min(max(prompt_len, 1), p.max_prompt_len))
+    mnt = int(min(max(max_new, 1), p.budget - plen))
+    return plen, max(mnt, 1)
+
+
+def _req(rng: np.random.RandomState, p: ScenarioParams, t: float,
+         prompt_len: int, max_new: int, priority: int = 0,
+         tenant: str = "default") -> TrafficRequest:
+    plen, mnt = _clamp(p, prompt_len, max_new)
+    return TrafficRequest(
+        arrival_s=float(t),
+        prompt=rng.randint(0, p.vocab_size, size=plen).astype(np.int32),
+        max_new_tokens=mnt, priority=int(priority), tenant=tenant)
+
+
+def _uniform_len(rng: np.random.RandomState, p: ScenarioParams) -> int:
+    """The classic serve_bench prompt-length draw: uniform in
+    [1, 2*mean-1], clamped to the admissible maximum."""
+    hi = min(2 * p.mean_prompt_len, p.max_prompt_len + 1)
+    return int(rng.randint(1, max(hi, 2)))
+
+
+# -- scenario builders -------------------------------------------------------
+def _poisson(rng: np.random.RandomState,
+             p: ScenarioParams) -> list[TrafficRequest]:
+    """The original serve_bench workload: memoryless arrivals at
+    ``rate``, uniform prompt lengths, one tier, one tenant."""
+    t = np.cumsum(rng.exponential(1.0 / p.rate, size=p.requests))
+    return [_req(rng, p, t[i], _uniform_len(rng, p), p.max_new_tokens)
+            for i in range(p.requests)]
+
+
+def _bursty(rng: np.random.RandomState,
+            p: ScenarioParams) -> list[TrafficRequest]:
+    """Cluster (Neyman-Scott-style) arrivals: Poisson burst CENTERS at
+    ``rate / mean_burst`` with ~``mean_burst`` requests packed at 10x
+    the mean rate inside each burst — the same long-run rate as
+    ``poisson`` but with queue-depth spikes that exercise shed/preempt
+    paths a smooth process never reaches."""
+    mean_burst = 6
+    out: list[TrafficRequest] = []
+    t = 0.0
+    while len(out) < p.requests:
+        t += float(rng.exponential(mean_burst / p.rate))
+        size = min(1 + int(rng.poisson(mean_burst - 1)),
+                   p.requests - len(out))
+        dt = np.cumsum(rng.exponential(1.0 / (10.0 * p.rate), size=size))
+        for i in range(size):
+            out.append(_req(rng, p, t + dt[i], _uniform_len(rng, p),
+                            p.max_new_tokens))
+    return out
+
+
+def _diurnal(rng: np.random.RandomState,
+             p: ScenarioParams) -> list[TrafficRequest]:
+    """Sinusoidally modulated arrivals (a compressed day): candidates
+    drawn at the 2x peak rate and thinned by the instantaneous
+    intensity ``(1 + sin) / 2`` — peak-hour load at twice the mean with
+    near-idle troughs, in one deterministic pass."""
+    period_s = max(p.requests / p.rate / 2.0, 1e-3)  # ~2 cycles per run
+    out: list[TrafficRequest] = []
+    t = 0.0
+    while len(out) < p.requests:
+        t += float(rng.exponential(1.0 / (2.0 * p.rate)))
+        intensity = 0.5 * (1.0 + np.sin(2.0 * np.pi * t / period_s))
+        if rng.uniform() < intensity:
+            out.append(_req(rng, p, t, _uniform_len(rng, p),
+                            p.max_new_tokens))
+        else:
+            # Burn the length draws anyway so accepted requests' content
+            # does not depend on how many candidates were thinned before
+            # them (keeps prompt streams stable across small param
+            # tweaks).
+            rng.randint(1, 2)
+    return out
+
+
+def _heavy_tail(rng: np.random.RandomState,
+                p: ScenarioParams) -> list[TrafficRequest]:
+    """Poisson arrivals with production-shaped SIZES: lognormal prompt
+    lengths (median ``mean_prompt_len``, sigma 0.8 — a few huge
+    contexts among many small ones) and Zipf completion budgets (most
+    requests stop early, a heavy tail runs to the cap). Exercises the
+    page pool's commitment math far harder than uniform sizes."""
+    t = np.cumsum(rng.exponential(1.0 / p.rate, size=p.requests))
+    out = []
+    for i in range(p.requests):
+        plen = int(np.exp(rng.normal(np.log(max(p.mean_prompt_len, 1)),
+                                     0.8)))
+        mnt = int(rng.zipf(1.8))
+        out.append(_req(rng, p, t[i], plen,
+                        min(mnt, p.max_new_tokens) if mnt > 0
+                        else p.max_new_tokens))
+    return out
+
+
+_TENANTS = (
+    # (tenant, tier, weight-share of the arrival mass, prompt scale)
+    ("gold", 0, 0.3, 1.0),
+    ("silver", 1, 0.3, 1.0),
+    ("batch", 2, 0.4, 2.0),
+)
+
+
+def _multi_tenant(rng: np.random.RandomState,
+                  p: ScenarioParams) -> list[TrafficRequest]:
+    """Three tenants on three SLO tiers: interactive ``gold`` (tier 0),
+    standard ``silver`` (tier 1), and a long-prompt ``batch`` tenant on
+    the best-effort tier submitting the largest share — the workload
+    weighted-fair admission and per-tenant quotas are judged on."""
+    out: list[TrafficRequest] = []
+    for tenant, tier, share, scale in _TENANTS:
+        n = max(int(round(p.requests * share)), 1)
+        t = np.cumsum(rng.exponential(1.0 / (p.rate * share), size=n))
+        for i in range(n):
+            out.append(_req(
+                rng, p, t[i],
+                int(_uniform_len(rng, p) * scale),
+                p.max_new_tokens, priority=tier, tenant=tenant))
+    out.sort(key=lambda r: (r.arrival_s, r.tenant))
+    return out[:p.requests]
+
+
+def _two_tier_burst(rng: np.random.RandomState,
+                    p: ScenarioParams) -> list[TrafficRequest]:
+    """The CI overload drill: a steady tier-0 interactive stream
+    (``prod``, short prompts, 40% of the mass) while a best-effort
+    ``batch`` tenant slams the remaining 60% in four dense bursts of
+    long prompts. Driven at ~2x the sustainable rate, the engine MUST
+    degrade selectively: tier 0 p99 TTFT holds while batch work is
+    preempted/shed — never the other way around."""
+    n_prod = max(int(round(p.requests * 0.4)), 1)
+    n_batch = p.requests - n_prod
+    out: list[TrafficRequest] = []
+    t = np.cumsum(rng.exponential(1.0 / (0.4 * p.rate), size=n_prod))
+    for i in range(n_prod):
+        out.append(_req(rng, p, t[i],
+                        max(p.mean_prompt_len // 2, 1),
+                        p.max_new_tokens, priority=0, tenant="prod"))
+    horizon = float(t[-1]) if n_prod else p.requests / p.rate
+    n_bursts = 4
+    for b in range(n_bursts):
+        t0 = horizon * (b + 0.5) / n_bursts
+        size = n_batch // n_bursts + (1 if b < n_batch % n_bursts else 0)
+        dt = np.cumsum(rng.exponential(1.0 / (10.0 * p.rate), size=size))
+        for i in range(size):
+            out.append(_req(rng, p, t0 + dt[i],
+                            2 * p.mean_prompt_len, p.max_new_tokens,
+                            priority=1, tenant="batch"))
+    out.sort(key=lambda r: (r.arrival_s, r.tenant))
+    return out
+
+
+def _preempt_storm(rng: np.random.RandomState,
+                   p: ScenarioParams) -> list[TrafficRequest]:
+    """Engineered preemption pressure: long best-effort requests land
+    FIRST and occupy every slot/page, then high-tier waves keep
+    arriving for the rest of the run — each wave must evict (and later
+    resume) best-effort work. The chaos-composition drill runs this
+    under speculation with a mid-run hot-swap."""
+    out: list[TrafficRequest] = []
+    n_low = max(p.requests // 3, 1)
+    n_high = p.requests - n_low
+    t = np.cumsum(rng.exponential(1.0 / p.rate, size=n_low))
+    for i in range(n_low):
+        out.append(_req(rng, p, t[i], 2 * p.mean_prompt_len,
+                        p.max_new_tokens, priority=1, tenant="batch"))
+    horizon = float(t[-1]) * 2.0 if n_low else p.requests / p.rate
+    tw = np.sort(rng.uniform(horizon * 0.1, horizon, size=n_high))
+    for i in range(n_high):
+        out.append(_req(rng, p, tw[i],
+                        max(p.mean_prompt_len // 2, 1),
+                        max(p.max_new_tokens // 2, 1),
+                        priority=0, tenant="prod"))
+    out.sort(key=lambda r: (r.arrival_s, r.tenant))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Scenario:
+    """Registry entry: the builder plus the tier/fairness defaults the
+    bench applies when the CLI does not override them."""
+
+    build: object             # (rng, ScenarioParams) -> list[TrafficRequest]
+    num_tiers: int
+    tenant_weights: dict | None
+    help: str
+
+
+SCENARIOS: dict[str, Scenario] = {
+    "poisson": Scenario(_poisson, 1, None,
+                        "memoryless arrivals, uniform lengths (the "
+                        "classic serve_bench workload)"),
+    "bursty": Scenario(_bursty, 1, None,
+                       "Poisson burst clusters at 10x rate inside "
+                       "bursts (queue-depth spikes)"),
+    "diurnal": Scenario(_diurnal, 1, None,
+                        "sinusoidal rate (compressed day): 2x peaks, "
+                        "near-idle troughs"),
+    "heavy_tail": Scenario(_heavy_tail, 1, None,
+                           "lognormal prompts + Zipf completions "
+                           "(page-commitment stress)"),
+    "multi_tenant": Scenario(_multi_tenant, 3,
+                             {"gold": 3.0, "silver": 2.0, "batch": 1.0},
+                             "gold/silver/batch tenants on 3 SLO tiers "
+                             "(weighted-fair admission workload)"),
+    "two_tier_burst": Scenario(_two_tier_burst, 2, None,
+                               "steady tier-0 stream + best-effort "
+                               "burst floods (the CI overload drill)"),
+    "preempt_storm": Scenario(_preempt_storm, 2, None,
+                              "slots filled with best-effort work, "
+                              "then high-tier waves force repeated "
+                              "lossless preemptions"),
+}
+
+
+def make_scenario(name: str, *, seed: int, requests: int, rate: float,
+                  mean_prompt_len: int, max_prompt_len: int,
+                  max_new_tokens: int, vocab_size: int,
+                  budget: int) -> list[TrafficRequest]:
+    """Build scenario ``name`` deterministically from ``seed``; returns
+    arrivals sorted by time (ties broken by tenant so the submission
+    order itself is deterministic)."""
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r} (have: "
+            f"{', '.join(sorted(SCENARIOS))})")
+    if requests < 1:
+        raise ValueError(f"requests must be >= 1, got {requests}")
+    if rate <= 0:
+        raise ValueError(f"rate must be > 0, got {rate}")
+    params = ScenarioParams(
+        requests=int(requests), rate=float(rate),
+        mean_prompt_len=int(mean_prompt_len),
+        max_prompt_len=int(max_prompt_len),
+        max_new_tokens=int(max_new_tokens), vocab_size=int(vocab_size),
+        budget=int(budget))
+    rng = np.random.RandomState(seed)
+    out = SCENARIOS[name].build(rng, params)
+    out.sort(key=lambda r: (r.arrival_s, r.tenant, r.priority))
+    return out
