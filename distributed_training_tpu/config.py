@@ -561,6 +561,25 @@ class ServeConfig:
     # identical to an uninterrupted run (pinned by
     # tests/test_preemption.py). False = tiers only order the queue.
     preempt: bool = True
+    # Crash-durable serving (serving/journal.py; docs/RESILIENCE.md
+    # "Crash-durable serving"). journal_dir enables the write-ahead
+    # request journal: admissions are durably recorded before submit()
+    # returns, emitted-token batches/preemptions/finishes ride a
+    # background writer thread, and Engine.recover() replays the log on
+    # restart — finished requests re-deliver exactly once (client
+    # cursor), unfinished ones re-seat through the preemption resume
+    # path and complete BITWISE equal to an uninterrupted run. None =
+    # off (no thread, no I/O).
+    journal_dir: str | None = None
+    # fsync policy: "none" (OS page cache only — survives kill -9, not
+    # power loss), "batch" (one fsync per writer flush — the default
+    # durability/latency trade), "always" (fsync per record).
+    journal_fsync: str = "batch"
+    # Segment rotation threshold: past this many bytes the journal
+    # compacts its live state into a fresh segment and deletes the old
+    # ones, so the on-disk footprint tracks in-flight work, not run
+    # history.
+    journal_segment_bytes: int = 1 << 20
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -635,6 +654,15 @@ class ServeConfig:
             raise ValueError(
                 f"tier_reserved_pages must be >= 0, "
                 f"got {self.tier_reserved_pages}")
+        if self.journal_fsync not in ("none", "batch", "always"):
+            raise ValueError(
+                f"journal_fsync must be 'none', 'batch' or 'always', "
+                f"got {self.journal_fsync!r}")
+        if self.journal_segment_bytes < 4096:
+            raise ValueError(
+                f"journal_segment_bytes must be >= 4096 (a segment "
+                f"must hold more than one compaction header), got "
+                f"{self.journal_segment_bytes}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -105,6 +105,22 @@ def corrupt_committed_checkpoint(path: str, flip_bytes: int = 64) -> str:
     return victim
 
 
+def hard_kill(flush=None) -> None:
+    """Serving crash drill: die like the hardware would — SIGKILL,
+    no grace window, no cleanup. ``flush`` (typically a request
+    journal's ``persist``) runs first so the DURABLE state at death is
+    exactly the records enqueued so far, independent of the writer
+    thread's timing — which is what makes the drill's recovery
+    counters bitwise-reproducible across runs. ``serve_bench
+    --kill-at-request N`` routes through here; the restarted process
+    must replay the journal and complete every accepted request
+    bitwise-equal to the uninterrupted oracle (tests/test_journal.py,
+    the CI crash-recovery drill)."""
+    if flush is not None:
+        flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
 class ChaosMonkey:
     """One run's fault injector, driven by the trainers' step loop.
 

@@ -18,6 +18,10 @@ library raised five frames down. The hierarchy is deliberately shallow:
   (verification, staging read, tree validation, apply/rollback). The
   serving engine keeps the old weights; the error records where the
   candidate died.
+- :class:`JournalCorruptError` — the serving request journal cannot be
+  used as-is (written under a different RNG/sampling fingerprint, or
+  appended to before recovery read its prior state). Torn tails are
+  NOT errors — ``serving/journal.py`` truncates and quarantines them.
 """
 
 from __future__ import annotations
@@ -48,6 +52,24 @@ class DrainingError(RuntimeError):
 class QueueFullError(RuntimeError):
     """The bounded request queue is full; the submit was shed instead of
     growing the queue (and its tail latency) without bound."""
+
+
+class JournalCorruptError(RuntimeError):
+    """The serving request journal refused an operation that would
+    break its durability contract. Torn record tails never raise this
+    (they are truncated and quarantined, like torn checkpoints); it is
+    reserved for structural misuse: replaying a journal written under a
+    different RNG/sampling ``fingerprint`` (the journaled token streams
+    would not reproduce) or appending before :meth:`RequestJournal.
+    recover` read the prior state (the next compaction would silently
+    drop it). Carries ``path`` and a machine-readable ``reason`` slug
+    (``"fingerprint"`` / ``"unrecovered"`` / ``"crashed"``)."""
+
+    def __init__(self, message: str, *, path: str = "",
+                 reason: str = "corrupt"):
+        super().__init__(message)
+        self.path = path
+        self.reason = reason
 
 
 class SwapError(RuntimeError):

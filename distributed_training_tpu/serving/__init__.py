@@ -34,6 +34,14 @@ restated for XLA's static-shape world:
   (reserved-vs-written cache positions, queue-wait vs prefill breakdown,
   admission-blocked time) — live-scrapeable via ``--metrics-port``
   (``observability/exporter.py``).
+- :mod:`journal` — crash-durable serving: an append-only, crc-framed
+  write-ahead request journal (admissions durable at submit; token/
+  preempt/finish records persisted off the hot loop by a writer
+  thread; segment rotation compacts finished work; torn tails are
+  truncated and quarantined, never a crash). ``Engine.recover()``
+  replays it on restart: finished results re-deliver exactly once via
+  a client cursor, unfinished requests re-seat through the preemption
+  resume path and complete bitwise identical to an uninterrupted run.
 - :mod:`hotswap` — zero-drain live weight hot-swap: a watcher streams
   newly COMMITTED checkpoints through the resilience verification path
   into the running engine at a decode-iteration boundary (in-flight
@@ -50,10 +58,16 @@ with hot-swap and speculation chaos drills). See docs/SERVING.md.
 
 from distributed_training_tpu.resilience.errors import (  # noqa: F401
     DrainingError,
+    JournalCorruptError,
     QueueFullError,
     SwapError,
 )
 from distributed_training_tpu.serving.engine import Engine  # noqa: F401
+from distributed_training_tpu.serving.journal import (  # noqa: F401
+    JournaledRequest,
+    RecoveredState,
+    RequestJournal,
+)
 from distributed_training_tpu.serving.hotswap import (  # noqa: F401
     HotSwapper,
     committed_epochs,

@@ -87,6 +87,7 @@ from distributed_training_tpu.inference.sampler import (
 from distributed_training_tpu.models.gpt import init_decode_cache
 from distributed_training_tpu.parallel.ring_attention import PagedKV
 from distributed_training_tpu.resilience.errors import SwapError
+from distributed_training_tpu.serving.journal import RequestJournal, perf_of
 from distributed_training_tpu.serving.metrics import ServeTelemetry
 from distributed_training_tpu.serving.pages import PagePool, pages_for
 from distributed_training_tpu.serving.queue import RequestQueue
@@ -238,6 +239,40 @@ class Engine:
         # pass left work queued that could not seat (head-of-line
         # blocked on slots/pages even after any preemption).
         self._overloaded = False
+        # Crash-durable serving (serving/journal.py): the write-ahead
+        # request journal. Admissions persist synchronously on the
+        # producer thread; token/preempt/finish records are enqueued at
+        # the iteration tail and persisted by the journal's writer
+        # thread — the decode loop never touches the filesystem (pinned
+        # by the graftlint hot-path rule). Callers with a journal MUST
+        # run recover() before serving: it replays the log, re-delivers
+        # finished-but-unacked results exactly once, and re-seats
+        # unfinished requests through the preemption resume path.
+        self.journal: RequestJournal | None = None
+        if cfg.journal_dir:
+            self.journal = RequestJournal(
+                cfg.journal_dir, fsync=cfg.journal_fsync,
+                segment_bytes=cfg.journal_segment_bytes,
+                # The RNG/sampling fingerprint: replaying this journal
+                # into an engine where any of these differ would not
+                # reproduce the journaled token streams — recovery
+                # refuses with a typed error instead of silently
+                # diverging. (Paging/speculation/batch knobs are
+                # deliberately absent: outputs are bitwise independent
+                # of them by the lane-independence invariant.)
+                fingerprint={
+                    "seed": cfg.seed, "temperature": cfg.temperature,
+                    "top_k": cfg.top_k, "top_p": cfg.top_p,
+                    "eos_id": cfg.eos_id, "pad_id": cfg.pad_id,
+                    # Weights identity: recovery into an engine serving
+                    # different weights than the journal's tail would
+                    # recompute "lost" tokens under the wrong model —
+                    # every hot-swap barrier journals the new epoch
+                    # (update_fingerprint below), and recover()
+                    # validates against the LAST journaled value.
+                    "weights_epoch": int(weights_epoch)})
+        self._recovering = False
+        self.recovery_report: dict[str, Any] | None = None
         self.telemetry = ServeTelemetry(cfg.ring_size,
                                         num_tiers=cfg.num_tiers)
         self._base_rng = jax.random.PRNGKey(cfg.seed)
@@ -512,10 +547,23 @@ class Engine:
         (0 = highest, < ``cfg.num_tiers``), ``tenant`` its fairness
         principal. Raises :class:`~distributed_training_tpu.inference.
         sampler.CacheBudgetError` when it can never fit a slot's page
-        table (or the legacy contiguous budget)."""
-        return self.queue.submit(prompt, max_new_tokens=max_new_tokens,
-                                 arrival_t=arrival_t, priority=priority,
-                                 tenant=tenant)
+        table (or the legacy contiguous budget). With a journal, the
+        admission record is durable before this returns — a request the
+        journal never saw was never accepted."""
+        req = self.queue.submit(prompt, max_new_tokens=max_new_tokens,
+                                arrival_t=arrival_t, priority=priority,
+                                tenant=tenant)
+        if self.journal is not None:
+            try:
+                self.journal.log_admit(req)
+            except BaseException:
+                # Acceptance is journal-backed: if the durable record
+                # failed, withdraw the queued request before the caller
+                # sees the error — otherwise it would decode anyway and
+                # the caller's retry would duplicate it.
+                self.queue.withdraw(req)
+                raise
+        return req
 
     @property
     def idle(self) -> bool:
@@ -621,6 +669,13 @@ class Engine:
                 jax.random.fold_in(self._base_rng, seq.request.uid))
 
         def on_preempt(seq: ActiveSequence) -> None:
+            if self.journal is not None:
+                # Tokens synced first, then the preempt mark: the
+                # requeued prefix is reconstructible from the journal
+                # alone, and a deadline miss after a crash still
+                # attributes as preempted_timeout. Enqueue-only — the
+                # writer thread persists off the hot loop.
+                self.journal.note_preempt(seq)
             # Recompute debt: cache positions the eviction frees and the
             # re-seat must prefill again (the whole preemption cost —
             # the tokens themselves are never lost). Branch on the
@@ -908,6 +963,12 @@ class Engine:
             self._install_params(params)
             # graftlint: disable=hot-path-transfer -- epoch is a staged host int, not a device value
             self.weights_epoch = int(epoch)
+        if self.journal is not None:
+            # The journal's weights-identity tail marker: recovery must
+            # be able to see which epoch produced the records after
+            # this barrier (enqueue-only; the writer thread persists).
+            self.journal.update_fingerprint(
+                weights_epoch=self.weights_epoch)
         if self.drafter is not None:
             # No stale-drafter window: a self-drafting (mirror) drafter
             # re-points its params snapshot at the freshly installed
@@ -1215,11 +1276,22 @@ class Engine:
     def _finish_iteration(self, it: int, had_work: bool,
                           finished: list[FinishedRequest]
                           ) -> list[FinishedRequest]:
-        """Shared iteration tail: page reclamation, telemetry, traces."""
+        """Shared iteration tail: page reclamation, journal, telemetry,
+        traces."""
         if self.paged:
             for fin in finished:
                 if fin.slot is not None:
                     self._free_slot_pages(fin.slot)
+        if self.journal is not None:
+            # Durability sweep, enqueue-only (the journal's writer
+            # thread owns the disk): each active slot's newly emitted
+            # tokens, and every completion's authoritative finish
+            # record. Tokens landed but not yet durable at a kill -9
+            # are recomputed bitwise by the recovery resume path.
+            for seq in self.scheduler.active():
+                self.journal.note_tokens(seq)
+            for fin in finished:
+                self.journal.note_finish(fin)
         if had_work:
             self.telemetry.on_iteration(
                 it, queue_depth=len(self.queue),
@@ -1290,6 +1362,117 @@ class Engine:
         self._drained = self.idle
         return out
 
+    def recover(self) -> dict[str, Any]:
+        """Replay the write-ahead journal BEFORE serving (crash-durable
+        serving, docs/RESILIENCE.md): call once, right after
+        construction and before the first submit/step.
+
+        Three recovery classes, every one exactly-once and — for
+        anything that decodes further — bitwise identical to the
+        uninterrupted run:
+
+        - **finished but unacked** results re-deliver from the journal
+          verbatim (``report["redelivered"]``; the consumer acks them
+          via ``journal.ack`` once durably taken, after which they stop
+          being redelivered — the client cursor);
+        - **unfinished** requests re-seat through the round-16
+          preemption resume path in original arrival (uid) order: the
+          re-prefill rebuilds prompt + emitted-minus-last and the
+          continuation samples the same ``fold_in(rng, position)``
+          stream, so tokens past the journal's last durable flush are
+          *recomputed*, not lost (``tokens_recomputed_on_recovery`` is
+          that debt, in cache positions). Downtime is billed to the
+          request's ``swap_pause_s`` (recovery cost, not decode TPOT);
+        - requests whose **deadline expired while the engine was dead**
+          (or whose journaled stream already met EOS/budget) complete
+          at replay — ``timeout``, or ``preempted_timeout`` when the
+          journal shows a preemption — instead of resurrecting
+          (``report["completed_at_replay"]``).
+
+        Returns the report dict; also stored as ``recovery_report``.
+        A journal-less engine returns an empty report. The /healthz
+        phase reads ``recovering`` while this runs.
+        """
+        report: dict[str, Any] = {
+            "redelivered": [], "completed_at_replay": [],
+            "resumed": 0, "notes": {}, "torn_bytes": 0}
+        self.recovery_report = report
+        if self.journal is None:
+            return report
+        self._recovering = True
+        try:
+            state = self.journal.recover()
+            report["notes"] = dict(state.notes)
+            report["torn_bytes"] = int(state.torn_bytes)
+            self.queue.reserve_uids(state.max_uid + 1)
+            now = time.perf_counter()
+            recovered = 0
+            recompute = 0
+            for uid in sorted(state.requests):
+                rr = state.requests[uid]
+                recovered += 1
+                prompt = np.asarray(rr.prompt, np.int32)
+                if rr.finished:
+                    report["redelivered"].append(FinishedRequest(
+                        uid=uid, prompt=prompt,
+                        tokens=np.asarray(rr.finish_tokens or [],
+                                          np.int32),
+                        finish_reason=rr.finish_reason,
+                        ttft_ms=rr.ttft_ms, tpot_ms=rr.tpot_ms,
+                        arrival_t=perf_of(rr.arrival_wall),
+                        first_token_t=None, priority=rr.priority,
+                        tenant=rr.tenant))
+                    continue
+                arrival_t = perf_of(rr.arrival_wall)
+                req = Request(
+                    uid=uid, prompt=prompt,
+                    max_new_tokens=rr.max_new_tokens,
+                    arrival_t=arrival_t,
+                    ttft_deadline_t=(
+                        arrival_t + rr.ttft_rel_s
+                        if rr.ttft_rel_s is not None else None),
+                    deadline_t=(
+                        arrival_t + rr.deadline_rel_s
+                        if rr.deadline_rel_s is not None else None),
+                    priority=rr.priority, tenant=rr.tenant)
+                seq = ActiveSequence.from_journal(
+                    req, rr.tokens, preempts=rr.preempts,
+                    first_token_t=(perf_of(rr.first_wall)
+                                   if rr.first_wall is not None
+                                   else None),
+                    last_token_t=(perf_of(rr.last_wall)
+                                  if rr.last_wall is not None
+                                  else None))
+                reason = seq.finish_reason(self.sample_cfg.eos_id, now)
+                if reason is not None:
+                    # The journaled stream already completed (a crash
+                    # between the last emit and the finish record's
+                    # flush), or a deadline ran down during the
+                    # downtime: complete at replay, never resurrect.
+                    fin = FinishedRequest.from_active(seq, reason,
+                                                      slot=None)
+                    self.journal.note_finish(fin)
+                    self.telemetry.on_finished(fin)
+                    report["completed_at_replay"].append(fin)
+                    continue
+                if seq.last_token_t is not None:
+                    # Downtime billed like a swap barrier: recovery
+                    # cost, attributed explicitly — not smeared into
+                    # the request's decode TPOT.
+                    seq.swap_pause_s += max(now - seq.last_token_t, 0.0)
+                if seq.tokens:
+                    recompute += prompt.size + len(seq.tokens) - 1
+                # A resumption (tokens, or a journaled preemption whose
+                # attribution must survive) restores as the sequence;
+                # an untouched admission restores as the bare request.
+                self.queue.restore(
+                    seq if (seq.tokens or seq.preempts) else req)
+                report["resumed"] += 1
+            self.telemetry.on_recovered(recovered, recompute)
+        finally:
+            self._recovering = False
+        return report
+
     @property
     def draining(self) -> bool:
         """True once admission has been closed (drain started)."""
@@ -1306,7 +1489,12 @@ class Engine:
         queued that could not seat even after preemption — selective
         degradation (tier-aware shed/preempt) is active, and a load
         balancer should prefer another replica for best-effort traffic.
+        ``recovering`` = the write-ahead journal is being replayed
+        before the port opens (crash restart) — a load balancer must
+        not route new traffic yet.
         """
+        if self._recovering:
+            return "recovering"
         if self._drained:
             return "drained"
         if self.queue.closed:
@@ -1332,6 +1520,15 @@ class Engine:
             "requests_preempted": self.telemetry.requests_preempted,
             "requests_shed": self.queue.shed,
             "queue_depth": len(self.queue),
+            # Crash-durable serving (serving/journal.py): the recovery
+            # drill reads the replay evidence and the journal's write
+            # counters straight off /healthz.
+            "requests_recovered": self.telemetry.requests_recovered,
+            "journal_records_written": (
+                self.journal.records_written
+                if self.journal is not None else 0),
+            "journal_fsyncs": (self.journal.fsyncs
+                               if self.journal is not None else 0),
         }
 
     def compiled_programs(self) -> dict[str, int | None]:
@@ -1381,6 +1578,14 @@ class Engine:
             stats[f"tier{t}_requests_shed"] = int(n)
         stats["requests_drain_rejected"] = self.queue.drain_rejected
         stats["drained"] = bool(self._drained)
+        # Crash-durable serving (serving/journal.py): the journal's
+        # durability counters ride the SLA surface (requests_recovered
+        # and tokens_recomputed_on_recovery come from the telemetry).
+        stats["journal_records_written"] = (
+            self.journal.records_written
+            if self.journal is not None else 0)
+        stats["journal_fsyncs"] = (
+            self.journal.fsyncs if self.journal is not None else 0)
         # Live weight hot-swap: the deployed epoch joins the telemetry's
         # swaps_completed/swaps_rejected/swap_blocked_s counters.
         stats["weights_epoch"] = int(self.weights_epoch)
@@ -1389,9 +1594,14 @@ class Engine:
     def reset_stats(self) -> None:
         """Fresh telemetry window (e.g. after a compile warm-up pass);
         compiled programs, slot state, and page allocations are
-        untouched."""
+        untouched. The crash-recovery counters carry across: recovery
+        happened once per process, and a warm-up reset must not erase
+        the evidence the recovery drill gates on."""
+        old = self.telemetry
         self.telemetry = ServeTelemetry(self.cfg.ring_size,
                                         num_tiers=self.cfg.num_tiers)
+        self.telemetry.on_recovered(old.requests_recovered,
+                                    old.tokens_recomputed_on_recovery)
         self.queue.reset_counters()
         self._iteration = 0
 

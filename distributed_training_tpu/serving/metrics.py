@@ -104,6 +104,16 @@ class ServeTelemetry:
         # drill holds them zero-drift.
         self.requests_preempted = 0
         self.preempted_token_recompute = 0
+        # Crash-recovery accounting (serving/journal.py): requests
+        # reconstructed from the write-ahead journal at restart
+        # (redelivered finished + re-seated unfinished + expired at
+        # replay) and the recompute debt the re-seats carry — the cache
+        # positions recovery must re-prefill, same token units as
+        # preempted_token_recompute. Both are pure functions of the
+        # journal's durable state, so the CI crash drill holds them
+        # bitwise-equal across runs (and zero-drift on no-crash rows).
+        self.requests_recovered = 0
+        self.tokens_recomputed_on_recovery = 0
         # Admission-latency breakdown: queueing vs prefill compute.
         self.queue_wait_ms: list[float] = []
         self.prefill_ms: list[float] = []
@@ -270,6 +280,15 @@ class ServeTelemetry:
         t = min(max(int(tier), 0), self.num_tiers - 1)
         self.tier_preempted[t] += 1
 
+    def on_recovered(self, requests: int, recompute_tokens: int) -> None:
+        """Journal replay landed: ``requests`` were reconstructed from
+        the write-ahead log and their re-seats owe ``recompute_tokens``
+        cache positions of re-prefill. The engine re-applies these
+        across ``reset_stats`` — recovery happened once per process,
+        and a warm-up window reset must not erase the evidence."""
+        self.requests_recovered += int(requests)
+        self.tokens_recomputed_on_recovery += int(recompute_tokens)
+
     def on_finished(self, fin: FinishedRequest) -> None:
         self.requests_finished += 1
         self.finish_reasons[fin.finish_reason] = \
@@ -358,6 +377,12 @@ class ServeTelemetry:
             "requests_preempted": int(self.requests_preempted),
             "preempted_token_recompute":
                 int(self.preempted_token_recompute),
+            # Crash-recovery economics (serving/journal.py): always
+            # present (0 without a journal) so the bench gate can hold
+            # the no-crash rows at zero drift.
+            "requests_recovered": int(self.requests_recovered),
+            "tokens_recomputed_on_recovery":
+                int(self.tokens_recomputed_on_recovery),
             "tokens_emitted": self.tokens_emitted,
             "busy_seconds": busy_s,
             # Utilization accounting (see module docstring): the

@@ -353,6 +353,50 @@ class RequestQueue:
                 self._tenant_service[req.tenant] -= cost
             self.depth_max = max(self.depth_max, self._depth())
 
+    def restore(self, entry) -> None:
+        """Re-admit a journal-recovered entry (crash-restart path,
+        serving/journal.py) with its ORIGINAL uid — the RNG stream is
+        ``fold_in(seed, uid)``, so uid continuity is what makes the
+        recovered output bitwise. Bypasses every admission guard (the
+        request was accepted once; dropping it now would break the
+        recovery contract) exactly like :meth:`requeue` does for
+        preemptions. Callers restore in uid order, so FIFO-within-tier
+        is preserved by construction."""
+        req = _request_of(entry)
+        if not 0 <= req.priority < self.num_tiers:
+            raise ValueError(
+                f"recovered request uid={req.uid} carries tier "
+                f"{req.priority}, but this engine serves only "
+                f"{self.num_tiers} tier(s) — restart with the journal "
+                f"writer's num_tiers")
+        with self._lock:
+            self._tiers[req.priority].append(entry)
+            self._next_uid = max(self._next_uid, req.uid + 1)
+            self.depth_max = max(self.depth_max, self._depth())
+
+    def withdraw(self, req: Request) -> bool:
+        """Remove a just-submitted request whose DURABLE admission
+        failed (the journal's sync write raised): the engine's
+        acceptance contract is journal-backed, so a request the journal
+        never recorded must not stay queued while its submitter sees an
+        exception — it would decode anyway and duplicate the retry.
+        No fairness charge (it was never seated); True if removed."""
+        with self._lock:
+            tier = self._tiers[req.priority]
+            for entry in tier:
+                if _request_of(entry).uid == req.uid:
+                    tier.remove(entry)
+                    return True
+        return False
+
+    def reserve_uids(self, next_uid: int) -> None:
+        """Advance the uid sequence past everything the journal ever
+        assigned (dropped/compacted entries included): a fresh submit
+        must never reuse a journaled uid, or two different requests
+        would share one RNG stream and one delivery cursor."""
+        with self._lock:
+            self._next_uid = max(self._next_uid, int(next_uid))
+
     def take_shed(self) -> list:
         """Drain the tier-aware shed victims (entries dropped from the
         queue to admit higher-tier work); the engine completes each with

@@ -125,6 +125,31 @@ class ActiveSequence:
         return (self.prefill_pos < self.prefill_tokens.size
                 or not self.tokens)
 
+    @staticmethod
+    def from_journal(req: Request, tokens, *, preempts: int = 0,
+                     first_token_t: float | None = None,
+                     last_token_t: float | None = None
+                     ) -> "ActiveSequence":
+        """Reconstruct a crash-interrupted sequence from its journaled
+        state (serving/journal.py) as a queued resumption — the SAME
+        shape :meth:`prepare_resume` leaves behind, so the re-seat path
+        (re-prefill prompt + emitted-minus-last, continue the
+        ``fold_in(rng, position)`` stream) needs no recovery-specific
+        branch and the continued output is bitwise identical to the
+        uninterrupted run. Tokens emitted after the journal's last
+        durable flush are simply recomputed by the same induction.
+        ``first_token_t``/``last_token_t`` are the journal's wall
+        anchors mapped into the new process's clock: TTFT stays "met"
+        across the restart and deadline attribution keeps working."""
+        seq = ActiveSequence(
+            request=req, slot=-1, tokens=[int(t) for t in tokens],
+            first_token_t=first_token_t,
+            last_token_t=last_token_t, preempts=int(preempts))
+        if seq.tokens:
+            seq.resume_prefix = np.concatenate([
+                req.prompt, np.asarray(seq.tokens[:-1], np.int32)])
+        return seq
+
     def prepare_resume(self) -> None:
         """Preemption bookkeeping: snapshot the re-prefill prefix from
         the tokens emitted so far and rewind the prefill cursor. The

@@ -149,6 +149,29 @@ def add_argument() -> argparse.Namespace:
                              "previously served weights (rollback)")
     parser.add_argument("--watch-interval", type=float, default=2.0,
                         help="seconds between checkpoint-watcher polls")
+    # Crash-durable serving (serving/journal.py; docs/RESILIENCE.md
+    # "Crash-durable serving").
+    parser.add_argument("--journal-dir", type=str, default=None,
+                        help="write-ahead request journal: accepted "
+                             "requests are durable before submit "
+                             "returns; on restart with the same flags "
+                             "the log replays BEFORE serving — "
+                             "finished results re-deliver exactly "
+                             "once, unfinished requests resume and "
+                             "complete bitwise-equal to the "
+                             "uninterrupted run, and already-consumed "
+                             "prompt lines are skipped")
+    parser.add_argument("--journal-fsync", type=str, default="batch",
+                        choices=["none", "batch", "always"],
+                        help="journal durability: 'none' = OS page "
+                             "cache (survives kill -9, not power "
+                             "loss), 'batch' = one fsync per writer "
+                             "flush, 'always' = fsync per record")
+    parser.add_argument("--journal-segment-bytes", type=int,
+                        default=1 << 20,
+                        help="journal segment rotation threshold "
+                             "(live state compacts into a fresh "
+                             "segment past this; bounded growth)")
     parser.add_argument("--flight-dump", type=str, default=None,
                         help="write a flight-recorder JSON here at exit "
                              "(tools/flight_report.py renders it)")
@@ -275,6 +298,9 @@ def main() -> int:
         max_queue_depth=args.max_queue_depth,
         ttft_deadline_ms=args.ttft_deadline_ms,
         deadline_ms=args.deadline_ms,
+        journal_dir=args.journal_dir,
+        journal_fsync=args.journal_fsync,
+        journal_segment_bytes=args.journal_segment_bytes,
         seed=args.seed,
     ), trace=trace, weights_epoch=restored_epoch)
 
@@ -320,14 +346,32 @@ def main() -> int:
             engine, args.metrics_port, component="serve",
             printer=lambda msg: print(msg, file=sys.stderr, flush=True))
 
+    # Crash-durable serving: replay the write-ahead journal BEFORE the
+    # prompt stream (the exporter is already up, so /healthz reads
+    # 'recovering' while this runs). Finished-but-undelivered results
+    # re-surface in the final report exactly once; unfinished requests
+    # re-seat through the resume path and complete bitwise; the
+    # journaled line cursor skips prompts this process already
+    # consumed on a previous life.
+    report = engine.recover()
+    recovered = (report["redelivered"]
+                 + report["completed_at_replay"])
+    lines_consumed = int(report["notes"].get("lines_consumed", 0))
+    if recovered or report["resumed"] or lines_consumed:
+        print(f"[serve] journal recovery: {len(recovered)} "
+              f"redelivered/expired, {report['resumed']} resumed; "
+              f"skipping {lines_consumed} already-consumed prompt "
+              f"line(s)", file=sys.stderr)
+
     if args.prompts_file:
         with open(args.prompts_file) as fh:
             lines = [ln.rstrip("\n") for ln in fh]
     else:
         lines = [ln.rstrip("\n") for ln in sys.stdin]
     lines = [ln for ln in lines if ln]
-    if not lines:
+    if not lines and not (recovered or report["resumed"]):
         raise SystemExit("no prompts (stdin/--prompts-file was empty)")
+    lines = lines[lines_consumed:]
 
     # Graceful drain: SIGTERM latches (PreemptionGuard); the submit loop
     # then closes admission — remaining prompts are rejected with the
@@ -340,6 +384,18 @@ def main() -> int:
         for text in lines:
             if guard.triggered:
                 engine.queue.close()  # idempotent; typed rejects below
+            if engine.journal is not None:
+                # The line cursor persists BEFORE the line is acted on:
+                # a crash inside this loop body drops a line that was
+                # never durably accepted (at-most-once) — it never
+                # duplicates one on restart.
+                lines_consumed += 1
+                # Enqueue-only: the admit below persists the same
+                # ordered batch (one fsync per line, not two); a
+                # skipped/rejected line's cursor rides the writer
+                # thread's next flush.
+                engine.journal.log_note(
+                    {"lines_consumed": lines_consumed}, flush=False)
             tokens = np.frombuffer(text.encode("utf-8"), np.uint8)
             if (tokens >= args.vocab_size).any():
                 print(f"[serve] SKIP (bytes outside vocab "
@@ -361,7 +417,9 @@ def main() -> int:
         # One-shot CLI: no more submits are coming, so ending through
         # drain() is free for the normal path and makes the SIGTERM path
         # identical — close admission, finish in-flight, then report.
-        done = engine.drain()
+        # Journal recoveries (redelivered + completed-at-replay) join
+        # the report: they are this process's deliveries too.
+        done = recovered + engine.drain()
         if guard.triggered:
             print(f"[serve] SIGTERM: drained {len(done)} in-flight "
                   f"request(s), admission closed", file=sys.stderr)
@@ -378,9 +436,18 @@ def main() -> int:
 
     for fin in sorted(done, key=lambda f: f.uid):
         ttft = ("-" if fin.ttft_ms is None else f"{fin.ttft_ms:.1f} ms")
+        # A recovered request's prompt text predates this process; its
+        # byte tokens reconstruct it (vocab 256 = one token per byte).
+        text = texts.get(fin.uid, decode_bytes(fin.prompt))
         print(f"[serve] #{fin.uid} ({fin.finish_reason}, "
               f"ttft {ttft}): "
-              f"{texts[fin.uid]!r} -> {decode_bytes(fin.tokens)!r}")
+              f"{text!r} -> {decode_bytes(fin.tokens)!r}")
+    if engine.journal is not None:
+        # Client cursor: the completions above are consumed — a future
+        # recovery must not redeliver them, and compaction may drop
+        # them.
+        engine.journal.ack([f.uid for f in done])
+        engine.journal.shutdown()
 
     stats = engine.stats()
     if args.json:

@@ -61,6 +61,48 @@ class TestHotPathTransfer:
                 return metrics.item()
         """, "hot-path-transfer")
 
+    def test_sync_journal_write_in_step_flagged(self, tmp_path, capsys):
+        # The crash-durability round's bug class: a journal append that
+        # fsyncs (or opens a file) inside Engine.step's compiled-
+        # dispatch window stalls every decode slot on storage latency.
+        assert _exit_code(tmp_path, """
+            import os
+
+            class Journal:
+                def append(self, rec):
+                    self._log = open("/data/wal.log", "ab")
+                    self._log.write(rec)
+                    os.fsync(self._log.fileno())
+
+            class Engine:
+                def step(self):
+                    self.journal.append(b"tok")
+        """, "hot-path-transfer") == 1
+        out = capsys.readouterr().out
+        assert "fsync" in out and "open(" in out
+
+    def test_negative_enqueue_only_journal_append_is_clean(self,
+                                                           tmp_path):
+        # The shipped design: the hot path only ENQUEUES; the writer
+        # thread (not reachable from Engine.step) owns open/fsync.
+        assert not _lint(tmp_path, """
+            import os
+
+            class Journal:
+                def append(self, rec):
+                    with self._lock:
+                        self._pending.append(rec)
+
+                def _writer_loop(self):
+                    fd = os.open("/data/wal.log", os.O_APPEND)
+                    os.write(fd, self._drain())
+                    os.fsync(fd)
+
+            class Engine:
+                def step(self):
+                    self.journal.append(b"tok")
+        """, "hot-path-transfer")
+
     def test_jitted_function_is_a_hot_root(self, tmp_path):
         findings = _lint(tmp_path, """
             import jax

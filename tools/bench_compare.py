@@ -114,6 +114,14 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     # high-tier latency SLO (wall-clock: cliff thresholds only)
     "tier0_ttft_hist_p99_ms": ("lower", 3.0),
     "tier0_tpot_hist_p95_ms": ("lower", 3.0),
+    # crash-durable serving (serving/journal.py): recovery counters are
+    # pure functions of the journal's durable state — on the no-crash
+    # smoke rows BOTH must stay exactly zero (any drift means requests
+    # were resurrected or recomputed in a run with no crash), and the
+    # CI crash drill separately pins them bitwise-equal across two
+    # kill/restart cycles
+    "requests_recovered": ("both", 0.0),
+    "tokens_recomputed_on_recovery": ("both", 0.0),
 }
 
 

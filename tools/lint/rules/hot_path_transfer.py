@@ -1,4 +1,5 @@
-"""Rule ``hot-path-transfer``: no hidden device↔host syncs in hot loops.
+"""Rule ``hot-path-transfer``: no hidden device↔host syncs (or
+synchronous disk writes) in hot loops.
 
 The static complement of ``tests/test_transfer_guard.py``: the runtime
 guard catches *implicit* transfers on a real accelerator, but the CPU
@@ -15,6 +16,16 @@ flags host-materialization calls inside the codebase's hot scopes:
 - ``Engine.step`` and everything it reaches inside ``serving/``;
 - HTTP handler methods (``do_GET``/``do_POST``) and their callees —
   the exporter's handler thread must never touch a device.
+
+The same scopes must never BLOCK ON THE FILESYSTEM either (the
+crash-durability round): the request journal's contract is that
+``Engine.step`` only ever *enqueues* records — ``open()`` /
+``os.fsync`` / ``os.fdatasync`` reachable from a hot scope means a
+synchronous disk write landed inside the compiled-dispatch window,
+stalling every decode slot on storage latency. The journal's writer
+thread (``serving/journal.py::_writer_loop``) owns the disk and is not
+reachable from the hot roots, so a finding here is a real leak, not
+the design.
 
 Deliberate syncs (the engine's per-iteration token landing, the TTFT
 measurement point) carry ``# graftlint: disable=hot-path-transfer``
@@ -44,6 +55,11 @@ FETCH_ATTRS = {"item", "tolist", "block_until_ready"}
 # Scalar conversions: flagged when applied to a computed value (bare
 # name / subscript), not to config attributes or literals.
 CONVERT_FUNCS = {"float", "int", "bool"}
+# Synchronous-disk-write primitives: blocking the decode loop on
+# storage is the journal bug class this rule pins (see module
+# docstring). `open` is only flagged as the BUILTIN (bare name, no
+# receiver) — `fh.open()`-style methods belong to their own objects.
+SYNC_IO_FUNCS = {"fsync", "fdatasync"}
 
 
 def _hot_functions(index: ProjectIndex
@@ -94,6 +110,21 @@ def check(index: ProjectIndex) -> Iterator[Finding]:
                     f".{cs.name}() in {where} forces a device→host "
                     f"transfer; keep metrics device-resident and fetch "
                     f"at flush boundaries (utils/logging.py contract)")
+            elif cs.name in SYNC_IO_FUNCS:
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f"{cs.name}() in {where} blocks the decode loop on "
+                    f"a synchronous disk write; journal/telemetry "
+                    f"records must be ENQUEUED here and persisted by "
+                    f"the writer thread (serving/journal.py contract)")
+            elif (cs.recv is None and cs.name == "open"
+                    and cs.chain == ["open"]):
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f"open(...) in {where}: file I/O inside the "
+                    f"compiled-dispatch window stalls every decode "
+                    f"slot on storage latency; move it off the hot "
+                    f"loop (writer thread / iteration-boundary flush)")
             elif cs.name == "device_get":
                 yield Finding(
                     NAME, fn.file.display_path, cs.line,

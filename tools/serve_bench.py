@@ -142,6 +142,41 @@ def add_argument() -> argparse.Namespace:
                         "one-line error — if anything recompiles inside "
                         "the measured window (silent retrace growth). "
                         "Requires warm-up (ignored with --no-warmup)")
+    # Crash-durable serving (serving/journal.py; docs/RESILIENCE.md
+    # "Crash-durable serving").
+    p.add_argument("--journal-dir", type=str, default=None,
+                   help="write-ahead request journal: admissions are "
+                        "durable before submit returns, progress "
+                        "persists off the hot loop, and a restart with "
+                        "the SAME flags replays the log — finished "
+                        "results re-deliver exactly once, unfinished "
+                        "requests resume and complete bitwise-equal "
+                        "to the uninterrupted run (the bench continues "
+                        "the scenario from its journaled submission "
+                        "cursor and skips warm-up)")
+    p.add_argument("--journal-fsync", type=str, default="batch",
+                   choices=["none", "batch", "always"],
+                   help="journal durability: 'none' = OS page cache "
+                        "(survives kill -9, not power loss), 'batch' = "
+                        "one fsync per writer flush, 'always' = fsync "
+                        "per record")
+    p.add_argument("--journal-segment-bytes", type=int, default=1 << 20,
+                   help="journal segment rotation threshold: past this "
+                        "the live state compacts into a fresh segment "
+                        "and old segments are deleted (bounded growth)")
+    p.add_argument("--kill-at-request", type=int, default=0,
+                   help="crash drill (resilience/chaos.py): SIGKILL "
+                        "this process the moment the Nth measured "
+                        "request has been submitted, after draining "
+                        "the journal queue to disk — so the durable "
+                        "state at death is deterministic. Restart with "
+                        "the same flags + --journal-dir to recover. "
+                        "0 = off")
+    p.add_argument("--completions-out", type=str, default=None,
+                   help="write every delivered completion (uid, finish "
+                        "reason, token ids; redelivered recoveries "
+                        "included) as one JSON list — the crash "
+                        "drill's bitwise-comparison artifact")
     p.add_argument("--flight-dump", type=str, default=None)
     p.add_argument("--metrics-port", type=int, default=None,
                    help="live telemetry plane: /metrics (Prometheus "
@@ -231,7 +266,30 @@ def main() -> int:
         tier_reserved_pages=args.tier_reserved_pages,
         preempt=not args.no_preempt,
         max_queue_depth=args.max_queue_depth,
+        journal_dir=args.journal_dir,
+        journal_fsync=args.journal_fsync,
+        journal_segment_bytes=args.journal_segment_bytes,
         seed=args.seed), trace=trace)
+
+    # Crash-durable serving: replay the write-ahead journal BEFORE any
+    # traffic. Finished-but-undelivered results re-deliver from the
+    # log; unfinished requests re-seat through the resume path (their
+    # continued outputs are bitwise the uninterrupted run's); the
+    # journaled submission cursor tells this process where the
+    # scenario left off.
+    report = engine.recover()
+    recovered_n = (len(report["redelivered"])
+                   + len(report["completed_at_replay"])
+                   + report["resumed"])
+    submitted_start = int(report["notes"].get("submitted", 0))
+    recovering = recovered_n > 0 or submitted_start > 0
+    if recovering:
+        print(f"[serve_bench] journal recovery: "
+              f"{len(report['redelivered'])} redelivered, "
+              f"{report['resumed']} resumed, "
+              f"{len(report['completed_at_replay'])} completed at "
+              f"replay; scenario continues at request "
+              f"{submitted_start}/{args.requests}", file=sys.stderr)
 
     # Live telemetry plane: the measured window is scrapeable while it
     # runs.
@@ -247,7 +305,15 @@ def main() -> int:
 
     rng = np.random.RandomState(args.seed)
 
-    if not args.no_warmup:
+    if not args.no_warmup and recovering:
+        # Recovery replay re-prefills and decodes through the normal
+        # compiled paths, so it IS the warm-up; re-running the warm-up
+        # pass here would also burn journaled uids and shift every
+        # subsequent request's fold_in(seed, uid) stream off the
+        # uninterrupted run's.
+        print("[serve_bench] warm-up skipped (journal recovery warms "
+              "the compiled paths)", file=sys.stderr)
+    elif not args.no_warmup:
         # Compile on the measured engine itself (compiles are
         # per-jit-closure, so a throwaway engine would not warm this
         # one), then reset the telemetry window. Paged mode has exactly
@@ -264,13 +330,13 @@ def main() -> int:
         # program either way (shapes are fixed-width, independent of
         # how many slots are active).
         warm_new = 4 if args.spec_k else 2
-        warm_tokens = 0
+        warm_fins = []
         if engine.paged:
             for _ in range(2):
                 engine.submit(rng.randint(0, args.vocab_size,
                                           size=2).astype(np.int32),
                               max_new_tokens=warm_new)
-                warm_tokens += sum(f.tokens.size for f in engine.run())
+                warm_fins.extend(engine.run())
         else:
             for lb in range(args.prefill_bucket, 2 * args.prompt_len - 1 +
                             args.prefill_bucket, args.prefill_bucket):
@@ -279,13 +345,26 @@ def main() -> int:
                 engine.submit(rng.randint(0, args.vocab_size,
                                           size=lb).astype(np.int32),
                               max_new_tokens=warm_new)
-                warm_tokens += sum(f.tokens.size for f in engine.run())
+                warm_fins.extend(engine.run())
+        if engine.journal is not None:
+            # Warm-up results are consumed here and now: ack them so a
+            # later recovery neither redelivers them nor carries them
+            # through compaction.
+            engine.journal.ack([f.uid for f in warm_fins])
         engine.reset_stats()
-        print(f"[serve_bench] warm-up done ({warm_tokens} tokens)",
+        print(f"[serve_bench] warm-up done "
+              f"({sum(f.tokens.size for f in warm_fins)} tokens)",
               file=sys.stderr)
 
     compile_watch = None
-    if args.check_compiles and not args.no_warmup:
+    if args.check_compiles and recovering:
+        # A recovery restart starts cold (warm-up is skipped so uids
+        # stay on the oracle's RNG streams): the measured window's
+        # first dispatches MUST compile, so the no-growth pin cannot
+        # apply — same reason it requires warm-up.
+        print("[serve_bench] --check-compiles skipped (journal "
+              "recovery restart runs cold)", file=sys.stderr)
+    elif args.check_compiles and not args.no_warmup:
         # Sanitizer (observability/sanitizer.py): the warm engine's
         # program inventory must match docs/SERVING.md, and the measured
         # window below must not compile anything at all.
@@ -317,19 +396,39 @@ def main() -> int:
                              f"{n}], got {args.swap_at_request}")
         swap_params = model.init(jax.random.PRNGKey(args.seed + 1),
                                  np.zeros((1, 8), np.int32))["params"]
+    if args.kill_at_request:
+        if not 1 <= args.kill_at_request <= n:
+            raise SystemExit(f"--kill-at-request must be in [1, {n}], "
+                             f"got {args.kill_at_request}")
 
     from distributed_training_tpu.resilience.errors import QueueFullError
 
-    submitted = 0
+    # Delivered completions: journal recoveries first (redelivered
+    # finished results + requests completed at replay), then everything
+    # the measured loop and the drain finish. The crash drill compares
+    # this set bitwise against the uninterrupted oracle.
+    completions = list(report["redelivered"]) \
+        + list(report["completed_at_replay"])
+    submitted = submitted_start
     finished = 0
     shed_at_submit = 0
 
     def submit_next(arrival_t=None):
         """Submit the next scenario arrival; a bounded-queue shed of the
         INCOMING request counts here (a shed of a queued lower-tier
-        victim instead surfaces as a 'shed' completion from step())."""
+        victim instead surfaces as a 'shed' completion from step()).
+        With a journal, the submission cursor persists BEFORE the
+        admission record: a crash between the two drops a request that
+        was never durably accepted (at-most-once), never duplicates
+        one."""
         nonlocal submitted, shed_at_submit
         r = load[submitted]
+        if engine.journal is not None:
+            # Enqueue-only: the admit inside engine.submit persists the
+            # same ordered batch, so the cursor is durable whenever the
+            # admit is — one fsync per request, not two.
+            engine.journal.log_note({"submitted": submitted + 1},
+                                    flush=False)
         try:
             engine.submit(r.prompt, max_new_tokens=r.max_new_tokens,
                           arrival_t=arrival_t, priority=r.priority,
@@ -339,6 +438,13 @@ def main() -> int:
         submitted += 1
         if swap_params is not None and submitted == args.swap_at_request:
             engine.arm_swap(swap_params, epoch=engine.weights_epoch + 1)
+        if args.kill_at_request and submitted == args.kill_at_request:
+            from distributed_training_tpu.resilience.chaos import (
+                hard_kill,
+            )
+
+            hard_kill(flush=None if engine.journal is None
+                      else engine.journal.persist)
 
     if args.virtual_dt > 0:
         # Deterministic drive: arrivals release on a virtual clock that
@@ -349,15 +455,26 @@ def main() -> int:
         # (arrival_t = the submit instant); only release timing is
         # virtualized, so latency stats remain real, merely paced by
         # iterations instead of seconds.
+        # After a recovery restart the scenario clock re-anchors at the
+        # first still-pending arrival, so the continuation releases
+        # immediately instead of replaying the dead process's idle
+        # time. A fresh run keeps the scenario origin (bitwise-stable
+        # schedule vs the committed baseline).
+        v0 = (load[submitted].arrival_s
+              if recovering and submitted < n else 0.0)
         it = 0
         while submitted < n:
-            vnow = it * args.virtual_dt / 1e3
+            vnow = v0 + it * args.virtual_dt / 1e3
             while submitted < n and load[submitted].arrival_s <= vnow:
                 submit_next()
-            finished += len(engine.step())
+            step_fins = engine.step()
+            completions.extend(step_fins)
+            finished += len(step_fins)
             it += 1
     else:
-        t0 = time.perf_counter()
+        w0 = (load[submitted].arrival_s
+              if recovering and submitted < n else 0.0)
+        t0 = time.perf_counter() - w0
         while submitted < n:
             now = time.perf_counter() - t0
             while submitted < n and load[submitted].arrival_s <= now:
@@ -367,15 +484,27 @@ def main() -> int:
                 # arrival instead of spinning empty iterations.
                 time.sleep(min(load[submitted].arrival_s - now, 0.05))
                 continue
-            finished += len(engine.step())
+            step_fins = engine.step()
+            completions.extend(step_fins)
+            finished += len(step_fins)
     # End through a graceful drain: admission closes and every accepted
     # request completes — preempted-and-requeued sequences included —
     # and is COUNTED before the SLA line is emitted; a hard stop here
     # used to drop tail requests from the percentiles.
-    finished += len(engine.drain())
-    assert finished + shed_at_submit == n, (
-        f"drained {finished} + {shed_at_submit} shed-at-submit "
-        f"of {n} requests")
+    drain_fins = engine.drain()
+    completions.extend(drain_fins)
+    finished += len(drain_fins)
+    # Completion accounting: this process's deliveries (recoveries +
+    # finishes) plus its sheds must cover what it drove — the scenario
+    # tail it submitted plus everything the journal owed it. A fresh
+    # run degenerates to the old finished + shed == n identity.
+    delivered = finished + len(report["redelivered"]) \
+        + len(report["completed_at_replay"])
+    expected = (n - submitted_start) + recovered_n
+    assert delivered + shed_at_submit == expected, (
+        f"delivered {delivered} + {shed_at_submit} shed-at-submit, "
+        f"expected {expected} ({n} requests, scenario resumed at "
+        f"{submitted_start}, {recovered_n} recovered)")
     if engine.paged:
         # Leak audit: every page back on the free list, no stranded
         # commitment — speculation's accept-rewind included (the CI
@@ -399,6 +528,20 @@ def main() -> int:
     stats["max_batch"] = args.max_batch
     stats["scenario"] = args.scenario
     stats["shed_at_submit"] = shed_at_submit
+    if args.completions_out:
+        with open(args.completions_out, "w") as fh:
+            json.dump([{"uid": int(f.uid), "reason": f.finish_reason,
+                        "tokens": [int(t) for t in f.tokens]}
+                       for f in sorted(completions,
+                                       key=lambda f: f.uid)], fh)
+        print(f"[serve_bench] completions: {args.completions_out} "
+              f"({len(completions)} requests)", file=sys.stderr)
+    if engine.journal is not None:
+        # The client cursor: everything above is durably consumed
+        # (printed / written out), so a future recovery must not
+        # redeliver it — and compaction may drop it.
+        engine.journal.ack([f.uid for f in completions])
+        engine.journal.shutdown()
     if args.flight_dump:
         engine.dump_flight(args.flight_dump, reason="serve_bench")
         print(f"[serve_bench] flight record: {args.flight_dump}",
