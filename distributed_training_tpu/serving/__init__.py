@@ -72,6 +72,11 @@ from distributed_training_tpu.serving.hotswap import (  # noqa: F401
     HotSwapper,
     committed_epochs,
 )
+from distributed_training_tpu.serving.ledger import (  # noqa: F401
+    LEDGER_CAUSES,
+    TOKEN_CAUSES,
+    LatencyLedger,
+)
 from distributed_training_tpu.serving.metrics import ServeTelemetry  # noqa: F401
 from distributed_training_tpu.serving.pages import (  # noqa: F401
     NULL_PAGE,

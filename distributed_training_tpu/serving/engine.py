@@ -63,7 +63,15 @@ step); a GPT drafter adds one single-shape ``draft`` program.
 SLA telemetry (TTFT / TPOT / throughput / queue depth / KV-page
 utilization / draft acceptance) flows through the round-7 flight
 recorder via :class:`ServeTelemetry`; ``dump_flight`` writes a
-``tools/flight_report.py``-readable record.
+``tools/flight_report.py``-readable record. Every request additionally
+carries a **latency ledger** (``serving/ledger.py``): ``(cause, start,
+end)`` intervals stamped at the measurement points this loop already
+pays for — seat, chunk boundary, decode iteration, spec rollback,
+preemption, swap barrier, journal admission, recovery replay, finish —
+whose causes partition the request's wall lifetime; the engine audits
+per-request conservation (``sum(intervals) == lifetime`` within
+``ledger.EPSILON_S``) at every completion and counts violations
+zero-tolerance (docs/OBSERVABILITY.md "Latency ledger").
 """
 
 from __future__ import annotations
@@ -88,6 +96,20 @@ from distributed_training_tpu.models.gpt import init_decode_cache
 from distributed_training_tpu.parallel.ring_attention import PagedKV
 from distributed_training_tpu.resilience.errors import SwapError
 from distributed_training_tpu.serving.journal import RequestJournal, perf_of
+from distributed_training_tpu.serving.ledger import (
+    CAUSE_DECODE,
+    CAUSE_JOURNAL_ADMIT,
+    CAUSE_PRE_CRASH,
+    CAUSE_PREEMPT_REQUEUE,
+    CAUSE_PREFILL,
+    CAUSE_QUEUE_WAIT,
+    CAUSE_RECOMPUTE,
+    CAUSE_RECOVERY,
+    CAUSE_SPEC_ACCEPT,
+    CAUSE_SPEC_DRAFT,
+    CAUSE_SPEC_ROLLBACK,
+    CAUSE_SWAP_BARRIER,
+)
 from distributed_training_tpu.serving.metrics import ServeTelemetry
 from distributed_training_tpu.serving.pages import PagePool, pages_for
 from distributed_training_tpu.serving.queue import RequestQueue
@@ -270,7 +292,8 @@ class Engine:
                     # every hot-swap barrier journals the new epoch
                     # (update_fingerprint below), and recover()
                     # validates against the LAST journaled value.
-                    "weights_epoch": int(weights_epoch)})
+                    "weights_epoch": int(weights_epoch)},
+                trace=trace)
         self._recovering = False
         self.recovery_report: dict[str, Any] | None = None
         self.telemetry = ServeTelemetry(cfg.ring_size,
@@ -563,6 +586,14 @@ class Engine:
                 # the caller's retry would duplicate it.
                 self.queue.withdraw(req)
                 raise
+            # Ledger: the synchronous admission write is the request's
+            # first lifetime span (arrival → durable-admit return).
+            # Producer-thread HANDOFF only — the request became
+            # seatable at enqueue, so the engine thread may already own
+            # the ledger; note_admit_done records the timestamp and the
+            # engine materializes the interval at its next stamp.
+            if req.ledger is not None:
+                req.ledger.note_admit_done(time.perf_counter())
         return req
 
     @property
@@ -600,6 +631,40 @@ class Engine:
         self._slot_pages[slot] = []
         self._slot_commit_left[slot] = 0
         self._tables[slot, :] = 0
+
+    # -- latency ledger (serving/ledger.py) ----------------------------------
+    @staticmethod
+    def _phase_cause(seq: ActiveSequence) -> str:
+        """The cause an in-slot sequence's CURRENT span bills to: fresh
+        prefill, recompute (re-prefilling a carried prefix after a
+        preemption or crash recovery), or decode."""
+        if seq.prefilling:
+            return (CAUSE_RECOMPUTE
+                    if seq.preempts or seq.resume_prefix is not None
+                    else CAUSE_PREFILL)
+        return CAUSE_DECODE
+
+    @staticmethod
+    def _finish_cause(fin: FinishedRequest) -> str:
+        """The cause of a completed request's terminal span (its last
+        stamp → the completion boundary). Queue-side evictions were
+        waiting (first wait or a requeue), slot evictions were serving
+        (mid-prefill for deadline evictions without a first token)."""
+        led = fin.ledger
+        if fin.slot is None:
+            if led is not None and led.intervals and \
+                    led.intervals[-1][0] not in (CAUSE_QUEUE_WAIT,
+                                                 CAUSE_JOURNAL_ADMIT):
+                return CAUSE_PREEMPT_REQUEUE
+            return CAUSE_QUEUE_WAIT
+        # A resumption evicted mid-RE-prefill (before or after its
+        # first token) was last doing recompute work, not decode.
+        if led is not None and led.intervals and \
+                led.intervals[-1][0] == CAUSE_RECOMPUTE:
+            return CAUSE_RECOMPUTE
+        if fin.first_token_t is None:
+            return CAUSE_PREFILL
+        return CAUSE_DECODE
 
     # -- tier-aware admission (shared by both step paths) --------------------
     def _queue_evict_finish(self, entry, reason: str) -> FinishedRequest:
@@ -685,6 +750,16 @@ class Engine:
             recompute = (seq.prefill_pos if seq.prefilling
                          else seq.request.prompt.size
                          + len(seq.tokens) - 1)
+            # Ledger: close the in-slot span at the eviction instant
+            # (the time from here to the re-seat bills to
+            # 'preempt_requeue' when the scheduler seats it again).
+            if seq.request.ledger is not None:
+                seq.request.ledger.stamp(self._phase_cause(seq),
+                                         time.perf_counter())
+            # The freed positions become ledger recompute debt: the
+            # next prefill chunks consume it before billing 'prefill',
+            # keeping ledger_tokens_recompute == the engine's counter.
+            seq.recompute_owed += recompute
             if self.paged:
                 self._free_slot_pages(seq.slot)
             self.telemetry.on_preempted(recompute,
@@ -751,8 +826,24 @@ class Engine:
             self._cache, self._tok, self._pos, self._rngs,
             jnp.int32(seq.slot), new_cache, tok, jnp.int32(n), req_rng)
         seq.prefill_pos = n
+        # Ledger token attribution: positions this prefill REwrote
+        # (recompute debt from preemptions/crashes) vs first-time
+        # writes — the split that keeps ledger_tokens_recompute equal
+        # to the engine's recompute counters.
+        led = seq.request.ledger
+        if led is not None:
+            rec = min(n, seq.recompute_owed)
+            seq.recompute_owed -= rec
+            if rec:
+                led.add_tokens(CAUSE_RECOMPUTE, rec)
+            if n - rec:
+                led.add_tokens(CAUSE_PREFILL, n - rec)
         if seq.tokens:
-            return  # resumed mid-decode: no new token was emitted
+            # Resumed mid-decode: no new token was emitted; bill the
+            # re-prefill dispatch to 'recompute' and resume decoding.
+            if led is not None:
+                led.stamp(CAUSE_RECOMPUTE, time.perf_counter())
+            return
         # graftlint: disable=hot-path-transfer -- the one deliberate sync: TTFT is measured here
         first = int(tok)
         t = time.perf_counter()
@@ -830,6 +921,17 @@ class Engine:
                 seq.note_token(tk, t)
             emitted += emit.size
             accepted += emit.size - 1
+            # Ledger: this iteration's span bills to 'decode' (the
+            # verify window IS the decode dispatch) and the landed
+            # tokens/draft economics count per request.
+            led = seq.request.ledger
+            if led is not None:
+                led.stamp(CAUSE_DECODE, t)
+                led.add_tokens(CAUSE_DECODE, emit.size)
+                if self.spec_k:
+                    led.add_tokens(CAUSE_SPEC_DRAFT,
+                                   useful_by_slot.get(seq.slot, 0))
+                    led.add_tokens(CAUSE_SPEC_ACCEPT, emit.size - 1)
             if self.trace is not None and self.spec_k:
                 self.trace.instant(
                     "spec.accept", track=f"slot {seq.slot}", t=t,
@@ -847,6 +949,17 @@ class Engine:
         req = seq.request
         seq.note_token(first, t)
         self.telemetry.on_tokens(1, t)
+        # Ledger: the prefill span closes AT the first token (the TTFT
+        # boundary the conservation sub-invariant checks), and the
+        # first token itself counts as an emitted 'decode' token. A
+        # resumption that was preempted mid-prefill re-prefills under
+        # 'recompute' instead.
+        if req.ledger is not None:
+            req.ledger.stamp(
+                CAUSE_RECOMPUTE
+                if seq.preempts or seq.resume_prefix is not None
+                else CAUSE_PREFILL, t)
+            req.ledger.add_tokens(CAUSE_DECODE, 1)
         self.telemetry.on_admitted((seq.seated_t - req.arrival_t) * 1e3,
                                    (t - seq.seated_t) * 1e3)
         if self.trace is not None:
@@ -977,12 +1090,19 @@ class Engine:
             # (serving/speculative.py; pinned by tests). epoch is
             # already a host int (arm_swap stages it as one).
             self.drafter.on_weights_swap(params, epoch)
-        dt = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        dt = t1 - t0
         self.telemetry.recorder.mark_gap()
         self.telemetry.on_swap_applied(dt)
         for seq in self.scheduler.active():
             if seq.first_token_t is not None:
                 seq.swap_pause_s += dt
+            # Ledger: close the in-flight span at the barrier entry and
+            # bill the barrier itself to 'swap_barrier' — deployment
+            # cost attributed per request, never smeared into decode.
+            if seq.request.ledger is not None:
+                seq.request.ledger.stamp(self._phase_cause(seq), t0)
+                seq.request.ledger.stamp(CAUSE_SWAP_BARRIER, t1)
         if self.trace is not None:
             self.trace.instant("swap.applied", track="engine",
                                # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg
@@ -1093,15 +1213,40 @@ class Engine:
                 decoding, toks, accepts, useful_by_slot, t)
             if self.spec_k:
                 # Host-side accept/rewind bookkeeping cost, attributed
-                # explicitly like admission_blocked_s/swap_blocked_s.
+                # explicitly like admission_blocked_s/swap_blocked_s —
+                # and billed to each decoding request's ledger as
+                # 'spec_rollback' (the batch shares the wall window).
+                t_roll = time.perf_counter()
+                for seq in decoding:
+                    if seq.request.ledger is not None:
+                        seq.request.ledger.stamp(CAUSE_SPEC_ROLLBACK,
+                                                 t_roll)
                 self.telemetry.on_spec(
                     drafted=drafted, accepted=accepted,
-                    rollback_s=time.perf_counter() - t)
+                    rollback_s=t_roll - t)
             self.telemetry.on_decode(lanes=len(decoding), tokens=emitted)
             self.telemetry.on_tokens(emitted, t)
             if chunk_seq is not None:
                 start = chunk_seq.prefill_pos
                 chunk_seq.prefill_pos = start + c
+                # Ledger chunk boundary: this iteration's span (chunk-
+                # lane wait included) and the cache positions the chunk
+                # wrote. Positions the chunk REwrites (the sequence's
+                # recompute debt from preemptions/crashes) bill to
+                # 'recompute'; first-time writes bill to 'prefill' —
+                # so the token split mirrors the engine's recompute
+                # counters exactly. The wall span takes the chunk's
+                # dominant cause.
+                led = chunk_seq.request.ledger
+                if led is not None:
+                    rec = min(c, chunk_seq.recompute_owed)
+                    chunk_seq.recompute_owed -= rec
+                    if rec:
+                        led.add_tokens(CAUSE_RECOMPUTE, rec)
+                    if c - rec:
+                        led.add_tokens(CAUSE_PREFILL, c - rec)
+                    led.stamp(CAUSE_RECOMPUTE if rec * 2 >= c
+                              else CAUSE_PREFILL, t)
                 if self.trace is not None:
                     self.trace.complete(
                         "prefill_chunk", t_step0, t,
@@ -1222,9 +1367,14 @@ class Engine:
                 t = time.perf_counter()
                 emitted, accepted = self._apply_accepts(
                     active_seqs, toks, accepts, useful_by_slot, t)
+                t_roll = time.perf_counter()
+                for seq in active_seqs:
+                    if seq.request.ledger is not None:
+                        seq.request.ledger.stamp(CAUSE_SPEC_ROLLBACK,
+                                                 t_roll)
                 self.telemetry.on_spec(
                     drafted=drafted, accepted=accepted,
-                    rollback_s=time.perf_counter() - t)
+                    rollback_s=t_roll - t)
                 self.telemetry.on_decode(lanes=len(active_seqs),
                                          tokens=emitted)
                 self.telemetry.on_tokens(emitted, t)
@@ -1248,6 +1398,9 @@ class Engine:
                 t = time.perf_counter()
                 for seq in active_seqs:
                     seq.note_token(toks[seq.slot], t)
+                    if seq.request.ledger is not None:
+                        seq.request.ledger.stamp(CAUSE_DECODE, t)
+                        seq.request.ledger.add_tokens(CAUSE_DECODE, 1)
                 self.telemetry.on_decode(lanes=len(active_seqs),
                                          tokens=len(active_seqs))
                 self.telemetry.on_tokens(len(active_seqs), t)
@@ -1302,6 +1455,16 @@ class Engine:
                 self.telemetry.end_work()
         else:
             self.telemetry.on_idle()
+        if finished:
+            # Ledger terminal stamp: a request's lifetime ends at the
+            # boundary that completed it; the tail span (last stamp →
+            # here) bills to the phase it was in. on_finished then
+            # audits conservation — so every completion is checked
+            # in-engine, at the moment it happens.
+            t_fin = time.perf_counter()
+            for fin in finished:
+                if fin.ledger is not None and not fin.ledger.closed:
+                    fin.ledger.close(self._finish_cause(fin), t_fin)
         for fin in finished:
             self.telemetry.on_finished(fin)
             if self.trace is not None:
@@ -1443,6 +1606,18 @@ class Engine:
                     last_token_t=(perf_of(rr.last_wall)
                                   if rr.last_wall is not None
                                   else None))
+                # Ledger (wall-anchored like the deadline clocks): the
+                # dead process's span is 'pre_crash' up to its last
+                # durable token (the per-cause detail died with it),
+                # and everything from there to the end of this replay
+                # — downtime included — bills to 'recovery'. Requests
+                # with no durable token bill their whole pre-replay
+                # span to 'recovery' (death time is unknowable).
+                if req.ledger is not None:
+                    if seq.last_token_t is not None:
+                        req.ledger.stamp(CAUSE_PRE_CRASH,
+                                         seq.last_token_t)
+                    req.ledger.stamp(CAUSE_RECOVERY, now)
                 reason = seq.finish_reason(self.sample_cfg.eos_id, now)
                 if reason is not None:
                     # The journaled stream already completed (a crash
@@ -1451,6 +1626,8 @@ class Engine:
                     # downtime: complete at replay, never resurrect.
                     fin = FinishedRequest.from_active(seq, reason,
                                                       slot=None)
+                    if fin.ledger is not None:
+                        fin.ledger.close(CAUSE_RECOVERY, now)
                     self.journal.note_finish(fin)
                     self.telemetry.on_finished(fin)
                     report["completed_at_replay"].append(fin)
@@ -1596,12 +1773,18 @@ class Engine:
         compiled programs, slot state, and page allocations are
         untouched. The crash-recovery counters carry across: recovery
         happened once per process, and a warm-up reset must not erase
-        the evidence the recovery drill gates on."""
+        the evidence the recovery drill gates on. The latency ledger's
+        per-cause LIFETIME histograms and conservation audit carry the
+        same way (the recovery/pre_crash causes are stamped once per
+        process, and a violation must never be erasable by a window
+        reset); the windowed ledger surfaces — per-cause token
+        counters, the slowest-requests list — start fresh."""
         old = self.telemetry
         self.telemetry = ServeTelemetry(self.cfg.ring_size,
                                         num_tiers=self.cfg.num_tiers)
         self.telemetry.on_recovered(old.requests_recovered,
                                     old.tokens_recomputed_on_recovery)
+        self.telemetry.adopt_ledger_lifetime(old)
         self.queue.reset_counters()
         self._iteration = 0
 

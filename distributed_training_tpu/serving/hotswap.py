@@ -36,7 +36,10 @@ errors.SwapError` + ``swaps_rejected``):
    mid-flight.
 5. **arm → barrier** — ``Engine.arm_swap`` stages the tree;
    ``Engine.step`` applies it at the next iteration boundary, bills the
-   pause to ``swap_blocked_s``, and bumps ``weights_epoch``. Two
+   pause to ``swap_blocked_s`` engine-wide AND to each in-flight
+   request's latency ledger as a ``swap_barrier`` interval
+   (serving/ledger.py — the per-request answer to "which p99 did this
+   deploy eat"), and bumps ``weights_epoch``. Two
    engines fed the same requests with the swap forced at the same
    iteration produce bitwise-identical outputs (pinned by
    ``tests/test_hotswap.py``).

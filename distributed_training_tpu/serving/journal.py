@@ -168,7 +168,8 @@ class RequestJournal:
     def __init__(self, path: str, *, fsync: str = "batch",
                  segment_bytes: int = 1 << 20,
                  fingerprint: dict | None = None,
-                 flush_interval_s: float = 0.01):
+                 flush_interval_s: float = 0.01,
+                 trace=None):
         if fsync not in ("none", "batch", "always"):
             raise ValueError(
                 f"fsync policy must be none|batch|always, got {fsync!r}")
@@ -179,6 +180,13 @@ class RequestJournal:
         self.fsync = fsync
         self.segment_bytes = int(segment_bytes)
         self.fingerprint = dict(fingerprint or {})
+        # Timeline visibility (observability/trace.py; None = off): the
+        # background writer draws per-batch write/fsync spans and a
+        # journal-queue-depth counter on a 'journal-writer' track, so
+        # the round-17 thread stops being invisible in Perfetto. Spans
+        # are emitted AFTER the io lock is released — the trace
+        # session's own lock must never nest inside journal locks.
+        self.trace = trace
         self._lock = threading.Lock()
         self._io_lock = threading.Lock()
         self._pending: list[dict] = []
@@ -620,11 +628,17 @@ class RequestJournal:
         with self._io_lock:
             if self._fd is None:
                 return  # crashed or never recovered
+            # Span bookkeeping starts AFTER the io lock lands: a sync
+            # append racing the writer thread must not bill the other
+            # flusher's fsyncs or its own lock wait to this batch.
+            t0 = time.perf_counter()
+            wrote = 0
+            fsyncs0 = self.fsyncs
+            rotated = False
             with self._lock:
                 batch, self._pending = self._pending, []
             try:
                 if batch:
-                    wrote = 0
                     if self.fsync == "always":
                         for payload in batch:
                             blob = self._encode(payload)
@@ -645,6 +659,7 @@ class RequestJournal:
                 if self._seg_bytes >= max(self.segment_bytes,
                                           2 * self._compact_floor):
                     self.segments_rotated += 1
+                    rotated = True
                     self._write_compacted(
                         self._seg_index + 1,
                         [p for _, p in self._segment_files()])
@@ -660,6 +675,17 @@ class RequestJournal:
                     self._pending = batch + self._pending
                 self.write_errors += 1
                 raise
+        # Trace spans outside the io lock (see __init__): one complete
+        # write(+fsync) span per non-empty flush, plus the queue-depth
+        # counter (records drained this batch). Empty writer ticks draw
+        # nothing — the track shows work, not the 100 Hz poll.
+        if self.trace is not None and (batch or rotated):
+            self.trace.complete(
+                "journal.write", t0, time.perf_counter(),
+                track="journal-writer", records=len(batch), bytes=wrote,
+                fsyncs=self.fsyncs - fsyncs0, rotated=rotated)
+            self.trace.counter("journal_queue_depth", len(batch),
+                               track="journal-writer")
 
     def _writer_loop(self, interval_s: float) -> None:
         while not self._stop.wait(interval_s):

@@ -42,6 +42,17 @@ its recompute debt in cache positions), per-tier finished/preempted
 counts, and ``requests_preempt_timed_out`` (deadline misses attributed
 to preemption pressure rather than service time).
 
+Latency-ledger accounting (serving/ledger.py; docs/OBSERVABILITY.md
+"Latency ledger"): per-request ``(cause, start, end)`` intervals whose
+causes partition each request's wall lifetime fold into per-cause
+fixed-bucket lifetime histograms (``ledger_<cause>_ms``), deterministic
+per-cause token counters (``ledger_tokens_<cause>``, bench-gated
+zero-drift), a bounded slowest-requests decomposition (``ledger_top``),
+and the zero-tolerance ``ledger_conservation_violations`` audit —
+every finished request's intervals must sum to its lifetime within
+``ledger.EPSILON_S``, with ``queue_wait + prefill == TTFT`` as the
+sub-invariant for unpreempted, unrecovered requests.
+
 The engine drives the same two touch points the trainers use
 (``observability/hooks.py`` shape): :meth:`on_iteration` per decode
 iteration (one host timestamp into the :class:`FlightRecorder` ring — so
@@ -62,7 +73,16 @@ from distributed_training_tpu.observability.flight_recorder import (
     percentile,
 )
 from distributed_training_tpu.observability.histogram import FixedHistogram
+from distributed_training_tpu.serving.ledger import (
+    LEDGER_CAUSES,
+    TOKEN_CAUSES,
+)
 from distributed_training_tpu.serving.request import FinishedRequest
+
+# How many of the slowest finished requests the flight/scrape surfaces
+# keep, each decomposed by cause — the "where did this p99 go" view
+# tools/flight_report.py renders as the latency-ledger table.
+LEDGER_TOP_N = 8
 
 
 class ServeTelemetry:
@@ -114,6 +134,29 @@ class ServeTelemetry:
         # bitwise-equal across runs (and zero-drift on no-crash rows).
         self.requests_recovered = 0
         self.tokens_recomputed_on_recovery = 0
+        # Per-request latency ledger aggregates (serving/ledger.py):
+        # one fixed-bucket histogram per cause over per-request
+        # milliseconds (process-LIFETIME aggregates — reset_stats
+        # carries them across a warm-up window reset exactly like
+        # requests_recovered, because the recovery/pre_crash causes are
+        # stamped once per process and a reset must not erase them),
+        # deterministic per-cause token counters (bench-gated
+        # zero-drift), the conservation audit counter (zero-tolerance:
+        # every finished request's intervals must tile its lifetime),
+        # and a bounded slowest-requests list for the flight report.
+        self.ledger_cause_ms = {c: FixedHistogram()
+                                for c in LEDGER_CAUSES}
+        # Windowed per-cause wall totals (reset with the stats window,
+        # like ledger_requests/ledger_tokens): the `ledger_<cause>_
+        # ms_total` stats describe exactly the requests this window
+        # audited — the lifetime histograms above additionally carry
+        # pre-reset (warm-up/recovery) spans.
+        self.ledger_window_ms = {c: 0.0 for c in LEDGER_CAUSES}
+        self.ledger_tokens = {c: 0 for c in TOKEN_CAUSES}
+        self.ledger_requests = 0
+        self.ledger_conservation_violations = 0
+        self.ledger_violation_last: str | None = None
+        self.ledger_top: list[dict[str, Any]] = []
         # Admission-latency breakdown: queueing vs prefill compute.
         self.queue_wait_ms: list[float] = []
         self.prefill_ms: list[float] = []
@@ -303,6 +346,62 @@ class ServeTelemetry:
             self.tpot_ms.append(fin.tpot_ms)
             self.tpot_hist.observe(fin.tpot_ms)
             self.tier_tpot_hist[tier].observe(fin.tpot_ms)
+        self._audit_ledger(fin)
+
+    def _audit_ledger(self, fin: FinishedRequest) -> None:
+        """Fold one finished request's latency ledger into the per-cause
+        aggregates and enforce the conservation invariant (module
+        docstring of serving/ledger.py). Journal redeliveries carry no
+        ledger (their wall detail died with the old process) and are
+        skipped — they never count as violations."""
+        led = fin.ledger
+        if led is None:
+            return
+        self.ledger_requests += 1
+        totals = led.totals_ms()
+        for cause, ms in totals.items():
+            hist = self.ledger_cause_ms.get(cause)
+            if hist is not None:
+                hist.observe(ms)
+            if cause in self.ledger_window_ms:
+                self.ledger_window_ms[cause] += ms
+        for cause, n in led.tokens.items():
+            if cause in self.ledger_tokens:
+                self.ledger_tokens[cause] += n
+        violations = led.violations(ttft_ms=fin.ttft_ms)
+        if violations:
+            self.ledger_conservation_violations += 1
+            self.ledger_violation_last = (
+                f"uid {fin.uid} ({fin.finish_reason}): {violations[0]}")
+        # Bounded slowest-requests view (LEDGER_TOP_N): lifetime-sorted,
+        # uid tiebreak for determinism under equal stamps.
+        entry = {
+            "uid": int(fin.uid),
+            "finish_reason": fin.finish_reason,
+            "lifetime_ms": led.lifetime_ms,
+            "ttft_ms": fin.ttft_ms,
+            "tokens": int(fin.tokens.size),
+            "causes_ms": totals,
+        }
+        self.ledger_top.append(entry)
+        self.ledger_top.sort(
+            key=lambda e: (-e["lifetime_ms"], e["uid"]))
+        del self.ledger_top[LEDGER_TOP_N:]
+
+    def adopt_ledger_lifetime(self, old: "ServeTelemetry") -> None:
+        """Carry the process-lifetime ledger evidence across a stats
+        window reset (``Engine.reset_stats``): the per-cause lifetime
+        histograms and the conservation audit — the round-17
+        ``requests_recovered`` precedent, extended. The WINDOWED ledger
+        surfaces (per-cause ms totals, token counters, slowest-request
+        list, audited count) deliberately start fresh, so a compile
+        warm-up pass cannot contaminate the measured window's
+        deterministic counters — or the SLA line's per-cause
+        decomposition of the requests it claims to audit."""
+        self.ledger_cause_ms = old.ledger_cause_ms
+        self.ledger_conservation_violations = \
+            old.ledger_conservation_violations
+        self.ledger_violation_last = old.ledger_violation_last
 
     def flush(self, iteration: int, queue_depth: int, active: int) -> None:
         self.recorder.record_flush(iteration, {
@@ -349,8 +448,25 @@ class ServeTelemetry:
             tiers[f"tier{t}_requests_finished"] = self.tier_finished[t]
             tiers[f"tier{t}_requests_preempted"] = self.tier_preempted[t]
 
+        # Latency-ledger aggregates (serving/ledger.py): WINDOWED
+        # per-cause wall totals (deliberately not the lifetime
+        # histograms' sums — the scalars must describe exactly the
+        # requests this window audited, warm-up excluded), the
+        # deterministic per-cause token counters, and the
+        # zero-tolerance conservation audit. Every key always present
+        # (0 / 0.0 when unused).
+        ledger: dict[str, Any] = {
+            f"ledger_{c}_ms_total": self.ledger_window_ms[c]
+            for c in LEDGER_CAUSES}
+        for c in TOKEN_CAUSES:
+            ledger[f"ledger_tokens_{c}"] = int(self.ledger_tokens[c])
+        ledger["ledger_requests"] = int(self.ledger_requests)
+        ledger["ledger_conservation_violations"] = \
+            int(self.ledger_conservation_violations)
+
         return {
             **tiers,
+            **ledger,
             "throughput_tok_s": tput,
             "ttft_p50_ms": pct(self.ttft_ms, 50),
             "ttft_p95_ms": pct(self.ttft_ms, 95),
@@ -454,6 +570,16 @@ class ServeTelemetry:
                     self.tier_ttft_hist[t].to_dict()
                 serving["histograms"][f"tpot_ms_tier{t}"] = \
                     self.tier_tpot_hist[t].to_dict()
+        # Latency-ledger per-cause histograms (causes that appeared) and
+        # the slowest-requests decomposition for the flight report.
+        for c in LEDGER_CAUSES:
+            if self.ledger_cause_ms[c].total:
+                serving["histograms"][f"ledger_{c}_ms"] = \
+                    self.ledger_cause_ms[c].to_dict()
+        if self.ledger_top:
+            serving["ledger_top"] = [dict(e) for e in self.ledger_top]
+        if self.ledger_violation_last is not None:
+            serving["ledger_violation_last"] = self.ledger_violation_last
         return serving
 
     def snapshot(self, *, reason: str = "scrape",

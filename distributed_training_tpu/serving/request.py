@@ -23,6 +23,8 @@ import dataclasses
 
 import numpy as np
 
+from distributed_training_tpu.serving.ledger import LatencyLedger
+
 # Why a sequence left its slot (or the queue).
 FINISH_EOS = "eos"        # emitted the configured eos_id
 FINISH_LENGTH = "length"  # hit its max_new_tokens budget
@@ -66,6 +68,21 @@ class Request:
     deadline_t: float | None = None
     priority: int = 0         # SLO tier, 0 = highest
     tenant: str = "default"
+    # Per-request latency ledger (serving/ledger.py): the append-only
+    # (cause, start, end) interval list whose causes partition the
+    # request's wall lifetime. It travels WITH the request through
+    # every state change — queue → slot → (preempt) → queue → slot →
+    # finished — so attribution survives requeues and the finished
+    # record carries the full decomposition. Mutable by design (the
+    # frozen dataclass pins the admission record; the ledger is
+    # telemetry riding along) and excluded from equality.
+    ledger: LatencyLedger | None = dataclasses.field(
+        default=None, compare=False, repr=False)
+
+    def __post_init__(self):
+        if self.ledger is None:
+            object.__setattr__(self, "ledger",
+                               LatencyLedger(self.arrival_t))
 
 
 @dataclasses.dataclass
@@ -106,6 +123,18 @@ class ActiveSequence:
     # identical to an uninterrupted run.
     preempts: int = 0
     resume_prefix: np.ndarray | None = None
+    # Ledger token-attribution debt (serving/ledger.py): cache
+    # positions freed by preemptions/crashes that the next prefill
+    # chunks will write AGAIN. Each re-prefill chunk consumes this
+    # before billing to 'prefill' — a request preempted mid-prefill
+    # bills only the positions it had actually written as recompute;
+    # the never-written tail of its prompt stays first-time 'prefill'
+    # work. When every evicted request re-seats, the summed ledger
+    # counter equals preempted_token_recompute +
+    # tokens_recomputed_on_recovery; a resumption shed or expired
+    # from the queue dies with its debt unconsumed (nothing was
+    # recomputed, so nothing is billed).
+    recompute_owed: int = 0
 
     @property
     def prefill_tokens(self) -> np.ndarray:
@@ -148,6 +177,10 @@ class ActiveSequence:
         if seq.tokens:
             seq.resume_prefix = np.concatenate([
                 req.prompt, np.asarray(seq.tokens[:-1], np.int32)])
+            # The recovery re-prefill rewrites exactly the positions
+            # the crash lost — the same count Engine.recover() reports
+            # as tokens_recomputed_on_recovery.
+            seq.recompute_owed = req.prompt.size + len(seq.tokens) - 1
         return seq
 
     def prepare_resume(self) -> None:
@@ -238,6 +271,11 @@ class FinishedRequest:
     slot: int | None = None
     priority: int = 0         # SLO tier (per-tier SLA histograms)
     tenant: str = "default"
+    # The request's latency ledger (closed by the engine at completion;
+    # None for results redelivered verbatim from the journal — their
+    # wall detail belongs to the process that served them).
+    ledger: "object | None" = dataclasses.field(
+        default=None, compare=False, repr=False)
 
     @staticmethod
     def from_active(seq: ActiveSequence, reason: str,
@@ -270,6 +308,7 @@ class FinishedRequest:
             slot=seq.slot if slot == -1 else slot,
             priority=seq.request.priority,
             tenant=seq.request.tenant,
+            ledger=seq.request.ledger,
         )
 
     @staticmethod
@@ -288,6 +327,7 @@ class FinishedRequest:
             first_token_t=None,
             priority=req.priority,
             tenant=req.tenant,
+            ledger=req.ledger,
         )
 
     @staticmethod

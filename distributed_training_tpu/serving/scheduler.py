@@ -41,6 +41,10 @@ import time
 
 import numpy as np
 
+from distributed_training_tpu.serving.ledger import (
+    CAUSE_PREEMPT_REQUEUE,
+    CAUSE_QUEUE_WAIT,
+)
 from distributed_training_tpu.serving.request import (
     ActiveSequence,
     FinishedRequest,
@@ -193,6 +197,15 @@ class SlotScheduler:
             else:
                 seq = ActiveSequence(request=cand, slot=slot,
                                      seated_t=now)
+            # Ledger seat stamp (serving/ledger.py): the wait that just
+            # ended is 'queue_wait' for a first seat and
+            # 'preempt_requeue' for a resumption's re-seat (preemption
+            # OR crash-recovery restore — both ride the resume path).
+            if seq.request.ledger is not None:
+                seq.request.ledger.stamp(
+                    CAUSE_PREEMPT_REQUEUE if isinstance(
+                        cand, ActiveSequence) else CAUSE_QUEUE_WAIT,
+                    now)
             self._slots[slot] = seq
             if on_seat is not None:
                 on_seat(seq)

@@ -63,6 +63,14 @@ Two drafter backends behind one protocol:
 
 Drafters are *proposal* machinery: a wrong, stale, or empty proposal
 costs acceptance rate, never correctness.
+
+**Latency attribution** (serving/ledger.py): per request, the verify
+window bills to the ledger's ``decode`` cause (the window IS the decode
+dispatch), the host accept/rewind bookkeeping after tokens land bills
+to ``spec_rollback``, and the per-request draft economics ride the
+deterministic ``spec_draft``/``spec_accept`` token counters — the
+request-level split of the engine-global ``drafted_tokens``/
+``accepted_tokens`` zero-drift pair.
 """
 
 from __future__ import annotations

@@ -175,6 +175,16 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--flight-dump", type=str, default=None,
                         help="write a flight-recorder JSON here at exit "
                              "(tools/flight_report.py renders it)")
+    parser.add_argument("--ledger-out", type=str, default=None,
+                        help="write each completed request's latency "
+                             "ledger (serving/ledger.py) as one "
+                             "strict-JSON list: per-request (cause, "
+                             "start, end) intervals partitioning its "
+                             "wall lifetime — queue wait, prefill, "
+                             "decode, preemption requeue/recompute, "
+                             "swap barriers, journal admission, "
+                             "crash-recovery downtime — plus the "
+                             "conservation verdict")
     parser.add_argument("--metrics-port", type=int, default=None,
                         help="live telemetry plane: /metrics (Prometheus "
                              "text, incl. TTFT/TPOT histograms + KV/slot "
@@ -463,6 +473,13 @@ def main() -> int:
               f"tpot p50 {stats['tpot_p50_ms']:.2f} / "
               f"p95 {stats['tpot_p95_ms']:.2f} ms | "
               f"queue depth max {stats['queue_depth_max']}",
+              file=sys.stderr)
+    if args.ledger_out:
+        from distributed_training_tpu.serving.ledger import dump_ledgers
+
+        n_rows, bad = dump_ledgers(args.ledger_out, done)
+        print(f"[serve] latency ledgers: {args.ledger_out} "
+              f"({n_rows} requests, {bad} conservation violation(s))",
               file=sys.stderr)
     if args.flight_dump:
         engine.dump_flight(args.flight_dump)

@@ -264,9 +264,17 @@ class TestServingScrape:
         snap = json.loads(_get(exp.url("/vars"))[2])
         srv = snap["serving"]
         assert srv["requests_finished"] == len(MIXED_LENS)
+        # The fixed SLA histograms, plus one ledger_<cause>_ms family
+        # per latency-ledger cause that actually appeared in this run
+        # (serving/ledger.py; a clean serve shows the three lifecycle
+        # causes and nothing else).
         assert set(srv["histograms"]) == {
-            "ttft_ms", "tpot_ms", "queue_wait_ms", "prefill_ms"}
+            "ttft_ms", "tpot_ms", "queue_wait_ms", "prefill_ms",
+            "ledger_queue_wait_ms", "ledger_prefill_ms",
+            "ledger_decode_ms"}
         assert srv["kv_reserved_vs_written"] > 1.0
+        assert srv["ledger_conservation_violations"] == 0
+        assert srv["ledger_requests"] == len(MIXED_LENS)
 
     def test_drained_engine_phase(self, served):
         """Engine-drained behavior: /healthz keeps answering 200 and

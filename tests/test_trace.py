@@ -236,6 +236,42 @@ class TestServingTrace:
         assert "\n" == err[err.index("\n"):]  # exactly one line
         assert report.main([str(tmp_path / "missing.json")]) == 2
 
+    def test_journal_writer_track(self, tmp_path):
+        """The round-17 background writer is visible on the timeline:
+        per-batch write/fsync spans plus the journal-queue-depth
+        counter on a 'journal-writer' track (serving/journal.py gains
+        the wiring; empty writer ticks draw nothing)."""
+        from distributed_training_tpu.serving import RequestJournal
+        from distributed_training_tpu.serving.request import Request
+
+        tr = TraceSession(process_name="journal-test")
+        j = RequestJournal(str(tmp_path / "wal"), trace=tr,
+                           flush_interval_s=60.0)  # we drive persist()
+        j.recover()
+        j.log_admit(Request(uid=0,
+                            prompt=np.arange(1, 4, dtype=np.int32),
+                            max_new_tokens=4,
+                            arrival_t=time.perf_counter()))
+        j.pause()
+        n_after_write = len(tr)
+        j.persist()  # empty flush: no span, no counter
+        assert len(tr) == n_after_write
+        obj = tr.to_json()
+        spans = [e for e in obj["traceEvents"]
+                 if e.get("name") == "journal.write" and e["ph"] == "X"]
+        assert spans and spans[0]["args"]["records"] >= 1
+        assert spans[0]["args"]["fsyncs"] >= 1  # fsync='batch' default
+        counters = [e for e in obj["traceEvents"]
+                    if e.get("name") == "journal_queue_depth"
+                    and e["ph"] == "C"]
+        assert counters
+        track_tids = {e["args"]["name"]: e["tid"]
+                      for e in obj["traceEvents"]
+                      if e.get("name") == "thread_name"}
+        assert "journal-writer" in track_tids
+        assert spans[0]["tid"] == track_tids["journal-writer"]
+        j.shutdown()
+
 
 class TestTrainerTrace:
     def test_lm_trainer_traced_run_end_to_end(self, tmp_path):

@@ -114,6 +114,20 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     # high-tier latency SLO (wall-clock: cliff thresholds only)
     "tier0_ttft_hist_p99_ms": ("lower", 3.0),
     "tier0_tpot_hist_p95_ms": ("lower", 3.0),
+    # latency ledger (serving/ledger.py): conservation is a structural
+    # invariant — ONE finished request whose intervals fail to tile its
+    # lifetime is an attribution bug, so the violation counter is
+    # zero-tolerance from any baseline; the per-cause token counters
+    # are pure functions of each request's own token stream and the
+    # deterministic schedule (the per-request twins of tokens_emitted /
+    # preempted_token_recompute / drafted-accepted), so ANY drift is
+    # accounting breakage, not noise
+    "ledger_conservation_violations": ("both", 0.0),
+    "ledger_tokens_prefill": ("both", 0.0),
+    "ledger_tokens_decode": ("both", 0.0),
+    "ledger_tokens_recompute": ("both", 0.0),
+    "ledger_tokens_spec_draft": ("both", 0.0),
+    "ledger_tokens_spec_accept": ("both", 0.0),
     # crash-durable serving (serving/journal.py): recovery counters are
     # pure functions of the journal's durable state — on the no-crash
     # smoke rows BOTH must stay exactly zero (any drift means requests

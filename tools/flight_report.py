@@ -154,6 +154,31 @@ def render(summary: dict) -> str:
                 f"{srv['prefill_p50_ms']:.1f} / p95 "
                 f"{srv['prefill_p95_ms']:.1f} ms  |  blocked "
                 f"{srv.get('admission_blocked_s', 0.0):.2f}s")
+        # Latency ledger (serving/ledger.py): the conserved per-cause
+        # decomposition — engine-wide cause totals, the conservation
+        # audit, and the slowest requests broken down by cause.
+        if srv.get("ledger_requests"):
+            totals = {k[len("ledger_"):-len("_ms_total")]: v
+                      for k, v in srv.items()
+                      if k.startswith("ledger_")
+                      and k.endswith("_ms_total") and v}
+            body = "  ".join(f"{c} {ms:.0f}" for c, ms in sorted(
+                totals.items(), key=lambda kv: -kv[1]))
+            viol = srv.get("ledger_conservation_violations", 0)
+            add(f"    latency ledger ({srv['ledger_requests']:.0f} "
+                f"requests audited, {viol:.0f} conservation "
+                f"violation(s)): {body or 'no spans'} ms")
+            if viol and srv.get("ledger_violation_last"):
+                add(f"      LAST VIOLATION: "
+                    f"{srv['ledger_violation_last']}")
+            for e in srv.get("ledger_top") or []:
+                causes = "  ".join(
+                    f"{c} {ms:.1f}" for c, ms in sorted(
+                        e.get("causes_ms", {}).items(),
+                        key=lambda kv: -kv[1]))
+                add(f"      #{e['uid']} ({e['finish_reason']}, "
+                    f"{e['tokens']} tok): {e['lifetime_ms']:.1f} ms "
+                    f"= {causes}")
         degraded = {k: srv.get(k, 0) for k in (
             "requests_timed_out", "requests_shed",
             "requests_drain_rejected", "requests_preempted",

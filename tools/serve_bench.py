@@ -177,6 +177,17 @@ def add_argument() -> argparse.Namespace:
                         "reason, token ids; redelivered recoveries "
                         "included) as one JSON list — the crash "
                         "drill's bitwise-comparison artifact")
+    p.add_argument("--ledger-out", type=str, default=None,
+                   help="write every delivered completion's latency "
+                        "ledger (serving/ledger.py) as one strict-JSON "
+                        "list: per-request (cause, start, end) "
+                        "intervals partitioning its wall lifetime, "
+                        "per-cause totals and token counts, and the "
+                        "conservation verdict (sum(intervals) == "
+                        "lifetime within the documented epsilon). "
+                        "Results redelivered from the journal carry "
+                        "ledger null — their wall detail belongs to "
+                        "the process that served them")
     p.add_argument("--flight-dump", type=str, default=None)
     p.add_argument("--metrics-port", type=int, default=None,
                    help="live telemetry plane: /metrics (Prometheus "
@@ -536,6 +547,13 @@ def main() -> int:
                                        key=lambda f: f.uid)], fh)
         print(f"[serve_bench] completions: {args.completions_out} "
               f"({len(completions)} requests)", file=sys.stderr)
+    if args.ledger_out:
+        from distributed_training_tpu.serving.ledger import dump_ledgers
+
+        n_rows, bad = dump_ledgers(args.ledger_out, completions)
+        print(f"[serve_bench] latency ledgers: {args.ledger_out} "
+              f"({n_rows} requests, {bad} conservation "
+              f"violation(s))", file=sys.stderr)
     if engine.journal is not None:
         # The client cursor: everything above is durably consumed
         # (printed / written out), so a future recovery must not
