@@ -580,6 +580,25 @@ class ServeConfig:
     # ones, so the on-disk footprint tracks in-flight work, not run
     # history.
     journal_segment_bytes: int = 1 << 20
+    # Radix-tree prefix cache (serving/prefix_cache.py; docs/SERVING.md
+    # "Prefix caching"): cross-request KV reuse over the paged pool.
+    # Finished sequences' full written pages stay indexed in a
+    # content-addressed trie (refcounted, LRU-evicted under pressure,
+    # flushed at every hot-swap barrier); a new request whose prompt
+    # starts with a resident page-aligned chain aliases those pages
+    # into its block table, commits only the non-resident tail, and
+    # prefills only that tail — shared system prompts and few-shot
+    # preambles prefill ONCE. Bitwise-neutral by construction: a hit
+    # changes prefill work, never a token (pinned by
+    # tests/test_prefix_cache.py). Requires the paged cache
+    # (kv_page_size set); the Engine refuses the combination with the
+    # legacy contiguous path, whose monolithic slot reservation has
+    # nothing to alias.
+    prefix_cache: bool = False
+    # Cap on pages the trie may hold (None = bounded only by the pool;
+    # LRU leaves evict past the cap). Smaller caps bound the resident
+    # working set when the pool is shared with deep decode traffic.
+    prefix_cache_pages: int | None = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -612,6 +631,16 @@ class ServeConfig:
         if self.prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {self.prefill_chunk}")
+        if self.prefix_cache and self.kv_page_size is None:
+            raise ValueError(
+                "prefix_cache requires the paged KV cache (set "
+                "kv_page_size): the legacy contiguous slot reservation "
+                "has no pages to alias across requests")
+        if self.prefix_cache_pages is not None \
+                and self.prefix_cache_pages < 1:
+            raise ValueError(
+                f"prefix_cache_pages must be >= 1 (or None), "
+                f"got {self.prefix_cache_pages}")
         if self.flush_every < 1:
             raise ValueError(
                 f"flush_every must be >= 1, got {self.flush_every}")

@@ -12,7 +12,16 @@ restated for XLA's static-shape world:
   head).
 - :mod:`pages` — the fixed-size KV page pool (PagedAttention's memory
   model, host half): free-list allocator with commitment-based
-  admission safety; physical page 0 reserved as the device null page.
+  admission safety and per-page reference counts (shared prefix pages
+  free exactly once, at the last holder); physical page 0 reserved as
+  the device null page.
+- :mod:`prefix_cache` — radix-tree prefix cache (SGLang RadixAttention
+  / vLLM automatic-prefix-caching shape): finished sequences' committed
+  page chains stay indexed in a content-addressed trie; a request whose
+  prompt starts with a resident page-aligned chain aliases those pages
+  into its block table and prefills only the tail. Refcounted, LRU
+  eviction under pressure, flushed at every hot-swap barrier; cache
+  hits are bitwise-neutral by construction.
 - :mod:`scheduler` — fixed decode slots; tier-strict tenant-fair refill
   (page-aware via a ``can_seat`` gate), LOSSLESS preempt-and-requeue of
   lower tiers under pressure (the evicted sequence re-prefills its
@@ -82,6 +91,9 @@ from distributed_training_tpu.serving.pages import (  # noqa: F401
     NULL_PAGE,
     PagePool,
     pages_for,
+)
+from distributed_training_tpu.serving.prefix_cache import (  # noqa: F401
+    PrefixCache,
 )
 from distributed_training_tpu.serving.queue import RequestQueue  # noqa: F401
 from distributed_training_tpu.serving.request import (  # noqa: F401

@@ -46,6 +46,20 @@ Shared discipline either way — masks, never shapes:
   decode is token-identical to the sequential ``Generator`` (pinned by
   ``tests/test_serving.py``).
 
+**Prefix caching** (``ServeConfig.prefix_cache``;
+``serving/prefix_cache.py``, docs/SERVING.md "Prefix caching"): a
+content-addressed radix trie indexes finished sequences' committed page
+chains at page granularity. A seat whose prompt starts with a resident
+page-aligned chain aliases those physical pages into its block table
+(refcounted), commits only the non-resident tail, and chunk-prefills
+only that tail — shared system prompts and few-shot preambles prefill
+ONCE across the fleet of requests. The n-gram drafter composes for
+free: it proposes from the host-side token stream, which a hit never
+changes — so speculation reads the reused prefix without touching a
+page. Bitwise-neutral by construction (a hit changes prefill work,
+never a gathered value or sampled token); every hot-swap barrier
+flushes the trie so old-weight KV cannot seed a new-epoch request.
+
 **Speculative decoding** (``ServeConfig.spec_k`` > 0;
 ``serving/speculative.py``, docs/SERVING.md "Speculative decoding"): a
 per-slot drafter proposes up to ``spec_k`` tokens each iteration and
@@ -102,6 +116,7 @@ from distributed_training_tpu.serving.ledger import (
     CAUSE_PRE_CRASH,
     CAUSE_PREEMPT_REQUEUE,
     CAUSE_PREFILL,
+    CAUSE_PREFIX_HIT,
     CAUSE_QUEUE_WAIT,
     CAUSE_RECOMPUTE,
     CAUSE_RECOVERY,
@@ -112,6 +127,7 @@ from distributed_training_tpu.serving.ledger import (
 )
 from distributed_training_tpu.serving.metrics import ServeTelemetry
 from distributed_training_tpu.serving.pages import PagePool, pages_for
+from distributed_training_tpu.serving.prefix_cache import PrefixCache
 from distributed_training_tpu.serving.queue import RequestQueue
 from distributed_training_tpu.serving.request import (
     FINISH_PREEMPT_TIMEOUT,
@@ -224,6 +240,11 @@ class Engine:
             # per-row overflow poison never fires on a masked lane.
             self._l_all = self.pages_per_slot * ps
         else:
+            if cfg.prefix_cache:
+                raise ValueError(
+                    "prefix_cache requires the paged KV cache "
+                    "(kv_page_size): the legacy contiguous slot "
+                    "reservation has no pages to alias across requests")
             self.page_size = None
             self.pool = None
             # One clone with the serving cache length; every compiled
@@ -244,6 +265,17 @@ class Engine:
                     f"validity-masked instead of written")
             self.model = model.clone(cache_len=cache_len)
 
+        # Radix-tree prefix cache (serving/prefix_cache.py): finished
+        # sequences' written page chains stay indexed; a seat whose
+        # prompt starts with a resident page-aligned chain aliases
+        # those pages, commits only the non-resident tail, and prefills
+        # only that tail. _kv_epoch stamps which weights wrote a seat's
+        # pages — every hot-swap barrier bumps it and flushes the trie,
+        # so old-weight KV can never seed a new-epoch request.
+        self.prefix_cache = (PrefixCache(self.page_size,
+                                         max_pages=cfg.prefix_cache_pages)
+                             if self.paged and cfg.prefix_cache else None)
+        self._kv_epoch = 0
         self.queue = RequestQueue(
             self.budget, default_max_new_tokens=cfg.max_new_tokens,
             max_depth=cfg.max_queue_depth,
@@ -317,6 +349,14 @@ class Engine:
                 np.asarray(self._base_rng).dtype)
             self._slot_pages: list[list[int]] = [[] for _ in range(s)]
             self._slot_commit_left = [0] * s
+            # Prefix-cache routing (serving/prefix_cache.py): how many
+            # LEADING entries of each slot's page list are ALIASED trie
+            # pages (the sequence holds a reference, never writes them),
+            # and the seated sequence itself — the engine needs its
+            # written token stream and KV epoch at page-release time to
+            # decide what enters the trie.
+            self._slot_shared = [0] * s
+            self._slot_seq: list[ActiveSequence | None] = [None] * s
             self._fused = jax.jit(
                 self._fused_impl, donate_argnums=(1,) if donate else ())
             self._decode = jax.jit(
@@ -625,12 +665,75 @@ class Engine:
             self._slot_pages[slot].extend(new)
             self._slot_commit_left[slot] -= len(new)
 
+    @staticmethod
+    def _written_tokens(seq: ActiveSequence) -> np.ndarray:
+        """The token values of every cache position ``seq`` actually
+        holds K/V for: ``prefill_pos`` positions while prefilling,
+        prompt + emitted-minus-last once decoding (the last emitted
+        token is never written back). This is the trie-insertion key
+        stream — K/V at position ``i`` is a pure function of tokens
+        ``0..i``, so a future request matching these tokens may alias
+        these pages bitwise-safely."""
+        if seq.prefilling:
+            # graftlint: disable=hot-path-transfer -- prefill_tokens is host numpy by contract (the prompt / resume prefix); no device value involved
+            return np.asarray(seq.prefill_tokens[:seq.prefill_pos],
+                              np.int32)
+        # graftlint: disable=hot-path-transfer -- emitted tokens are host ints by contract (note_token casts at landing); no device value involved
+        full = np.concatenate([seq.request.prompt,
+                               np.asarray(seq.tokens, np.int32)])
+        return full[:seq.request.prompt.size + len(seq.tokens) - 1]
+
+    @staticmethod
+    def _hit_cap(entry) -> int:
+        """Max cache positions a prefix hit may cover for ``entry``. A
+        fresh request keeps at least ONE prompt position to prefill —
+        the first token samples from the last prompt position's logits,
+        which must be computed, not remembered. A resumption that
+        already emitted tokens may be covered entirely: its incoming
+        token is known, so a full hit re-seats straight into decode."""
+        if isinstance(entry, ActiveSequence):
+            n = entry.prefill_tokens.size
+            return n if entry.tokens else n - 1
+        return entry.prompt.size - 1
+
     def _free_slot_pages(self, slot: int) -> None:
-        self.pool.free(self._slot_pages[slot],
+        """Release a slot's pages (finish, deadline eviction, or
+        preemption). With the prefix cache on, the sequence's FULL
+        written pages first enter the trie — private pages are adopted
+        (the slot's reference becomes the trie's), aliased prefix pages
+        just drop the slot's extra reference — so the next request
+        sharing the prefix (a preempted victim's own re-seat included)
+        hits instead of re-prefilling. Old-epoch pages (written before
+        the last hot-swap barrier) are never indexed: stale-weight KV
+        must not seed new-epoch requests."""
+        pages = self._slot_pages[slot]
+        seq = self._slot_seq[slot]
+        adopted: set[int] = set()
+        if (self.prefix_cache is not None and seq is not None and pages
+                and seq.kv_epoch == self._kv_epoch):
+            adopted, evicted = self.prefix_cache.insert_chain(
+                self._written_tokens(seq), pages, self.pool)
+            if adopted or evicted:
+                self.telemetry.on_prefix_pages(inserted=len(adopted),
+                                               evicted=evicted)
+        self.pool.free([p for p in pages if p not in adopted],
                        uncommit=max(self._slot_commit_left[slot], 0))
         self._slot_pages[slot] = []
+        self._slot_shared[slot] = 0
         self._slot_commit_left[slot] = 0
+        self._slot_seq[slot] = None
         self._tables[slot, :] = 0
+
+    def check_balanced(self) -> None:
+        """Leak audit at the drained steady state: every pool page free
+        or — prefix cache on — held by exactly the trie with exactly one
+        reference, nothing committed. The paged twin of the legacy
+        path's no-op (no pool, nothing to leak)."""
+        if self.pool is None:
+            return
+        self.pool.check_balanced(
+            cached=(self.prefix_cache.pages_held()
+                    if self.prefix_cache is not None else None))
 
     # -- latency ledger (serving/ledger.py) ----------------------------------
     @staticmethod
@@ -710,14 +813,51 @@ class Engine:
                 return True
             req = (entry.request if isinstance(entry, ActiveSequence)
                    else entry)
-            n_pages = self._req_pages(req)
+            # Prefix-cache sizing probe (read-only): the candidate
+            # commits only its NON-RESIDENT tail — a hit request admits
+            # with fewer pages, which is itself an admission-latency
+            # win under pool pressure.
+            hit_pages: list[int] = []
+            if self.prefix_cache is not None:
+                toks = (entry.prefill_tokens
+                        if isinstance(entry, ActiveSequence)
+                        else entry.prompt)
+                hit_pages = self.prefix_cache.probe(
+                    toks, max_tokens=self._hit_cap(entry))
+            n_pages = self._req_pages(req) - len(hit_pages)
+            # Reserved-page headroom for non-top tiers; waived when the
+            # pool serves nothing (no commitment, no active sequence —
+            # trie-held pages are evictable, not "in use"), so a lone
+            # best-effort request on an idle engine cannot deadlock
+            # against its own reserve.
+            headroom = (self.cfg.tier_reserved_pages
+                        if req.priority > 0 else 0)
+            if headroom and self.pool.committed == 0 \
+                    and self.scheduler.num_active == 0:
+                headroom = 0
+            if (self.prefix_cache is not None
+                    and self.pool.available < n_pages + headroom
+                    # O(1) futility guard: even reclaiming EVERY trie
+                    # page (the upper bound on what eviction can free)
+                    # would not cover the commitment — draining the
+                    # trie anyway would destroy restore chains and
+                    # re-walk it every admission poll for zero seats
+                    # gained. Leave it intact; preemption (or a
+                    # finishing sequence) is what changes the answer.
+                    and n_pages + headroom <= self.pool.available
+                    + self.prefix_cache.num_pages):
+                # LRU pressure eviction: unreferenced trie pages are
+                # reclaimable capacity — oldest first, the candidate's
+                # own matched chain pinned (evicting it would trade the
+                # hit for the headroom).
+                evicted = self.prefix_cache.evict_until(
+                    self.pool, n_pages + headroom,
+                    pinned=set(hit_pages))
+                if evicted:
+                    self.telemetry.on_prefix_pages(evicted=evicted)
             if not self.pool.can_commit(n_pages):
                 return False
-            if (req.priority > 0 and self.cfg.tier_reserved_pages
-                    and self.pool.available - n_pages
-                    < self.cfg.tier_reserved_pages
-                    and not (self.pool.num_allocated == 0
-                             and self.pool.committed == 0)):
+            if headroom and self.pool.available - n_pages < headroom:
                 return False
             return True
 
@@ -725,10 +865,58 @@ class Engine:
             if not self.paged:
                 return
             slot = seq.slot
-            self.pool.commit(self._req_pages(seq.request))
-            self._slot_pages[slot] = []
-            self._slot_commit_left[slot] = self._req_pages(seq.request)
+            # Claim the resident prefix (refcount per page) and alias
+            # it into the slot's block table; commit only the tail.
+            # can_seat just validated the tail commitment on this same
+            # pass — the trie cannot shrink in between (matched pages
+            # are pinned and referenced), only grow.
+            hit_pages: list[int] = []
+            if self.prefix_cache is not None:
+                hit_pages = self.prefix_cache.claim(
+                    seq.prefill_tokens, self.pool,
+                    max_tokens=self._hit_cap(seq))
+            worst = self._req_pages(seq.request)
+            self.pool.commit(worst - len(hit_pages))
+            self._slot_pages[slot] = list(hit_pages)
+            self._slot_shared[slot] = len(hit_pages)
+            self._slot_commit_left[slot] = worst - len(hit_pages)
+            self._slot_seq[slot] = seq
             self._tables[slot, :] = 0
+            for i, p in enumerate(hit_pages):
+                self._tables[slot, i] = p
+            hit = len(hit_pages) * self.page_size
+            seq.kv_epoch = self._kv_epoch
+            seq.prefix_hit_tokens = hit
+            # The chunk lane starts PAST the resident prefix: reused
+            # positions are never recomputed, which is the entire
+            # prefill-compute/TTFT win — and bitwise-free, because the
+            # aliased pages hold exactly the K/V a cold prefill of the
+            # same tokens would write (pinned by test_prefix_cache.py).
+            seq.prefill_pos = hit
+            if hit:
+                # Recompute debt covered by residency (a preempted
+                # victim re-seating onto its own pages, or a recovered
+                # request hitting an earlier recovery's chain): the
+                # preempt-and-RESTORE satellite — each recompute
+                # counter drops by what IT charged, to the divergent
+                # tail actually re-prefilled. Recovery debt credits
+                # first (it was billed first, at replay — and a
+                # recovered-then-preempted request's preempt charge is
+                # the younger one).
+                covered = min(hit, seq.recompute_owed)
+                seq.recompute_owed -= covered
+                rec_credit = min(covered, seq.recovery_owed)
+                seq.recovery_owed -= rec_credit
+                self.telemetry.on_prefix_hit(
+                    hit, restored_preempt=covered - rec_credit,
+                    restored_recovery=rec_credit)
+                if seq.request.ledger is not None:
+                    seq.request.ledger.add_tokens(CAUSE_PREFIX_HIT, hit)
+                if self.trace is not None:
+                    self.trace.instant(
+                        "prefix_cache.hit", track=f"slot {slot}",
+                        uid=seq.request.uid, tokens=hit,
+                        pages=len(hit_pages))
             # graftlint: disable=hot-path-transfer -- admission-boundary key landing: slot routing is host-side numpy by design
             self._slot_rng[slot] = np.asarray(
                 jax.random.fold_in(self._base_rng, seq.request.uid))
@@ -777,17 +965,55 @@ class Engine:
             # active ever let this candidate seat? On the legacy path a
             # freed slot is all a candidate can need; paged, the
             # preemptible pool must cover the candidate's worst-case
-            # commitment (a victim returns its held pages PLUS its
-            # unused commitment = exactly its own worst case), with the
-            # same reserved-page headroom can_seat applies. Without
-            # this bound a too-large candidate would evict best-effort
-            # work one sequence at a time for zero admission gained.
+            # commitment minus its resident prefix, with the same
+            # reserved-page headroom can_seat applies. Without this
+            # bound a too-large candidate would evict best-effort work
+            # one sequence at a time for zero admission gained.
+            #
+            # A victim's reclaimable footprint under the prefix cache:
+            # its PRIVATE pages + unused commitment free (or become
+            # trie-evictable after its insert) immediately. A SHARED
+            # page reclaims iff, once EVERY victim aliasing it lets go,
+            # no live holder remains except possibly the trie: count
+            # the victims holding it, and it is freeable when the
+            # residual holders are zero (frees outright) or exactly the
+            # trie's one reference (becomes LRU-evictable — can_seat's
+            # pressure eviction reclaims it on the re-poll). A residual
+            # NON-trie holder is a surviving sequence (e.g. two
+            # post-flush old-epoch sharers), and evicting the victim
+            # would free nothing — the futility the bound exists to
+            # catch. Never counted when the candidate's own hit chain
+            # pins the page.
             if not self.paged:
                 return True
             req = (entry.request if isinstance(entry, ActiveSequence)
                    else entry)
             need = self._req_pages(req)
-            freeable = sum(self._req_pages(v.request) for v in victims)
+            pinned: set[int] = set()
+            if self.prefix_cache is not None:
+                toks = (entry.prefill_tokens
+                        if isinstance(entry, ActiveSequence)
+                        else entry.prompt)
+                pinned = set(self.prefix_cache.probe(
+                    toks, max_tokens=self._hit_cap(entry)))
+                need -= len(pinned)
+            freeable = 0
+            shared_holders: dict[int, int] = {}
+            for v in victims:
+                slot = v.slot
+                shared_n = self._slot_shared[slot]
+                freeable += (len(self._slot_pages[slot]) - shared_n
+                             + max(self._slot_commit_left[slot], 0))
+                for pg in self._slot_pages[slot][:shared_n]:
+                    shared_holders[pg] = shared_holders.get(pg, 0) + 1
+            for pg, held_by_victims in shared_holders.items():
+                if pg in pinned:
+                    continue
+                residual = self.pool.refcount(pg) - held_by_victims
+                if residual == 0 or (
+                        residual == 1 and self.prefix_cache is not None
+                        and self.prefix_cache.holds(pg)):
+                    freeable += 1
             headroom = (self.cfg.tier_reserved_pages
                         if req.priority > 0 else 0)
             return self.pool.available + freeable >= need + headroom
@@ -834,6 +1060,11 @@ class Engine:
         if led is not None:
             rec = min(n, seq.recompute_owed)
             seq.recompute_owed -= rec
+            # A genuinely recomputed position's recovery charge stands;
+            # the recovery-attribution share just never exceeds the
+            # remaining debt (prefix-hit credit bookkeeping).
+            seq.recovery_owed = min(seq.recovery_owed,
+                                    seq.recompute_owed)
             if rec:
                 led.add_tokens(CAUSE_RECOMPUTE, rec)
             if n - rec:
@@ -1076,6 +1307,16 @@ class Engine:
             self._install_params(params)
             # graftlint: disable=hot-path-transfer -- epoch is a staged host int, not a device value
             self.weights_epoch = int(epoch)
+        # KV-identity barrier (serving/prefix_cache.py): cached pages
+        # hold K/V computed under the OLD weights — flush the trie
+        # inside the same barrier so no new-epoch request can alias
+        # them, and bump the epoch so in-flight old-epoch sequences
+        # (which legitimately keep their pages mid-sequence) never
+        # re-index them at finish. Pages still aliased by in-flight
+        # sequences stay allocated under their remaining references.
+        self._kv_epoch += 1
+        if self.prefix_cache is not None:
+            self.prefix_cache.flush(self.pool)
         if self.journal is not None:
             # The journal's weights-identity tail marker: recovery must
             # be able to see which epoch produced the records after
@@ -1241,6 +1482,11 @@ class Engine:
                 if led is not None:
                     rec = min(c, chunk_seq.recompute_owed)
                     chunk_seq.recompute_owed -= rec
+                    # Recovery-attribution share never exceeds the
+                    # remaining debt (prefix-hit credit bookkeeping).
+                    chunk_seq.recovery_owed = min(
+                        chunk_seq.recovery_owed,
+                        chunk_seq.recompute_owed)
                     if rec:
                         led.add_tokens(CAUSE_RECOMPUTE, rec)
                     if c - rec:
@@ -1555,6 +1801,15 @@ class Engine:
         Returns the report dict; also stored as ``recovery_report``.
         A journal-less engine returns an empty report. The /healthz
         phase reads ``recovering`` while this runs.
+
+        The prefix cache COLD-STARTS across a restart: the trie is
+        in-memory state whose pages died with the old process, and
+        rebuilding it is a pure performance concern — reuse changes
+        which pages a block table aliases, never a token, so
+        redelivered results stay bitwise and resumed requests recompute
+        bitwise either way. The trie repopulates naturally as recovered
+        requests re-prefill and finish (later recoveries sharing a
+        prefix with earlier ones hit it mid-replay).
         """
         report: dict[str, Any] = {
             "redelivered": [], "completed_at_replay": [],
@@ -1766,6 +2021,12 @@ class Engine:
         # Live weight hot-swap: the deployed epoch joins the telemetry's
         # swaps_completed/swaps_rejected/swap_blocked_s counters.
         stats["weights_epoch"] = int(self.weights_epoch)
+        # Prefix cache: the trie's resident-page gauge (the hit/insert/
+        # evict counters live in the telemetry window); 0 when off so
+        # downstream JSON consumers need no key guard.
+        stats["prefix_cache_pages_held"] = (
+            self.prefix_cache.num_pages
+            if self.prefix_cache is not None else 0)
         return stats
 
     def reset_stats(self) -> None:

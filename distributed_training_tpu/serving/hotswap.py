@@ -39,7 +39,12 @@ errors.SwapError` + ``swaps_rejected``):
    pause to ``swap_blocked_s`` engine-wide AND to each in-flight
    request's latency ledger as a ``swap_barrier`` interval
    (serving/ledger.py — the per-request answer to "which p99 did this
-   deploy eat"), and bumps ``weights_epoch``. Two
+   deploy eat"), and bumps ``weights_epoch``. The barrier also FLUSHES
+   the radix prefix cache (serving/prefix_cache.py) and bumps the
+   engine's KV epoch: cached pages hold K/V computed under the old
+   weights, which must never seed a new-epoch request — in-flight
+   sequences keep their pages mid-sequence (the documented hot-swap
+   contract) but can no longer index them into the trie at finish. Two
    engines fed the same requests with the swap forced at the same
    iteration produce bitwise-identical outputs (pinned by
    ``tests/test_hotswap.py``).

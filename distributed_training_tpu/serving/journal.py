@@ -59,6 +59,15 @@ recovery maps them back into the new process's ``perf_counter``
 timeline — downtime keeps billing against TTFT/total deadlines, which
 is exactly what "the clock keeps running" must mean across a restart.
 
+The radix prefix cache (``serving/prefix_cache.py``) is deliberately
+NOT journaled: the trie indexes in-memory KV pages that die with the
+process, and reuse is performance-only — a hit changes which pages a
+block table aliases, never a token. Recovery therefore COLD-STARTS the
+trie (its fingerprint knobs are absent from ``cfg`` for the same
+lane-independence reason as the paging/batch knobs) and replay
+repopulates it as recovered requests re-prefill and finish; redelivered
+results stay bitwise either way.
+
 ``Engine.recover()`` (serving/engine.py) owns the replay semantics;
 this module owns bytes, segments and the durable state machine.
 """

@@ -91,10 +91,17 @@ LEDGER_CAUSES = (
 
 CAUSE_SPEC_DRAFT = "spec_draft"
 CAUSE_SPEC_ACCEPT = "spec_accept"
+# Prefix-cache reuse (serving/prefix_cache.py): cache positions a seat
+# found already RESIDENT in the paged pool and aliased instead of
+# prefilling. A token cause only — reused positions cost no wall time
+# by construction (they are skipped, not computed), which is exactly
+# how "reused-prefix time bills nothing to prefill" holds: the prefill
+# token counter covers only the tail the sequence actually wrote.
+CAUSE_PREFIX_HIT = "prefix_hit"
 
 # Deterministic token-count keys (``ledger_tokens_<cause>``).
 TOKEN_CAUSES = (CAUSE_PREFILL, CAUSE_DECODE, CAUSE_RECOMPUTE,
-                CAUSE_SPEC_DRAFT, CAUSE_SPEC_ACCEPT)
+                CAUSE_SPEC_DRAFT, CAUSE_SPEC_ACCEPT, CAUSE_PREFIX_HIT)
 
 # Conservation tolerance in seconds (see module docstring: float
 # summation error only — the stamps themselves telescope exactly).

@@ -157,6 +157,22 @@ class ServeTelemetry:
         self.ledger_conservation_violations = 0
         self.ledger_violation_last: str | None = None
         self.ledger_top: list[dict[str, Any]] = []
+        # Prefix-cache accounting (serving/prefix_cache.py): cache
+        # positions seats found resident and aliased instead of
+        # prefilling (hit_tokens — THE prefill-compute-saved counter,
+        # deterministic under the bench's virtual-time drive because
+        # trie state is a pure function of the seeded completion
+        # order), SEATS with a nonzero hit (a preempted request's
+        # restore re-seat counts again — this can exceed
+        # requests_finished under preemption churn, it is not a
+        # per-request hit rate), and the trie's page churn
+        # (adopted at finish / evicted under cap-or-pool pressure; a
+        # swap-barrier flush counts in neither — it is deployment
+        # hygiene, not memory pressure). All bench-gated zero-drift.
+        self.prefix_cache_hit_tokens = 0
+        self.prefix_cache_hit_requests = 0
+        self.prefix_cache_inserted_pages = 0
+        self.prefix_cache_evicted_pages = 0
         # Admission-latency breakdown: queueing vs prefill compute.
         self.queue_wait_ms: list[float] = []
         self.prefill_ms: list[float] = []
@@ -322,6 +338,37 @@ class ServeTelemetry:
         self.preempted_token_recompute += int(recompute_tokens)
         t = min(max(int(tier), 0), self.num_tiers - 1)
         self.tier_preempted[t] += 1
+
+    def on_prefix_hit(self, tokens: int, *, restored_preempt: int = 0,
+                      restored_recovery: int = 0) -> None:
+        """One seat aliased ``tokens`` resident prefix positions instead
+        of prefilling them. The ``restored_*`` counts covered recompute
+        debt a preemption / crash recovery had already billed — the
+        preempt-and-RESTORE satellite: each recompute counter drops by
+        exactly what IT was charged, down to the divergent tail the
+        re-seat will actually re-prefill (clamped at zero; the debt was
+        charged in full at eviction/replay time, so mid-flight scrapes
+        may transiently overstate it until the re-seat lands its
+        hit). Counts one SEAT per call — a preempted request's restore
+        re-seat that hits again increments hit_requests again, so the
+        counter is seats-that-hit, not distinct requests."""
+        self.prefix_cache_hit_tokens += int(tokens)
+        self.prefix_cache_hit_requests += 1
+        if restored_recovery:
+            self.tokens_recomputed_on_recovery = max(
+                self.tokens_recomputed_on_recovery
+                - int(restored_recovery), 0)
+        if restored_preempt:
+            self.preempted_token_recompute = max(
+                self.preempted_token_recompute - int(restored_preempt), 0)
+
+    def on_prefix_pages(self, *, inserted: int = 0,
+                        evicted: int = 0) -> None:
+        """Trie page churn: ``inserted`` pages adopted from finishing
+        sequences, ``evicted`` reclaimed by LRU pressure (cap or pool
+        exhaustion; swap flushes count in neither)."""
+        self.prefix_cache_inserted_pages += int(inserted)
+        self.prefix_cache_evicted_pages += int(evicted)
 
     def on_recovered(self, requests: int, recompute_tokens: int) -> None:
         """Journal replay landed: ``requests`` were reconstructed from
@@ -519,6 +566,18 @@ class ServeTelemetry:
                 self.page_iters_allocated / self.page_iters_total
                 if self.page_iters_total else 0.0),
             "kv_pages_allocated_iters": int(self.page_iters_allocated),
+            # Prefix cache (serving/prefix_cache.py): reuse economics —
+            # hit_tokens is prefill compute SAVED in cache positions
+            # (deterministic under --virtual-dt, bench-gated), the page
+            # counters are the trie's churn. pages_held is merged by
+            # Engine.stats() (a gauge owned by the trie itself).
+            "prefix_cache_hit_tokens": int(self.prefix_cache_hit_tokens),
+            "prefix_cache_hit_requests":
+                int(self.prefix_cache_hit_requests),
+            "prefix_cache_inserted_pages":
+                int(self.prefix_cache_inserted_pages),
+            "prefix_cache_evicted_pages":
+                int(self.prefix_cache_evicted_pages),
             "queue_wait_p50_ms": pct(self.queue_wait_ms, 50),
             "queue_wait_p95_ms": pct(self.queue_wait_ms, 95),
             "prefill_p50_ms": pct(self.prefill_ms, 50),

@@ -33,6 +33,23 @@ pages stay with the slot (they are inside the commitment) and the next
 window overwrites them, so accept-rewind cycles keep
 :meth:`PagePool.check_balanced` green (pinned by
 ``tests/test_speculative.py``).
+
+**Shared pages** (the radix prefix cache, ``serving/prefix_cache.py``):
+an allocated page carries a REFERENCE COUNT — the cache's trie holds
+one reference on every page it indexes, and every sequence whose block
+table aliases a cached prefix page holds another (:meth:`PagePool.
+incref` at seat). :meth:`free` releases ONE reference per call; the
+page returns to the free list only when the last holder lets go, so a
+prefix shared by the trie and three running sequences is freed exactly
+once no matter which order they finish in. Reads through aliased
+tables are safe by construction (the paged gather is read-only);
+writes never land in a shared page because a prefix hit is page-ALIGNED
+— the new sequence's first write position sits at or past the aliased
+region's end, in a private page of its own. Commitment accounting is
+per-holder: a hit request commits only its non-resident tail, so the
+``uncommit`` a finishing sequence returns is exactly what IT promised —
+shared pages release no commitment twice (pinned by
+``tests/test_prefix_cache.py``).
 """
 
 from __future__ import annotations
@@ -75,6 +92,11 @@ class PagePool:
         # working set of device pages dense (and reuse deterministic).
         self._free: list[int] = list(range(self.num_pages, 0, -1))
         self._allocated: set[int] = set()
+        # page id -> reference count (only for allocated pages; alloc
+        # starts at 1, incref adds holders, free releases one — the page
+        # returns to the free list at zero). The prefix cache's trie and
+        # every sequence aliasing one of its pages each hold one ref.
+        self._refs: dict[int, int] = {}
         self.committed = 0  # pages promised to seated requests, unallocated
 
     # -- views ---------------------------------------------------------------
@@ -140,31 +162,76 @@ class PagePool:
                 f"page_size={self.page_size})")
         pages = [self._free.pop() for _ in range(n)]
         self._allocated.update(pages)
+        for p in pages:
+            self._refs[p] = 1
         if committed:
             self.committed -= n
         return pages
 
+    def incref(self, pages: list[int]) -> None:
+        """Add one holder to each of ``pages`` (prefix-cache sharing:
+        the trie indexing a page, or a sequence aliasing one into its
+        block table). Raises on a page that is not allocated — a ref on
+        a free page would resurrect garbage."""
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(
+                    f"cannot incref page {p}: not allocated (the null "
+                    f"page, a freed page, or a foreign id)")
+            self._refs[p] += 1
+
+    def refcount(self, page: int) -> int:
+        """Current holder count (0 for a free/foreign page)."""
+        return self._refs.get(page, 0)
+
     def free(self, pages: list[int], *, uncommit: int = 0) -> None:
-        """Return ``pages`` to the pool, plus ``uncommit`` unused
-        commitments (a request that finished early via EOS/timeout never
-        allocated its worst case)."""
+        """Release ONE reference on each of ``pages`` (plus ``uncommit``
+        unused commitments — a request that finished early via
+        EOS/timeout never allocated its worst case). A page returns to
+        the free list only when its last holder releases it; unshared
+        pages (refcount 1, the pre-prefix-cache norm) free immediately,
+        and releasing a page that holds no reference still raises — a
+        double free is a bug whether or not the page was shared."""
         for p in pages:
             if p not in self._allocated:
                 raise ValueError(
                     f"page {p} is not allocated (double free, the null "
                     f"page, or a foreign id)")
-            self._allocated.discard(p)
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._allocated.discard(p)
+                self._free.append(p)
         if uncommit:
             self.release(uncommit)
 
-    def check_balanced(self) -> None:
+    def check_balanced(self, cached: "set[int] | None" = None) -> None:
         """Invariant audit: every page is exactly free or allocated and
         nothing is committed — the post-drain steady state. Raises
-        ``AssertionError`` with the leak arithmetic otherwise."""
+        ``AssertionError`` with the leak arithmetic otherwise.
+
+        ``cached`` is the prefix cache's held-page set
+        (``PrefixCache.pages_held()``): with a trie attached, the drained
+        steady state legitimately keeps pages allocated — but then every
+        allocated page must be EXACTLY a trie page with EXACTLY one
+        reference (the trie's). A page the trie holds that the pool
+        thinks is free, a page no one holds that never came back, or a
+        stranded sequence reference all fail here. ``cached=None``
+        (no prefix cache) additionally demands refcounts degenerate to
+        the pre-sharing shape: one holder per allocated page."""
         assert len(self._free) + len(self._allocated) == self.num_pages, (
             f"page leak: {len(self._free)} free + {len(self._allocated)} "
             f"allocated != {self.num_pages} total")
         assert self.committed == 0, (
             f"{self.committed} committed page(s) never released")
         assert not (set(self._free) & self._allocated), "page aliased"
+        if cached is not None:
+            assert self._allocated == set(cached), (
+                f"prefix-cache page drift: pool holds "
+                f"{sorted(self._allocated - set(cached))} outside the "
+                f"trie; trie claims {sorted(set(cached) - self._allocated)} "
+                f"the pool freed")
+        stranded = {p: n for p, n in self._refs.items() if n != 1}
+        assert not stranded, (
+            f"stranded page references at steady state (holder leaked): "
+            f"{stranded}")

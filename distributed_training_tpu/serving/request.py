@@ -135,6 +135,23 @@ class ActiveSequence:
     # from the queue dies with its debt unconsumed (nothing was
     # recomputed, so nothing is billed).
     recompute_owed: int = 0
+    # Prefix-cache state (serving/prefix_cache.py). kv_epoch stamps
+    # WHICH weights wrote this seat's KV pages (the engine bumps its
+    # epoch at every hot-swap barrier): a sequence whose pages predate
+    # the serving weights must not index them into the trie at finish —
+    # old-weight KV must never seed a new-epoch request.
+    # prefix_hit_tokens is the resident prefix this seat aliased
+    # instead of prefilling (0 = cold); re-stamped at every re-seat.
+    kv_epoch: int = 0
+    prefix_hit_tokens: int = 0
+    # The portion of recompute_owed that was charged to the RECOVERY
+    # counter (tokens_recomputed_on_recovery, billed up front by
+    # Engine.recover()) rather than to preempted_token_recompute: a
+    # prefix-cache hit that covers debt credits each counter back by
+    # what it was actually charged. Maintained as recovery-first on
+    # hits and clamped under recompute_owed when chunks genuinely
+    # recompute (a recomputed position's charge legitimately stands).
+    recovery_owed: int = 0
 
     @property
     def prefill_tokens(self) -> np.ndarray:
@@ -179,8 +196,12 @@ class ActiveSequence:
                 req.prompt, np.asarray(seq.tokens[:-1], np.int32)])
             # The recovery re-prefill rewrites exactly the positions
             # the crash lost — the same count Engine.recover() reports
-            # as tokens_recomputed_on_recovery.
+            # as tokens_recomputed_on_recovery (recovery_owed tracks
+            # that attribution so a prefix-cache hit covering the debt
+            # credits the recovery counter, not the preemption one —
+            # even when the journal also restored pre-crash preempts).
             seq.recompute_owed = req.prompt.size + len(seq.tokens) - 1
+            seq.recovery_owed = seq.recompute_owed
         return seq
 
     def prepare_resume(self) -> None:

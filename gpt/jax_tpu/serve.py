@@ -65,6 +65,22 @@ def add_argument() -> argparse.Namespace:
                         help="LEGACY prefill (--kv-page-size 0): prompt "
                              "lengths pad to a multiple of this (bounds "
                              "prefill compile count)")
+    parser.add_argument("--prefix-cache",
+                        action=argparse.BooleanOptionalAction,
+                        default=False,
+                        help="radix-tree prefix cache over the paged "
+                             "pool (docs/SERVING.md 'Prefix caching'): "
+                             "finished requests' KV page chains stay "
+                             "indexed and a prompt sharing a "
+                             "page-aligned prefix aliases them, "
+                             "prefilling only the tail — shared system "
+                             "prompts prefill once. Bitwise-neutral; "
+                             "flushed at every hot-swap barrier. "
+                             "Requires paged mode (--kv-page-size > 0)")
+    parser.add_argument("--prefix-cache-pages", type=int, default=None,
+                        help="cap on pool pages the prefix-cache trie "
+                             "may hold (LRU leaves evict past it); "
+                             "default unbounded within the pool")
     # Speculative decoding (docs/SERVING.md "Speculative decoding").
     parser.add_argument("--spec-k", type=int, default=0,
                         help="speculative decoding: draft tokens "
@@ -296,6 +312,8 @@ def main() -> int:
         kv_pages=args.kv_pages,
         prefill_chunk=args.prefill_chunk,
         prefill_bucket=args.prefill_bucket,
+        prefix_cache=args.prefix_cache,
+        prefix_cache_pages=args.prefix_cache_pages,
         spec_k=args.spec_k,
         spec_drafter=args.spec_drafter,
         spec_ngram=args.spec_ngram,

@@ -128,6 +128,20 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     "ledger_tokens_recompute": ("both", 0.0),
     "ledger_tokens_spec_draft": ("both", 0.0),
     "ledger_tokens_spec_accept": ("both", 0.0),
+    # radix-tree prefix cache (serving/prefix_cache.py): under the
+    # bench's --virtual-dt drive the trie's state is a pure function of
+    # the seeded completion order, so the reuse counters are zero-drift
+    # workload-deterministic like the scheduling counters. hit_tokens
+    # is prefill compute SAVED — falling means the cache stopped
+    # hitting (a keying or eviction regression) even when wall numbers
+    # hide it; the page-churn counters gate bitwise. All exactly zero
+    # on prefix-cache-off rows (zero-baseline semantics keep growth
+    # from hiding there).
+    "prefix_cache_hit_tokens": ("higher", 0.0),
+    "prefix_cache_hit_requests": ("both", 0.0),
+    "prefix_cache_inserted_pages": ("both", 0.0),
+    "prefix_cache_evicted_pages": ("both", 0.0),
+    "ledger_tokens_prefix_hit": ("both", 0.0),
     # crash-durable serving (serving/journal.py): recovery counters are
     # pure functions of the journal's durable state — on the no-crash
     # smoke rows BOTH must stay exactly zero (any drift means requests

@@ -139,6 +139,18 @@ def render(summary: dict) -> str:
                 f"{srv['page_pool_occupancy_mean']:.1%}  "
                 f"({srv.get('kv_pages_allocated_iters', 0)} "
                 f"page-iters allocated)")
+        # Radix-tree prefix cache (serving/prefix_cache.py): reuse
+        # economics — prefill compute saved, trie page churn/residency.
+        if (srv.get("prefix_cache_hit_requests")
+                or srv.get("prefix_cache_pages_held")):
+            add(f"    prefix cache: "
+                f"{srv.get('prefix_cache_hit_tokens', 0):.0f} tok reused "
+                f"across {srv.get('prefix_cache_hit_requests', 0):.0f} "
+                f"hit(s)  |  pages "
+                f"{srv.get('prefix_cache_inserted_pages', 0):.0f} "
+                f"indexed / {srv.get('prefix_cache_evicted_pages', 0):.0f}"
+                f" evicted / {srv.get('prefix_cache_pages_held', 0):.0f} "
+                f"held")
         # Live weight hot-swap (serving/hotswap.py): deployment
         # counters + the explicitly-attributed barrier pause.
         if srv.get("swaps_completed") or srv.get("swaps_rejected"):

@@ -98,6 +98,21 @@ def add_argument() -> argparse.Namespace:
     p.add_argument("--prefill-chunk", type=int, default=64,
                    help="chunked prefill: prompt tokens prefilled per "
                         "decode iteration (paged mode)")
+    p.add_argument("--prefix-cache", action=argparse.BooleanOptionalAction,
+                   default=False,
+                   help="radix-tree prefix cache over the paged pool "
+                        "(docs/SERVING.md 'Prefix caching'): finished "
+                        "requests' KV page chains stay indexed, and a "
+                        "request sharing a page-aligned token prefix "
+                        "aliases them and prefills only the tail — "
+                        "bitwise-neutral, pure TTFT/prefill-compute "
+                        "win on shared-boilerplate traffic (pair with "
+                        "--scenario shared_prefix). Requires paged "
+                        "mode (--kv-page-size > 0)")
+    p.add_argument("--prefix-cache-pages", type=int, default=None,
+                   help="cap on pool pages the prefix-cache trie may "
+                        "hold (LRU leaves evict past it); default "
+                        "unbounded within the pool")
     p.add_argument("--prefill-bucket", type=int, default=16,
                    help="LEGACY prefill bucketing (--kv-page-size 0)")
     p.add_argument("--spec-k", type=int, default=0,
@@ -268,6 +283,8 @@ def main() -> int:
         kv_pages=args.kv_pages,
         prefill_chunk=args.prefill_chunk,
         prefill_bucket=args.prefill_bucket,
+        prefix_cache=args.prefix_cache,
+        prefix_cache_pages=args.prefix_cache_pages,
         spec_k=args.spec_k, spec_drafter=args.spec_drafter,
         spec_ngram=args.spec_ngram,
         spec_draft_window=args.spec_draft_window,
@@ -517,10 +534,12 @@ def main() -> int:
         f"expected {expected} ({n} requests, scenario resumed at "
         f"{submitted_start}, {recovered_n} recovered)")
     if engine.paged:
-        # Leak audit: every page back on the free list, no stranded
-        # commitment — speculation's accept-rewind included (the CI
-        # speculation leg runs on this assertion).
-        engine.pool.check_balanced()
+        # Leak audit: every page back on the free list (or held by
+        # exactly the prefix-cache trie at one reference each), no
+        # stranded commitment — speculation's accept-rewind and the
+        # prefix cache's aliasing/eviction churn included (the CI
+        # speculation and prefix-cache legs run on this assertion).
+        engine.check_balanced()
 
     if compile_watch is not None:
         from distributed_training_tpu.observability.sanitizer import (

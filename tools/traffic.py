@@ -237,6 +237,52 @@ def _preempt_storm(rng: np.random.RandomState,
     return out
 
 
+_SHARED_PREFIX_TENANTS = ("alpha", "beta", "gamma")
+
+
+def _shared_prefix(rng: np.random.RandomState,
+                   p: ScenarioParams) -> list[TrafficRequest]:
+    """The prefix-cache workload (serving/prefix_cache.py): N tenants,
+    each with a small pool of COMMON system-prompt preambles, every
+    request = one preamble + a short unique suffix. Preamble choice is
+    Zipf-shared (a few boilerplates dominate, a tail is rare) — the
+    production shape where most prompt tokens are shared across
+    requests, so a radix prefix cache should collapse most prefill
+    compute after each preamble's first (cold) request.
+
+    Deterministic like every scenario: the preamble pools are drawn
+    ONCE up front from the seeded rng, then arrivals/choices/suffixes
+    in one fixed pass — a pure function of (seed, params)."""
+    pool_size = 4
+    # Long preambles, short suffixes: the shared mass dominates, and a
+    # preamble spans several kv_page_size pages so the trie match is
+    # deep. Leave 8 suffix positions of admissibility headroom.
+    pre_hi = max(p.max_prompt_len - 8, 1)
+    pre_lo = min(max(p.mean_prompt_len, 1), pre_hi)
+    preambles = {
+        tenant: [rng.randint(0, p.vocab_size,
+                             size=int(rng.randint(pre_lo, pre_hi + 1))
+                             ).astype(np.int32)
+                 for _ in range(pool_size)]
+        for tenant in _SHARED_PREFIX_TENANTS}
+    t = np.cumsum(rng.exponential(1.0 / p.rate, size=p.requests))
+    out: list[TrafficRequest] = []
+    for i in range(p.requests):
+        tenant = _SHARED_PREFIX_TENANTS[
+            int(rng.randint(len(_SHARED_PREFIX_TENANTS)))]
+        pre = preambles[tenant][
+            min(int(rng.zipf(1.5)) - 1, pool_size - 1)]
+        suffix = rng.randint(0, p.vocab_size,
+                             size=int(rng.randint(1, 9))).astype(np.int32)
+        prompt = np.concatenate([pre, suffix])[
+            :min(p.max_prompt_len, p.budget - 1)]
+        mnt = max(min(p.max_new_tokens, p.budget - prompt.size), 1)
+        out.append(TrafficRequest(
+            arrival_s=float(t[i]), prompt=prompt, max_new_tokens=mnt,
+            priority=0, tenant=tenant))
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class Scenario:
     """Registry entry: the builder plus the tier/fairness defaults the
@@ -272,6 +318,10 @@ SCENARIOS: dict[str, Scenario] = {
                               "slots filled with best-effort work, "
                               "then high-tier waves force repeated "
                               "lossless preemptions"),
+    "shared_prefix": Scenario(_shared_prefix, 1, None,
+                              "tenants sharing Zipf-weighted "
+                              "system-prompt preambles + unique "
+                              "suffixes (the prefix-cache workload)"),
 }
 
 
