@@ -599,6 +599,29 @@ class ServeConfig:
     # LRU leaves evict past the cap). Smaller caps bound the resident
     # working set when the pool is shared with deep decode traffic.
     prefix_cache_pages: int | None = None
+    # Quantized execution (serving/quantize.py; docs/SERVING.md
+    # "Quantized execution"). quantize_weights=True quantizes the
+    # transformer's matmul weights (embedding/attention/MLP kernels) to
+    # symmetric per-channel int8 ONCE — at engine construction and at
+    # hot-swap arm time on the watcher thread, never inside
+    # Engine.step. Layernorms, biases, the positional table and the
+    # logits head stay high-precision. Deterministic round-to-nearest:
+    # the quantized engine is bitwise-reproducible across runs and
+    # batch-composition-independent, quality-bounded rather than
+    # bit-equal to fp32 (CI pins greedy exact-match >= 0.98 on the
+    # smoke corpus).
+    quantize_weights: bool = False
+    # KV cache storage dtype for the paged pool: None = model dtype
+    # (fp32 pools today), "int8" = pages stored int8 with per-row
+    # per-head fp32 scales alongside, quantize-on-scatter /
+    # dequantize-in-gather inside the same two compiled programs
+    # (inventory grows by zero — sanitizer-pinned). Roughly quarters
+    # KV bytes/token vs fp32, so the same kv_pages HBM holds ~4x the
+    # tokens; prefix-cache/preemption/journal/speculation operate on
+    # quantized pages unchanged (content addressing is host-token-
+    # keyed). Requires the paged cache (kv_page_size set): the legacy
+    # contiguous path keeps full-precision slots.
+    kv_dtype: str | None = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -692,6 +715,15 @@ class ServeConfig:
                 f"journal_segment_bytes must be >= 4096 (a segment "
                 f"must hold more than one compaction header), got "
                 f"{self.journal_segment_bytes}")
+        if self.kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (model dtype) or 'int8', "
+                f"got {self.kv_dtype!r}")
+        if self.kv_dtype is not None and self.kv_page_size is None:
+            raise ValueError(
+                "kv_dtype requires the paged KV cache (set "
+                "kv_page_size): the legacy contiguous path keeps "
+                "full-precision slots")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -30,6 +30,42 @@ import jax.numpy as jnp
 from distributed_training_tpu.parallel.ring_attention import RingSelfAttention
 
 
+class QuantFriendlyDense(nn.Dense):
+    """``nn.Dense`` with its ``__call__`` restated so the kernel
+    use-site is ``astype``.
+
+    A SUBCLASS (not a from-scratch module) so every
+    ``isinstance(mod, nn.Dense)`` dispatch keeps firing — the TP
+    ring-overlap interceptors (parallel/collective_matmul.py) match
+    fc1/fc2 by exactly that test and bypass the param shape check for
+    their pre-sharded kernels. Params are the parent's (same names,
+    same lecun_normal/zeros initializers, same RNG stream) and the math
+    is bitwise-identical for plain fp32 trees. The one deliberate
+    difference: the kernel reaches the matmul through
+    ``kernel.astype(dtype)``, so when the serving engine binds a
+    per-channel int8 :class:`~distributed_training_tpu.serving.quantize.
+    QuantizedTensor` in the kernel's place, that same call dequantizes
+    it (duck-typed ``astype``) and the module needs no quantization
+    branch. ``nn.Dense``'s own ``promote_dtype`` would try to
+    ``jnp.asarray`` the quantized node and fail.
+    """
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", self.kernel_init,
+                            (jnp.shape(x)[-1], self.features),
+                            self.param_dtype)
+        bias = self.param("bias", self.bias_init, (self.features,),
+                          self.param_dtype)
+        d = self.dtype or jnp.float32
+        x = x.astype(d)
+        y = jax.lax.dot_general(
+            x, kernel.astype(d),
+            (((x.ndim - 1,), (0,)), ((), ())))
+        return y + jnp.reshape(bias.astype(d),
+                               (1,) * (y.ndim - 1) + (-1,))
+
+
 class MlpBlock(nn.Module):
     """Position-wise transformer MLP (fc1 → GELU → fc2).
 
@@ -43,9 +79,9 @@ class MlpBlock(nn.Module):
     @nn.compact
     def __call__(self, x):
         d = x.shape[-1]
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
+        h = QuantFriendlyDense(self.mlp_dim, dtype=self.dtype, name="fc1")(x)
         h = nn.gelu(h)
-        return nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+        return QuantFriendlyDense(d, dtype=self.dtype, name="fc2")(h)
 
 
 class DecoderBlock(nn.Module):
@@ -73,6 +109,7 @@ class DecoderBlock(nn.Module):
     cache_len: int | None = None
     kv_page_size: int | None = None
     kv_pages: int | None = None
+    kv_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
@@ -83,6 +120,7 @@ class DecoderBlock(nn.Module):
             axis_name=self.seq_axis, causal=True,
             attn_impl=self.attn_impl, cache_len=self.cache_len,
             kv_page_size=self.kv_page_size, kv_pages=self.kv_pages,
+            kv_dtype=self.kv_dtype,
             name="attn")(y, decode=decode, pages=pages)
         if self.dropout_rate:
             y = nn.Dropout(self.dropout_rate, deterministic=not train)(y)
@@ -137,10 +175,46 @@ def moe_layer_experts(num_layers: int, moe_every: int,
     return dict(zip(layers, counts))
 
 
-def make_tok_embed(m: "TransformerLM", name: str | None = None) -> nn.Embed:
+class QuantFriendlyEmbed(nn.Module):
+    """``nn.Embed`` restated to tolerate a per-row int8 quantized table.
+
+    Param-compatible with ``nn.Embed`` (same ``embedding`` name, same
+    variance-scaling init, fp32 param dtype) and bitwise-identical for
+    plain tables (astype-then-take ≡ take-then-astype for a dtype-
+    preserving cast). When the serving engine binds a per-row
+    :class:`~distributed_training_tpu.serving.quantize.QuantizedTensor`
+    ([vocab, D] int8 + [vocab, 1] scales), the lookup gathers int8 rows
+    AND their scales, dequantizing only the gathered rows — the full
+    table never materializes in fp32. Duck-typed on the node's
+    ``q``/``scale`` attributes so the models layer stays import-free of
+    the serving package.
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs):
+        embedding = self.param(
+            "embedding",
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal",
+                                             out_axis=0),
+            (self.num_embeddings, self.features), jnp.float32)
+        q = getattr(embedding, "q", None)
+        if q is not None:  # quantized table: gather rows + row scales
+            rows = jnp.take(q, inputs, axis=0).astype(self.dtype)
+            scales = jnp.take(embedding.scale, inputs,
+                              axis=0).astype(self.dtype)
+            return rows * scales
+        return jnp.take(embedding.astype(self.dtype), inputs, axis=0)
+
+
+def make_tok_embed(m: "TransformerLM", name: str | None = None):
     """Token-embedding module; single source of its config for both the
     plain model and the pipelined executor (``parallel/pipeline.py``)."""
-    return nn.Embed(m.vocab_size, m.hidden_dim, dtype=m.dtype, name=name)
+    return QuantFriendlyEmbed(m.vocab_size, m.hidden_dim, dtype=m.dtype,
+                              name=name)
 
 
 def make_final_norm(m: "TransformerLM", name: str | None = None) -> nn.LayerNorm:
@@ -216,6 +290,12 @@ class TransformerLM(nn.Module):
     # params are identical either way.
     kv_page_size: int | None = None
     kv_pages: int | None = None
+    # Paged-pool KV storage dtype: None = model dtype; "int8" = pages
+    # stored int8 with per-row per-head fp32 scales alongside,
+    # quantize-on-scatter / dequantize-in-gather (serving engine's
+    # ServeConfig.kv_dtype; see ring_attention._paged_decode_attend).
+    # Config-only like kv_page_size: params are identical either way.
+    kv_dtype: str | None = None
     # Rematerialize each decoder block in the backward pass (activation
     # checkpointing: O(depth) activation memory for ~30% extra FLOPs).
     # Ignored in decode mode (no backward). The pipeline executor honors
@@ -294,6 +374,7 @@ class TransformerLM(nn.Module):
                 cache_len=self.cache_len or self.max_len,
                 kv_page_size=self.kv_page_size,
                 kv_pages=self.kv_pages,
+                kv_dtype=self.kv_dtype,
                 name=f"block{i}")(x, train, decode, pages)
         x = make_final_norm(self, name="ln_f")(x)
         if return_hidden:

@@ -331,11 +331,22 @@ class RingSelfAttention(nn.Module):
     # through the :class:`PagedKV` page tables instead of cache_index.
     kv_page_size: int | None = None
     kv_pages: int | None = None  # physical pages INCLUDING the null page
+    # Paged-pool storage dtype: None = store K/V at their compute dtype;
+    # "int8" = pools held int8 with per-row per-head fp32 scales in
+    # sibling cache variables (key_scales/value_scales), quantized on
+    # scatter and dequantized in the gather of the SAME call — no extra
+    # compiled program, and each row's scale depends only on that row's
+    # own K/V, so lanes stay batch-composition-independent.
+    kv_dtype: str | None = None
 
     def _decode_attend(self, q, k, v, head_dim: int):
         """Cached-KV attention: write K/V at ``cache_index``, attend q
         against the full cache. Shapes: q/k/v [B, T_in, H, hd]."""
         b, t_in = q.shape[0], q.shape[1]
+        if self.kv_dtype is not None:
+            raise ValueError(
+                "kv_dtype requires the paged cache (kv_page_size set); "
+                "the legacy contiguous path keeps full-precision slots")
         if self.cache_len is None:
             raise ValueError("decode=True requires cache_len")
         if not self.causal:
@@ -402,11 +413,27 @@ class RingSelfAttention(nn.Module):
         b, t_in = q.shape[0], q.shape[1]
         if self.kv_pages is None:
             raise ValueError("paged decode requires kv_pages (pool size)")
+        if self.kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {self.kv_dtype!r}")
+        quant = self.kv_dtype == "int8"
         ps = int(self.kv_page_size)
         pool_rows = int(self.kv_pages) * ps
         shape = (pool_rows, self.num_heads, head_dim)
-        ck = self.variable("cache", "key_pages", jnp.zeros, shape, k.dtype)
-        cv = self.variable("cache", "value_pages", jnp.zeros, shape, v.dtype)
+        ck = self.variable("cache", "key_pages", jnp.zeros, shape,
+                           jnp.int8 if quant else k.dtype)
+        cv = self.variable("cache", "value_pages", jnp.zeros, shape,
+                           jnp.int8 if quant else v.dtype)
+        if quant:
+            # Per-row per-head scales live beside the pools: a token-row's
+            # K/V dequantize with ONE broadcast multiply after the gather,
+            # and the scale travels with the page through every alias
+            # (prefix-cache hits, preempt-and-restore) for free.
+            sshape = (pool_rows, self.num_heads)
+            cks = self.variable("cache", "key_scales", jnp.zeros, sshape,
+                                jnp.float32)
+            cvs = self.variable("cache", "value_scales", jnp.zeros, sshape,
+                                jnp.float32)
         table, positions, valid = pages
         # Physical write rows; invalid tokens land in the null page
         # (row < ps), where duplicate scatters are harmless garbage.
@@ -414,10 +441,36 @@ class RingSelfAttention(nn.Module):
         phys = jnp.take_along_axis(table, logical, axis=1) * ps \
             + positions % ps
         write_idx = jnp.where(valid, phys, 0).reshape(-1)
-        k_all = ck.value.at[write_idx].set(k.reshape(b * t_in, -1, head_dim))
-        v_all = cv.value.at[write_idx].set(v.reshape(b * t_in, -1, head_dim))
-        if not self.is_initializing():
-            ck.value, cv.value = k_all, v_all
+        k_rows = k.reshape(b * t_in, -1, head_dim)
+        v_rows = v.reshape(b * t_in, -1, head_dim)
+        if quant:
+            # Quantize-on-scatter: symmetric per-row per-head int8,
+            # scale = amax/127 over head_dim, round-to-nearest
+            # (deterministic). A row's scale is a function of that row's
+            # own K/V only — no cross-lane amax — which is what keeps
+            # quantized decode bitwise batch-composition-independent.
+            def _quantize_rows(rows):
+                r32 = rows.astype(jnp.float32)
+                amax = jnp.max(jnp.abs(r32), axis=-1)
+                scl = jnp.where(amax > 0, amax / 127.0, 1.0)
+                qr = jnp.clip(jnp.round(r32 / scl[..., None]),
+                              -127, 127).astype(jnp.int8)
+                return qr, scl
+
+            kq, k_scl = _quantize_rows(k_rows)
+            vq, v_scl = _quantize_rows(v_rows)
+            k_all = ck.value.at[write_idx].set(kq)
+            v_all = cv.value.at[write_idx].set(vq)
+            ks_all = cks.value.at[write_idx].set(k_scl)
+            vs_all = cvs.value.at[write_idx].set(v_scl)
+            if not self.is_initializing():
+                ck.value, cv.value = k_all, v_all
+                cks.value, cvs.value = ks_all, vs_all
+        else:
+            k_all = ck.value.at[write_idx].set(k_rows)
+            v_all = cv.value.at[write_idx].set(v_rows)
+            if not self.is_initializing():
+                ck.value, cv.value = k_all, v_all
 
         # Static-shape gather: row b reads its table's pages in logical
         # order — positions 0..L-1 exactly as the contiguous cache lays
@@ -426,8 +479,17 @@ class RingSelfAttention(nn.Module):
         l_all = table.shape[1] * ps
         gather_idx = (table[:, :, None] * ps
                       + jnp.arange(ps)[None, None, :]).reshape(b, l_all)
-        kg = k_all[gather_idx]  # [B, L, H, hd]
-        vg = v_all[gather_idx]
+        if quant:
+            # Dequantize-in-gather: int8 rows × their per-row scales,
+            # inside the same compiled program as the attention —
+            # compiled-program inventory grows by zero.
+            kg = (k_all[gather_idx].astype(jnp.float32)
+                  * ks_all[gather_idx][..., None])  # [B, L, H, hd]
+            vg = (v_all[gather_idx].astype(jnp.float32)
+                  * vs_all[gather_idx][..., None])
+        else:
+            kg = k_all[gather_idx]  # [B, L, H, hd]
+            vg = v_all[gather_idx]
         qh = jnp.swapaxes(q, -3, -2)               # [B, H, T_in, hd]
         kh, vh = (jnp.swapaxes(t, -3, -2) for t in (kg, vg))
         scale = 1.0 / jnp.sqrt(jnp.asarray(head_dim, jnp.float32))
@@ -444,6 +506,10 @@ class RingSelfAttention(nn.Module):
         s = jnp.where((qpos >= l_all)[:, None, :, None], jnp.nan, s)
         p = jax.nn.softmax(s, axis=-1).astype(vh.dtype)
         out = jnp.einsum("...qk,...kd->...qd", p, vh)
+        if quant:
+            # Dequantized math ran in fp32; hand back the compute dtype
+            # the contiguous path would have produced.
+            out = out.astype(v.dtype)
         return jnp.swapaxes(out, -3, -2)  # back to [B, T, H, hd]
 
     @nn.compact

@@ -177,6 +177,32 @@ class Engine:
                 f"cache budget {self.budget} cannot hold a prompt token "
                 f"plus a generated token")
         self.paged = cfg.kv_page_size is not None
+        # Quantized execution (serving/quantize.py; docs/SERVING.md
+        # "Quantized execution"): per-channel int8 matmul weights,
+        # quantized ONCE here — construction is off the hot path by
+        # definition — and again for every hot-swap candidate at arm
+        # time on the watcher thread (arm_swap). Engine.step only ever
+        # binds the already-quantized tree as a step argument.
+        self._quantize_weights = bool(cfg.quantize_weights)
+        self._weight_quant_s = 0.0
+        self._quantized_params_bytes = 0
+        # The fp32 abstract tree is pinned BEFORE quantization: hot-swap
+        # candidates arrive from checkpoints as fp32 trees and
+        # validate_swap must recognize them as armable (arm quantizes).
+        self._fp32_params_abstract = (jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
+                                           jnp.result_type(a)), params)
+            if self._quantize_weights else None)
+        if self._quantize_weights:
+            from distributed_training_tpu.serving.quantize import (
+                quantize_params,
+                quantized_param_bytes,
+            )
+
+            t0_q = time.perf_counter()
+            params = quantize_params(params)
+            self._weight_quant_s = time.perf_counter() - t0_q
+            self._quantized_params_bytes = quantized_param_bytes(params)
         self.params = params
         # Speculative decoding (serving/speculative.py): the decode step
         # becomes a [max_batch, spec_k + 1] verify window — spec_k drafts
@@ -230,7 +256,8 @@ class Engine:
             # allocator serves ids 1..pool_pages.
             self.model = model.clone(cache_len=self.budget,
                                      kv_page_size=ps,
-                                     kv_pages=self.pool_pages + 1)
+                                     kv_pages=self.pool_pages + 1,
+                                     kv_dtype=cfg.kv_dtype)
             # A chunk wider than the longest admissible prompt is pure
             # padding compute.
             self.prefill_chunk = min(int(cfg.prefill_chunk),
@@ -318,6 +345,14 @@ class Engine:
                     "seed": cfg.seed, "temperature": cfg.temperature,
                     "top_k": cfg.top_k, "top_p": cfg.top_p,
                     "eos_id": cfg.eos_id, "pad_id": cfg.pad_id,
+                    # Quantization identity: quantized and fp32 engines
+                    # emit DIFFERENT (both-deterministic) token streams,
+                    # and so do different KV storage dtypes — replaying
+                    # one into the other would recompute divergent
+                    # "recovered" tokens. Part of the fingerprint for
+                    # the same reason seed is.
+                    "quantize_weights": bool(cfg.quantize_weights),
+                    "kv_dtype": cfg.kv_dtype,
                     # Weights identity: recovery into an engine serving
                     # different weights than the journal's tail would
                     # recompute "lost" tokens under the wrong model —
@@ -390,6 +425,30 @@ class Engine:
                 self._decode = jax.jit(
                     self._decode_impl,
                     donate_argnums=(1, 2, 3) if donate else ())
+
+        # Quantization gauges ride the telemetry from birth:
+        # kv_bytes_per_token is measured off the REAL device cache tree
+        # (so the int8 scale-plane overhead is counted, not assumed)
+        # and the weight gauges carry the construction-time quantize
+        # cost/footprint. reset_stats() re-seeds all three — they are
+        # facts of the engine build, not of a measurement window.
+        self.telemetry.on_weight_quant(self._weight_quant_s,
+                                       self._quantized_params_bytes)
+        self.telemetry.set_kv_bytes_per_token(self._kv_bytes_per_token())
+
+    def _kv_bytes_per_token(self) -> float:
+        """Device-cache bytes per storable KV token position, measured
+        from the actual cache pytree: paged pools divide by physical
+        pool rows (so int8 pages + their fp32 scale planes both count),
+        the legacy contiguous cache by slots × cache length (its scalar
+        write heads are noise but counted for honesty)."""
+        total = sum(int(leaf.nbytes)
+                    for leaf in jax.tree_util.tree_leaves(self._cache))
+        if self.paged:
+            rows = (self.pool_pages + 1) * self.page_size
+        else:
+            rows = self.cfg.max_batch * (self.budget + self.spec_k)
+        return total / max(rows, 1)
 
     # -- compiled pieces: paged KV + chunked prefill -------------------------
     def _decode_step(self, params, cache, tok, pos, valid, rngs, tables):
@@ -1215,21 +1274,35 @@ class Engine:
         compiled programs can serve in place of the current weights:
         identical structure, leaf shapes, and dtypes (anything else
         would retrace — or worse, silently reinterpret — mid-flight).
-        Runs off the hot path (staging thread / arm call)."""
+        Runs off the hot path (staging thread / arm call).
+
+        A quantizing engine (``quantize_weights=True``) accepts TWO
+        abstract shapes: the quantized serving tree (what rollback
+        re-arms — already int8+scales) and the fp32 restore tree (what
+        the hot-swap watcher stages from checkpoints — :meth:`arm_swap`
+        quantizes it). Anything else is the same hard mismatch as
+        always."""
         candidate = jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(jnp.shape(a),
                                            jnp.result_type(a)), params)
-        if candidate != self._params_abstract:
-            want = jax.tree_util.tree_structure(self._params_abstract)
-            got = jax.tree_util.tree_structure(candidate)
-            detail = (f"tree structure {got} != serving {want}"
-                      if got != want else
-                      "leaf shapes/dtypes differ from the serving model")
-            raise SwapError(
-                f"swap candidate does not match the serving model's "
-                f"parameter tree ({detail}); the engine keeps its "
-                f"current weights (epoch {self.weights_epoch})",
-                stage=stage, epoch=epoch)
+        if candidate == self._params_abstract:
+            return
+        if (self._fp32_params_abstract is not None
+                and candidate == self._fp32_params_abstract):
+            return
+        want = jax.tree_util.tree_structure(self._params_abstract)
+        got = jax.tree_util.tree_structure(candidate)
+        detail = (f"tree structure {got} != serving {want}"
+                  if got != want else
+                  "leaf shapes/dtypes differ from the serving model")
+        if self._fp32_params_abstract is not None:
+            detail += (" (matches neither the quantized serving tree "
+                       "nor the fp32 restore tree)")
+        raise SwapError(
+            f"swap candidate does not match the serving model's "
+            f"parameter tree ({detail}); the engine keeps its "
+            f"current weights (epoch {self.weights_epoch})",
+            stage=stage, epoch=epoch)
 
     def arm_swap(self, params: Any, *, epoch: int) -> None:
         """Stage validated weights for the next iteration boundary
@@ -1237,7 +1310,35 @@ class Engine:
         thread). The live engine is untouched until :meth:`step` applies
         the swap; arming again before that replaces the earlier
         candidate (newest wins). Raises :class:`SwapError`
-        (``stage="arm"``) on a tree/shape/dtype mismatch."""
+        (``stage="arm"``) on a tree/shape/dtype mismatch.
+
+        On a quantizing engine an fp32 candidate (the hot-swap
+        watcher's restored checkpoint) is quantized HERE — on the
+        caller's thread, so the cost lands on the watcher exactly like
+        restore/verify staging, never on the serving thread — and the
+        wall time is billed to ``weight_quant_s``. An already-quantized
+        candidate (rollback's re-arm of the previous tree) stages
+        as-is."""
+        if self._quantize_weights:
+            from distributed_training_tpu.serving.quantize import (
+                is_quantized,
+                quantize_params,
+                quantized_param_bytes,
+            )
+
+            if not is_quantized(params):
+                # Validate the fp32 tree BEFORE paying for quantization
+                # (a malformed candidate should die as cheaply and as
+                # early as the unquantized path kills it).
+                self.validate_swap(params, stage="arm", epoch=epoch)
+                t0_q = time.perf_counter()
+                params = quantize_params(params)
+                dt_q = time.perf_counter() - t0_q
+                self._weight_quant_s += dt_q
+                self._quantized_params_bytes = quantized_param_bytes(
+                    params)
+                self.telemetry.on_weight_quant(
+                    dt_q, self._quantized_params_bytes)
         self.validate_swap(params, stage="arm", epoch=epoch)
         with self._swap_lock:
             self._pending_swap = (params, int(epoch))
@@ -2046,6 +2147,13 @@ class Engine:
         self.telemetry.on_recovered(old.requests_recovered,
                                     old.tokens_recomputed_on_recovery)
         self.telemetry.adopt_ledger_lifetime(old)
+        # Quantization gauges are facts of the engine build, not of a
+        # measurement window: re-seed them (weight_quant_s carries its
+        # lifetime accumulation — construction + every armed swap —
+        # attributed exactly like swap staging cost).
+        self.telemetry.on_weight_quant(self._weight_quant_s,
+                                       self._quantized_params_bytes)
+        self.telemetry.set_kv_bytes_per_token(old.kv_bytes_per_token)
         self.queue.reset_counters()
         self._iteration = 0
 

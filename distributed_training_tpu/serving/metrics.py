@@ -209,6 +209,18 @@ class ServeTelemetry:
         self.tokens_drafted = 0
         self.tokens_accepted = 0
         self.spec_rollback_s = 0.0
+        # Quantized execution accounting (serving/quantize.py):
+        # kv_bytes_per_token is the device-cache footprint gauge the
+        # engine measures off its real cache pytree (int8 pools + their
+        # scale planes, deterministic for a given config — bench-gated
+        # zero-drift); quantized_params_bytes the stored weight
+        # footprint (0 when quantize_weights is off);  weight_quant_s
+        # the staging-time wall cost of quantizing — construction plus
+        # every armed hot-swap candidate — attributed explicitly like
+        # swap staging, never inside Engine.step.
+        self.kv_bytes_per_token = 0.0
+        self.quantized_params_bytes = 0
+        self.weight_quant_s = 0.0
         # Decode dispatch economics: slot-lane dispatches vs tokens they
         # landed. Their ratio is the speculation speedup factor at
         # fixed dispatch cost (1.0 with speculation off) — DETERMINISTIC
@@ -328,6 +340,21 @@ class ServeTelemetry:
         """A swap candidate died somewhere in the pipeline (verify /
         stage / validate / arm); the engine kept its old weights."""
         self.swaps_rejected += 1
+
+    def on_weight_quant(self, quant_s: float, params_bytes: int) -> None:
+        """One weight-quantization pass finished off the hot path
+        (engine construction, or a hot-swap candidate at arm time on
+        the watcher thread): ``quant_s`` wall seconds accumulate —
+        the same staging-cost attribution as swap verify/restore —
+        and ``params_bytes`` (re)states the stored quantized footprint
+        (a gauge: every pass serves the same tree shape)."""
+        self.weight_quant_s += max(float(quant_s), 0.0)
+        self.quantized_params_bytes = int(params_bytes)
+
+    def set_kv_bytes_per_token(self, v: float) -> None:
+        """Device-cache bytes per storable KV token position — a gauge
+        the engine measures once from its real cache pytree."""
+        self.kv_bytes_per_token = float(v)
 
     def on_preempted(self, recompute_tokens: int, tier: int) -> None:
         """One lossless preemption: a ``tier`` sequence was evicted to
@@ -605,6 +632,14 @@ class ServeTelemetry:
                 self.decode_tokens / self.decode_lanes
                 if self.decode_lanes else 0.0),
             "spec_rollback_s": self.spec_rollback_s,
+            # Quantized execution (serving/quantize.py): cache bytes
+            # per token position and stored quantized-weight bytes are
+            # config-deterministic gauges (bench-gated zero-drift);
+            # weight_quant_s is staging wall time, attributed like
+            # swap staging cost.
+            "kv_bytes_per_token": float(self.kv_bytes_per_token),
+            "quantized_params_bytes": int(self.quantized_params_bytes),
+            "weight_quant_s": float(self.weight_quant_s),
         }
 
     def _serving_section(self, stats: dict[str, Any] | None

@@ -1,0 +1,241 @@
+"""Per-channel int8 weight quantization for the serving plane.
+
+Decode is memory-bandwidth-bound: every iteration re-reads the full
+parameter tree from HBM to emit a handful of tokens, so weight BYTES —
+not weight FLOPs — set the per-token floor (the AQT-style int8 serving
+trade vLLM and friends ship in production). This module quantizes the
+transformer's matmul weights to symmetric per-channel int8, **once, off
+the hot path** — at engine construction or hot-swap staging time on the
+watcher thread, never inside ``Engine.step`` (the graftlint hot-path
+rule stays green because the decode loop only ever *binds* the already-
+quantized tree as a step argument).
+
+Scheme
+------
+- **Symmetric, per-channel.** Each eligible weight quantizes to int8
+  ``q = clip(round(w / scale), -127, 127)`` with one fp32 scale per
+  OUTPUT channel (``amax / 127`` over the contraction axes, kept with
+  ``keepdims`` so dequantization is a plain broadcast multiply). No
+  zero-points: symmetric quantization keeps the dequant a single fused
+  multiply and zero stays exactly zero.
+- **What quantizes:** the token embedding table and every attention
+  (qkv/out) and MLP (fc1/fc2) matmul kernel — the leaves that dominate
+  both bytes and decode bandwidth.
+- **What stays high-precision:** LayerNorm scales/biases (tiny, and
+  their elementwise products gate every residual), all biases, the
+  positional table (a gather, already cheap), and the logits head
+  (the last matmul feeds argmax/softmax directly — int8 noise there
+  moves sampled tokens far more than anywhere else, for a tensor that
+  is read once per token, not once per layer).
+- **Determinism before accuracy-luck:** round-to-nearest-even (jnp's
+  ``round``), never stochastic rounding — quantizing the same tree
+  twice is bitwise identical, which is what lets hot-swap staging
+  re-quantize a restored checkpoint and arm a tree the running
+  programs already validated against.
+
+Representation
+--------------
+:class:`QuantizedTensor` is a registered pytree node ``(q: int8,
+scale: fp32)`` standing where the fp32 leaf stood, so quantized trees
+flow through ``jax.jit`` argument binding, ``jax.tree`` maps, and
+``model.apply`` unchanged. Its ``astype(dtype)`` method **dequantizes**
+— deliberately duck-typed: the attention projections' existing
+``kernel.astype(self.dtype)`` call sites dequantize quantized leaves
+with zero model-code branches, and XLA folds the broadcast multiply
+into the consuming matmul's operand read. (A dequant-free int8×bf16
+``lax.dot_general`` is not expressible on this jax version — mixed
+int/float dot operands promote first — so dequant-at-use IS the
+supported fast path; the bytes win is in HBM/param residency either
+way.) ``flax``'s apply-time shape check flattens the node and compares
+the leading leaf — ``q`` keeps the original kernel shape exactly, so
+quantized trees serve through unmodified modules.
+
+``Engine`` integration: ``ServeConfig.quantize_weights=True`` quantizes
+at construction and re-quantizes every hot-swap candidate at arm time
+(``Engine.arm_swap``), billing the wall cost to ``weight_quant_s`` and
+the footprint to ``quantized_params_bytes``. ``Engine.validate_swap``
+accepts BOTH the quantized abstract tree (rollback re-arms an already-
+quantized predecessor) and the fp32 abstract tree (the hot-swap
+watcher stages fp32 checkpoints; arm quantizes them).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# Symmetric int8 range. ±127 (not -128): symmetric quantization wastes
+# the -128 code so q and -q are both representable — negation-safe and
+# one comparison simpler everywhere.
+Q_MAX = 127.0
+
+
+class QuantizedTensor:
+    """A per-channel int8 weight leaf: ``q`` int8 (original shape) +
+    ``scale`` fp32 (``keepdims`` reduced — broadcast-ready).
+
+    Registered as a pytree node: tree maps/jit binding descend into the
+    two component arrays, and the node reconstructs around whatever
+    they map to (device arrays, tracers, ``ShapeDtypeStruct``s — the
+    engine's abstract-tree validation relies on the last).
+    """
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    # -- duck-typed dequantization ------------------------------------------
+    def astype(self, dtype):
+        """Dequantize to ``dtype`` — the same method name the model's
+        ``kernel.astype(self.dtype)`` use-sites already call, so
+        quantized leaves serve through them without a branch."""
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+    def dequantize(self, dtype=jnp.float32):
+        return self.astype(dtype)
+
+    @property
+    def shape(self):
+        return jnp.shape(self.q)
+
+    @property
+    def nbytes(self) -> int:
+        """Stored bytes (int8 values + fp32 scales)."""
+        q, s = self.q, self.scale
+        qb = getattr(q, "nbytes", None)
+        sb = getattr(s, "nbytes", None)
+        if qb is None:  # ShapeDtypeStruct / tracer
+            qb = int(jnp.size(q)) * jnp.dtype(q.dtype).itemsize
+        if sb is None:
+            sb = int(jnp.size(s)) * jnp.dtype(s.dtype).itemsize
+        return int(qb) + int(sb)
+
+    # Structural equality (component-wise) — what dict comparison of two
+    # abstract trees recurses into when Engine.validate_swap compares a
+    # candidate against the serving tree. Only meaningful for hashable
+    # leaf stand-ins (ShapeDtypeStructs); arrays never reach it.
+    def __eq__(self, other):
+        return (isinstance(other, QuantizedTensor)
+                and self.q == other.q and self.scale == other.scale)
+
+    def __hash__(self):
+        return hash((QuantizedTensor, self.q, self.scale))
+
+    def __repr__(self):
+        return (f"QuantizedTensor(q={jnp.shape(self.q)} int8, "
+                f"scale={jnp.shape(self.scale)})")
+
+
+def _qt_flatten(t: QuantizedTensor):
+    return (t.q, t.scale), None
+
+
+def _qt_unflatten(_, children) -> QuantizedTensor:
+    return QuantizedTensor(*children)
+
+
+jax.tree_util.register_pytree_node(QuantizedTensor, _qt_flatten,
+                                   _qt_unflatten)
+
+
+def reduce_axes_for(path: str) -> tuple[int, ...] | None:
+    """Contraction axes to reduce per-channel scales over for the param
+    at ``path`` ('/'-joined), or None when the leaf stays high-precision.
+
+    The rule mirrors each matmul's contraction: scales live per OUTPUT
+    channel, so dequantizing after the (int8-stored) contraction is
+    algebraically the same weight the fp32 path multiplies by.
+
+    - ``tok_embed/embedding`` [vocab, D]: per-ROW (per vocab entry,
+      reduce axis 1) — the embedding is a gather, and per-row scales
+      dequantize only the gathered rows instead of the whole table.
+    - attention ``qkv/kernel`` [D, 3, H, hd]: reduce the input axis 0.
+    - attention ``out/kernel`` [H, hd, D]: reduce both input axes.
+    - MLP ``fc1``/``fc2`` kernels [in, out]: reduce the input axis 0.
+
+    Everything else (layernorms, biases, ``pos_embed``, ``lm_head``,
+    MoE experts — router logits are precision-sensitive and the serving
+    smoke models are dense) returns None.
+    """
+    if path.endswith("tok_embed/embedding"):
+        return (1,)
+    if path.endswith("/kernel") or path == "kernel":
+        if path.endswith("attn/qkv/kernel"):
+            return (0,)
+        if path.endswith("attn/out/kernel"):
+            return (0, 1)
+        if path.endswith("fc1/kernel") or path.endswith("fc2/kernel"):
+            return (0,)
+    return None
+
+
+def quantize_array(w, reduce_axes: tuple[int, ...]) -> QuantizedTensor:
+    """Symmetric per-channel int8 of one weight: ``scale = amax/127``
+    over ``reduce_axes`` (keepdims), round-to-nearest, clipped. An
+    all-zero channel gets scale 1.0 (its codes are all zero anyway) so
+    dequantization never divides by or multiplies with 0/0 garbage."""
+    w32 = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / Q_MAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w32 / scale), -Q_MAX, Q_MAX).astype(jnp.int8)
+    return QuantizedTensor(q, scale)
+
+
+def _flatten_params(params: Any) -> dict[tuple, Any]:
+    from flax import traverse_util
+    from flax.core import unfreeze
+
+    return traverse_util.flatten_dict(unfreeze(params))
+
+
+def quantize_params(params: Any) -> Any:
+    """Quantize every eligible leaf of a flax param tree (see
+    :func:`reduce_axes_for`); structure and ineligible leaves are
+    untouched. Pure and deterministic — quantizing the same tree twice
+    is bitwise identical. Runs eagerly (off the hot path by contract:
+    construction or the hot-swap watcher thread)."""
+    from flax import traverse_util
+
+    flat = _flatten_params(params)
+    out = {}
+    for path, leaf in flat.items():
+        axes = reduce_axes_for("/".join(str(p) for p in path))
+        out[path] = (quantize_array(leaf, axes)
+                     if axes is not None else leaf)
+    tree = traverse_util.unflatten_dict(out)
+    if type(params) is not dict:  # FrozenDict in, FrozenDict out
+        from flax.core import freeze
+
+        tree = freeze(tree)
+    return tree
+
+
+def is_quantized(params: Any) -> bool:
+    """True when the tree carries at least one :class:`QuantizedTensor`
+    (the arm-time dispatch: fp32 candidates quantize, already-quantized
+    rollback trees arm as-is)."""
+    leaves = jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    return any(isinstance(leaf, QuantizedTensor) for leaf in leaves)
+
+
+def quantized_param_bytes(params: Any) -> int:
+    """Stored bytes of the quantized leaves (int8 values + scales) —
+    the ``quantized_params_bytes`` telemetry gauge. 0 for fp32 trees."""
+    leaves = jax.tree_util.tree_leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))
+    return sum(leaf.nbytes for leaf in leaves
+               if isinstance(leaf, QuantizedTensor))
+
+
+def dequantize_params(params: Any) -> Any:
+    """fp32 tree with every quantized leaf expanded — the quality-eval
+    helper (tests compare its eval loss against the original fp32
+    tree), never the serving path."""
+    return jax.tree_util.tree_map(
+        lambda x: x.dequantize() if isinstance(x, QuantizedTensor) else x,
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor))

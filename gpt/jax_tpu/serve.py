@@ -106,6 +106,28 @@ def add_argument() -> argparse.Namespace:
     parser.add_argument("--spec-draft-window", type=int, default=16,
                         help="gpt drafter: context tokens re-run per "
                              "draft step")
+    # Quantized execution (docs/SERVING.md "Quantized execution").
+    parser.add_argument("--quantize-weights", action="store_true",
+                        default=False,
+                        help="symmetric per-channel int8 for the "
+                             "transformer matmul weights (embedding, "
+                             "attention, MLP); layernorms, biases and "
+                             "the logits head stay full precision. "
+                             "Quantization happens ONCE at engine "
+                             "construction and at hot-swap staging "
+                             "time on the watcher thread — never "
+                             "inside the decode loop. Deterministic: "
+                             "two quantized runs are bitwise-identical")
+    parser.add_argument("--kv-dtype", type=str, default=None,
+                        choices=["int8"],
+                        help="paged KV cache storage dtype: 'int8' "
+                             "stores pool pages as int8 with per-row "
+                             "per-head scales, quantizing on scatter "
+                             "and dequantizing in the gather inside "
+                             "the same compiled programs (inventory "
+                             "stays at 2). Requires paged mode "
+                             "(--kv-page-size > 0). Default: model "
+                             "dtype")
     # SLO tiers + multi-tenant fairness (docs/SERVING.md "Tiered
     # scheduling & preemption").
     parser.add_argument("--num-tiers", type=int, default=1,
@@ -318,6 +340,8 @@ def main() -> int:
         spec_drafter=args.spec_drafter,
         spec_ngram=args.spec_ngram,
         spec_draft_window=args.spec_draft_window,
+        quantize_weights=args.quantize_weights,
+        kv_dtype=args.kv_dtype,
         num_tiers=args.num_tiers,
         tenant_quota=args.tenant_quota,
         tier_reserved_slots=args.tier_reserved_slots,

@@ -65,6 +65,7 @@ MAX_LEN = 48
 # that hand-craft journals reuse it so a real engine can recover them.
 DEFAULT_FP = {"seed": 0, "temperature": 0.0, "top_k": None,
               "top_p": None, "eos_id": None, "pad_id": 0,
+              "quantize_weights": False, "kv_dtype": None,
               "weights_epoch": -1}
 
 

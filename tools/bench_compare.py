@@ -142,6 +142,16 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     "prefix_cache_inserted_pages": ("both", 0.0),
     "prefix_cache_evicted_pages": ("both", 0.0),
     "ledger_tokens_prefix_hit": ("both", 0.0),
+    # quantized execution (serving/quantize.py; docs/SERVING.md
+    # "Quantized execution"): kv_bytes_per_token is a pure function of
+    # the engine config (cache geometry + storage dtype) and
+    # quantized_params_bytes of the parameter tree — both are
+    # zero-drift: ANY movement is a cache-layout or quantization-
+    # coverage change, not noise. Exactly zero params-bytes on
+    # quantization-off rows (zero-baseline semantics). weight_quant_s
+    # is wall time and deliberately NOT gated.
+    "kv_bytes_per_token": ("both", 0.0),
+    "quantized_params_bytes": ("both", 0.0),
     # crash-durable serving (serving/journal.py): recovery counters are
     # pure functions of the journal's durable state — on the no-crash
     # smoke rows BOTH must stay exactly zero (any drift means requests

@@ -132,6 +132,24 @@ def add_argument() -> argparse.Namespace:
     p.add_argument("--spec-draft-window", type=int, default=16,
                    help="gpt drafter: context tokens re-run per draft "
                         "step")
+    p.add_argument("--quantize-weights", action="store_true",
+                   default=False,
+                   help="quantized execution (docs/SERVING.md "
+                        "'Quantized execution'): symmetric per-channel "
+                        "int8 for the transformer matmul weights, "
+                        "quantized ONCE at engine construction / swap "
+                        "staging time (never inside the hot loop); "
+                        "layernorms, biases and the logits head stay "
+                        "full precision. Deterministic: two quantized "
+                        "runs are bitwise-identical")
+    p.add_argument("--kv-dtype", type=str, default=None,
+                   choices=["int8"],
+                   help="paged KV cache storage dtype: 'int8' stores "
+                        "pages as int8 with per-row per-head scales "
+                        "(quantize-on-scatter / dequantize-in-gather "
+                        "inside the same compiled programs — the "
+                        "inventory stays at 2). Requires paged mode "
+                        "(--kv-page-size > 0). Default: model dtype")
     # Tiny random-weight model (no checkpoint: this benches the ENGINE —
     # scheduling, prefill/decode latency — not model quality).
     p.add_argument("--vocab-size", type=int, default=256)
@@ -288,6 +306,8 @@ def main() -> int:
         spec_k=args.spec_k, spec_drafter=args.spec_drafter,
         spec_ngram=args.spec_ngram,
         spec_draft_window=args.spec_draft_window,
+        quantize_weights=args.quantize_weights,
+        kv_dtype=args.kv_dtype,
         num_tiers=num_tiers, tenant_quota=args.tenant_quota,
         tenant_weights=scen.tenant_weights,
         tier_reserved_slots=args.tier_reserved_slots,
