@@ -622,6 +622,31 @@ class ServeConfig:
     # keyed). Requires the paged cache (kv_page_size set): the legacy
     # contiguous path keeps full-precision slots.
     kv_dtype: str | None = None
+    # Serving control room (serving/timeseries.py + serving/alerts.py;
+    # docs/OBSERVABILITY.md "Serving SLO alerting & incident capture").
+    # The engine appends one flat sample of its host-side counters and
+    # gauges to a bounded time-series ring every sample_every
+    # ITERATIONS (never wall time — the cadence is a pure function of
+    # the virtual-dt schedule, so alert decisions over deterministic
+    # counters are bitwise-reproducible). The ring holds
+    # timeseries_capacity samples (~100 floats each; < 1 MB at the
+    # defaults) regardless of run length.
+    sample_every: int = 16
+    timeseries_capacity: int = 1024
+    # Declarative SLO burn-rate rules evaluated at sample cadence:
+    # "default" = the shipped set (p95 TTFT/TPOT, shed/timeout rate,
+    # pool pressure, zero-tolerance ledger-conservation and
+    # journal-write-error watchers), or a ';'-separated clause list —
+    # name:metric[/den]>objective[@fast,slow][xBURN][~CLEAR]
+    # (serving/alerts.py::parse_slo_rules). None = no alerting (the
+    # ring still samples; alert counters report 0).
+    slo_rules: str | None = None
+    # Incident capture: a firing alert enqueues ONE bundled snapshot
+    # (flight dump + ledger_top + the last time-series window + the
+    # firing event) for a background writer thread to write atomically
+    # under this directory (tools/incident_report.py renders it). None
+    # = alerts log/count but write no bundles.
+    incident_dir: str | None = None
 
     def __post_init__(self):
         if self.max_batch < 1:
@@ -724,6 +749,17 @@ class ServeConfig:
                 "kv_dtype requires the paged KV cache (set "
                 "kv_page_size): the legacy contiguous path keeps "
                 "full-precision slots")
+        if self.sample_every < 1:
+            raise ValueError(
+                f"sample_every must be >= 1, got {self.sample_every}")
+        if self.timeseries_capacity < 2:
+            raise ValueError(
+                f"timeseries_capacity must be >= 2, "
+                f"got {self.timeseries_capacity}")
+        if self.incident_dir is not None and self.slo_rules is None:
+            raise ValueError(
+                "incident_dir without slo_rules captures nothing: an "
+                "incident bundle is written when a rule fires")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -19,6 +19,10 @@ picture. This module is that plane, on stdlib ``http.server`` only:
   alive and responding"; phase carries the rest.
 - ``GET /vars`` — the full flight snapshot as strict JSON (the same
   dict a flight dump would write, minus the disk I/O).
+- ``GET /timeseries`` / ``GET /alerts`` — the serving control room's
+  sample ring and SLO alert log as strict JSON (registered by the
+  serving ``attach_engine``; 404 when the owner registered no
+  provider, so the training exporter is unchanged).
 
 **Scrape-safety contract.** The handler thread only ever calls the
 ``snapshot_provider`` the owner registered, and every provider in this
@@ -75,7 +79,9 @@ class MetricsExporter:
     def __init__(self, snapshot_provider: Callable[[], dict], *,
                  port: int, host: str = "127.0.0.1",
                  phase_provider: Callable[[], str] | None = None,
-                 health_provider: Callable[[], dict] | None = None):
+                 health_provider: Callable[[], dict] | None = None,
+                 timeseries_provider: Callable[[], dict] | None = None,
+                 alerts_provider: Callable[[], dict] | None = None):
         self._provider = snapshot_provider
         self._phase = phase_provider or (lambda: "running")
         # Optional owner-specific /healthz extras (the serving engine
@@ -83,6 +89,13 @@ class MetricsExporter:
         # confirm a live weight deploy from the health endpoint alone).
         # Same scrape-safety contract: cached host-side state only.
         self._health_extra = health_provider
+        # Serving control room endpoints (/timeseries, /alerts): the
+        # engine registers read-only JSON views of its sample ring and
+        # alert log. None → 404, so owners without a control room (the
+        # training exporter) expose exactly the endpoints they always
+        # did. Same scrape-safety contract as every other provider.
+        self._timeseries = timeseries_provider
+        self._alerts = alerts_provider
         self._t0 = time.perf_counter()
         self.scrapes = 0  # /metrics GETs served (rides /healthz)
         exporter = self
@@ -153,10 +166,22 @@ class MetricsExporter:
                 # metrics ride as 'nan'/'inf' strings).
                 body = json.dumps(self._provider(), allow_nan=False) + "\n"
                 ctype = "application/json"
+            elif path == "/timeseries" and self._timeseries is not None:
+                body = json.dumps(self._timeseries(),
+                                  allow_nan=False) + "\n"
+                ctype = "application/json"
+            elif path == "/alerts" and self._alerts is not None:
+                body = json.dumps(self._alerts(), allow_nan=False) + "\n"
+                ctype = "application/json"
             else:
-                self._send(req, 404, "application/json",
-                           '{"error": "not found", "endpoints": '
-                           '["/metrics", "/healthz", "/vars"]}\n')
+                endpoints = ["/metrics", "/healthz", "/vars"]
+                if self._timeseries is not None:
+                    endpoints.append("/timeseries")
+                if self._alerts is not None:
+                    endpoints.append("/alerts")
+                self._send(req, 404, "application/json", json.dumps(
+                    {"error": "not found",
+                     "endpoints": endpoints}) + "\n")
                 return
         except Exception as e:  # a bad snapshot must not kill the server
             self._send(req, 500, "text/plain; charset=utf-8",
@@ -187,11 +212,15 @@ def attach_engine(engine, port: int, *, component: str = "serve",
     snapshots from ``engine.flight_snapshot`` (never flushes, never
     syncs), /healthz phase from ``engine.phase`` (serving ⇄ swapping →
     draining → drained) plus the hot-swap extras from ``engine.health``
-    (weights_epoch, swaps_completed/rejected)."""
+    (weights_epoch, swaps_completed/rejected), and the control-room
+    views from ``engine.timeseries_snapshot`` / ``engine.
+    alerts_snapshot`` on /timeseries and /alerts."""
     exporter = MetricsExporter(
         engine.flight_snapshot, port=port, host=host,
         phase_provider=lambda: engine.phase,
-        health_provider=engine.health).start()
+        health_provider=engine.health,
+        timeseries_provider=engine.timeseries_snapshot,
+        alerts_provider=engine.alerts_snapshot).start()
     printer(f"[{component}] live metrics: {exporter.url('')} "
-            f"(/metrics /healthz /vars)")
+            f"(/metrics /healthz /vars /timeseries /alerts)")
     return exporter

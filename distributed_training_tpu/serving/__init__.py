@@ -43,6 +43,16 @@ restated for XLA's static-shape world:
   (reserved-vs-written cache positions, queue-wait vs prefill breakdown,
   admission-blocked time) — live-scrapeable via ``--metrics-port``
   (``observability/exporter.py``).
+- :mod:`timeseries` / :mod:`alerts` — the serving control room: a
+  fixed-capacity telemetry sample ring appended at iteration-count
+  cadence (windowed delta/rate/quantile queries, bitwise-reproducible
+  under ``--virtual-dt``), a declarative multi-window SLO burn-rate
+  alert engine (fast AND slow windows must burn to fire; hysteresis to
+  clear; typed fire/clear events on a bounded deterministic log), and
+  an off-hot-path incident writer that lands one atomic bundle (alert
+  + log + time-series window + flight snapshot) per fire
+  (``tools/incident_report.py`` renders them). Scrapeable live at
+  ``/timeseries`` and ``/alerts``.
 - :mod:`journal` — crash-durable serving: an append-only, crc-framed
   write-ahead request journal (admissions durable at submit; token/
   preempt/finish records persisted off the hot loop by a writer
@@ -70,6 +80,13 @@ from distributed_training_tpu.resilience.errors import (  # noqa: F401
     JournalCorruptError,
     QueueFullError,
     SwapError,
+)
+from distributed_training_tpu.serving.alerts import (  # noqa: F401
+    AlertEngine,
+    IncidentWriter,
+    SLORule,
+    default_rules,
+    parse_slo_rules,
 )
 from distributed_training_tpu.serving.engine import Engine  # noqa: F401
 from distributed_training_tpu.serving.journal import (  # noqa: F401
@@ -111,4 +128,7 @@ from distributed_training_tpu.serving.speculative import (  # noqa: F401
     Drafter,
     GPTDrafter,
     NGramDrafter,
+)
+from distributed_training_tpu.serving.timeseries import (  # noqa: F401
+    TelemetryRing,
 )

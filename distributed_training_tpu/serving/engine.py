@@ -109,6 +109,11 @@ from distributed_training_tpu.inference.sampler import (
 from distributed_training_tpu.models.gpt import init_decode_cache
 from distributed_training_tpu.parallel.ring_attention import PagedKV
 from distributed_training_tpu.resilience.errors import SwapError
+from distributed_training_tpu.serving.alerts import (
+    AlertEngine,
+    IncidentWriter,
+    parse_slo_rules,
+)
 from distributed_training_tpu.serving.journal import RequestJournal, perf_of
 from distributed_training_tpu.serving.ledger import (
     CAUSE_DECODE,
@@ -124,6 +129,7 @@ from distributed_training_tpu.serving.ledger import (
     CAUSE_SPEC_DRAFT,
     CAUSE_SPEC_ROLLBACK,
     CAUSE_SWAP_BARRIER,
+    LEDGER_CAUSES,
 )
 from distributed_training_tpu.serving.metrics import ServeTelemetry
 from distributed_training_tpu.serving.pages import PagePool, pages_for
@@ -141,6 +147,10 @@ from distributed_training_tpu.serving.scheduler import SlotScheduler
 from distributed_training_tpu.serving.speculative import (
     make_drafter,
     truncate_at_eos,
+)
+from distributed_training_tpu.serving.timeseries import (
+    TIMESERIES_DUMP_SAMPLES,
+    TelemetryRing,
 )
 
 
@@ -365,6 +375,22 @@ class Engine:
         self.recovery_report: dict[str, Any] | None = None
         self.telemetry = ServeTelemetry(cfg.ring_size,
                                         num_tiers=cfg.num_tiers)
+        # Serving control room (serving/timeseries.py + serving/
+        # alerts.py): the telemetry time-series ring samples host-side
+        # counters/gauges every cfg.sample_every ITERATIONS (iteration
+        # cadence, never wall time — deterministic under --virtual-dt),
+        # the SLO rule engine evaluates burn-rate alerts at the same
+        # boundary, and a firing rule enqueues ONE incident bundle for
+        # the background writer thread (the journal writer discipline:
+        # the decode loop never opens a file). A bad --slo-rules spec
+        # fails HERE, before the engine serves anything.
+        self.timeseries = TelemetryRing(cfg.timeseries_capacity,
+                                        cfg.sample_every)
+        self.alerts = AlertEngine(
+            parse_slo_rules(cfg.slo_rules) if cfg.slo_rules else [])
+        self.incidents: IncidentWriter | None = (
+            IncidentWriter(cfg.incident_dir)
+            if cfg.incident_dir else None)
         self._base_rng = jax.random.PRNGKey(cfg.seed)
         self._iteration = 0
 
@@ -1819,7 +1845,89 @@ class Engine:
         if self._iteration % self.cfg.flush_every == 0:
             self.telemetry.flush(it, len(self.queue),
                                  self.scheduler.num_active)
+        if self._iteration % self.cfg.sample_every == 0:
+            self._sample_telemetry(it)
         return finished
+
+    def _sample_telemetry(self, it: int) -> None:
+        """One control-room sample boundary (iteration cadence): append
+        a flat sample of host-side counters/gauges to the time-series
+        ring, evaluate the SLO rules over it, and enqueue one incident
+        bundle per rule that fired. Everything here is host arithmetic
+        plus one queue.put — no device read, no file I/O (the incident
+        writer thread owns the disk; graftlint's hot-path rule pins
+        this)."""
+        tm = self.telemetry
+        sample: dict[str, float] = {
+            "iteration": it,
+            # Deterministic schedule counters — what the bitwise alert
+            # drill gates on.
+            "tokens_emitted": tm.tokens_emitted,
+            "requests_finished": tm.requests_finished,
+            "requests_submitted": self.queue.submitted,
+            "requests_shed": self.queue.shed,
+            "requests_timed_out":
+                tm.finish_reasons.get(FINISH_TIMEOUT, 0),
+            "requests_preempted": tm.requests_preempted,
+            "requests_preempt_timed_out":
+                tm.finish_reasons.get(FINISH_PREEMPT_TIMEOUT, 0),
+            "requests_recovered": tm.requests_recovered,
+            "prefix_cache_hit_tokens": tm.prefix_cache_hit_tokens,
+            "prefix_cache_evicted_pages": tm.prefix_cache_evicted_pages,
+            "drafted_tokens": tm.tokens_drafted,
+            "accepted_tokens": tm.tokens_accepted,
+            "swaps_completed": tm.swaps_completed,
+            "swaps_rejected": tm.swaps_rejected,
+            "ledger_conservation_violations":
+                tm.ledger_conservation_violations,
+            "journal_records_written": (
+                self.journal.records_written
+                if self.journal is not None else 0),
+            "journal_write_errors": (
+                self.journal.write_errors
+                if self.journal is not None else 0),
+            # Gauges (instantaneous, still schedule-deterministic).
+            "queue_depth": len(self.queue),
+            "active_slots": self.scheduler.num_active,
+            "pool_occupancy": (
+                self.pool.num_allocated / self.pool.num_pages
+                if self.paged else 0.0),
+            "prefix_cache_pages_held": (
+                self.prefix_cache.num_pages
+                if self.prefix_cache is not None else 0),
+            "weights_epoch": self.weights_epoch,
+        }
+        for t in range(self.cfg.num_tiers):
+            sample[f"tier{t}_requests_shed"] = self.queue.shed_by_tier[t]
+            sample[f"tier{t}_requests_preempted"] = tm.tier_preempted[t]
+        # Wall-derived columns: per-cause ledger window totals and the
+        # TTFT/TPOT histogram cumulative bucket counts (windowed-
+        # quantile source). Operators alert on these; the deterministic
+        # drill does not.
+        for c in LEDGER_CAUSES:
+            sample[f"ledger_{c}_ms_total"] = tm.ledger_window_ms[c]
+        for prefix, hist in (("ttft_ms", tm.ttft_hist),
+                             ("tpot_ms", tm.tpot_hist)):
+            for i, n in enumerate(hist.cumulative()):
+                suffix = f"{i:02d}" if i < len(hist.bounds) else "inf"
+                sample[f"{prefix}_le_{suffix}"] = n
+        self.timeseries.record_sample(sample)
+        for event in self.alerts.evaluate(self.timeseries, it):
+            if self.incidents is not None:
+                # One bundle per fire event: the alert, the full alert
+                # log, the last slow-window of samples, and a flight
+                # snapshot (taken WITHOUT the control-room sections —
+                # the bundle already carries them at top level).
+                self.incidents.capture(event["rule"], {
+                    "format_version": 1,
+                    "alert": event,
+                    "alerts": self.alerts.to_dict(),
+                    "timeseries": self.timeseries.to_dict(
+                        last_n=TIMESERIES_DUMP_SAMPLES),
+                    "flight": self.telemetry.snapshot(
+                        reason=f"incident:{event['rule']}",
+                        stats=self.stats()),
+                })
 
     def _trace_finish(self, fin: FinishedRequest) -> None:
         """One request's terminal trace events: the decode span (first →
@@ -2106,9 +2214,11 @@ class Engine:
         stats["requests_shed"] = self.queue.shed
         # Per-tier shed breakdown (tier-aware degradation evidence: the
         # CI overload drill asserts tier 0 stays at zero while
-        # best-effort tiers absorb the pressure).
+        # best-effort tiers absorb the pressure). shed_by_tier holds
+        # plain ints (queue.py) — no conversion on this hot-reachable
+        # path.
         for t, n in enumerate(self.queue.shed_by_tier):
-            stats[f"tier{t}_requests_shed"] = int(n)
+            stats[f"tier{t}_requests_shed"] = n
         stats["requests_drain_rejected"] = self.queue.drain_rejected
         stats["drained"] = bool(self._drained)
         # Crash-durable serving (serving/journal.py): the journal's
@@ -2128,6 +2238,15 @@ class Engine:
         stats["prefix_cache_pages_held"] = (
             self.prefix_cache.num_pages
             if self.prefix_cache is not None else 0)
+        # Serving control room (serving/alerts.py): lifetime alert and
+        # incident counters ride the SLA surface — always present (0
+        # with no rules configured) so downstream JSON consumers and
+        # the bench_compare zero-drift gate need no key guard.
+        stats["alerts_fired"] = self.alerts.fired
+        stats["alerts_cleared"] = self.alerts.cleared
+        stats["alerts_active"] = len(self.alerts.active)
+        stats["incidents_captured"] = (
+            self.incidents.captured if self.incidents is not None else 0)
         return stats
 
     def reset_stats(self) -> None:
@@ -2155,7 +2274,30 @@ class Engine:
                                        self._quantized_params_bytes)
         self.telemetry.set_kv_bytes_per_token(old.kv_bytes_per_token)
         self.queue.reset_counters()
+        # Control room: the sample ring is a windowed instrument — it
+        # starts fresh with the new window (stale pre-reset samples
+        # must not feed post-reset burn rates). The alert engine and
+        # incident writer are process history, exactly like the
+        # recovery counters above: an alert that fired (or an incident
+        # that was captured) before a warm-up reset really happened,
+        # and reset_stats must not erase the evidence.
+        self.timeseries = TelemetryRing(self.cfg.timeseries_capacity,
+                                        self.cfg.sample_every)
         self._iteration = 0
+
+    def _control_room_sections(self) -> dict[str, Any]:
+        """The ``alerts`` + ``timeseries`` top-level sections flight
+        snapshots and dumps carry (tools/flight_report.py renders both;
+        ``tools/incident_report.py`` reads the same shapes from an
+        incident bundle). The time-series section is trimmed to the
+        newest ``TIMESERIES_DUMP_SAMPLES`` samples — enough to cover
+        the slow alert window with margin, small enough that a dump
+        stays a quick read."""
+        return {
+            "alerts": self.alerts.to_dict(),
+            "timeseries": self.timeseries.to_dict(
+                last_n=TIMESERIES_DUMP_SAMPLES),
+        }
 
     def flight_snapshot(self, *, reason: str = "scrape") -> dict[str, Any]:
         """The live flight snapshot a /metrics scrape serves — same
@@ -2164,11 +2306,36 @@ class Engine:
         Every input is host-side state this thread already owns or
         lock-guarded queue counters — scrape-safe from the exporter's
         handler thread while the serving loop runs."""
-        return self.telemetry.snapshot(reason=reason, stats=self.stats())
+        return self.telemetry.snapshot(
+            reason=reason, stats=self.stats(),
+            extra_sections=self._control_room_sections())
 
     def dump_flight(self, path: str, *,
                     reason: str = "serving") -> dict[str, Any]:
         """Flight-recorder-compatible JSON dump (tools/flight_report.py)."""
         self.telemetry.flush(self._iteration, len(self.queue),
                              self.scheduler.num_active)
-        return self.telemetry.dump(path, reason=reason, stats=self.stats())
+        return self.telemetry.dump(
+            path, reason=reason, stats=self.stats(),
+            extra_sections=self._control_room_sections())
+
+    def timeseries_snapshot(self) -> dict[str, Any]:
+        """Read-only JSON view of the telemetry ring for the exporter's
+        ``/timeseries`` endpoint — a scrape copies rows, it never
+        mutates (the scrape-safety lint rule pins this)."""
+        return self.timeseries.to_dict(last_n=TIMESERIES_DUMP_SAMPLES)
+
+    def alerts_snapshot(self) -> dict[str, Any]:
+        """Read-only JSON view of the alert engine (rules, counters,
+        active set, event log) for the exporter's ``/alerts`` endpoint.
+        Evaluation happens only on the engine thread at sample cadence;
+        a scrape only reads the log."""
+        return self.alerts.to_dict()
+
+    def close_incidents(self) -> None:
+        """Flush and stop the incident writer thread (drains any queued
+        bundles to disk synchronously). Idempotent; no-op when no
+        incident dir was configured. CLIs call this at exit, after the
+        last iteration, exactly like ``journal.shutdown()``."""
+        if self.incidents is not None:
+            self.incidents.shutdown()

@@ -677,19 +677,30 @@ class ServeTelemetry:
         return serving
 
     def snapshot(self, *, reason: str = "scrape",
-                 stats: dict[str, Any] | None = None) -> dict[str, Any]:
+                 stats: dict[str, Any] | None = None,
+                 extra_sections: dict[str, Any] | None = None,
+                 ) -> dict[str, Any]:
         """The live flight snapshot (dump shape, no disk): what the
         ``/metrics``/``/vars`` exporter serves mid-run. Reads only
         host-side state this object already holds — scrape-safe from
-        another thread by construction."""
-        return self.recorder.snapshot(
-            reason=reason, extra={"serving": self._serving_section(stats)})
+        another thread by construction. ``extra_sections`` lets the
+        engine ride additional top-level sections (``alerts``,
+        ``timeseries``) on the same snapshot."""
+        extra = {"serving": self._serving_section(stats)}
+        if extra_sections:
+            extra.update(extra_sections)
+        return self.recorder.snapshot(reason=reason, extra=extra)
 
     def dump(self, path: str, *, reason: str = "serving",
-             stats: dict[str, Any] | None = None) -> dict[str, Any]:
+             stats: dict[str, Any] | None = None,
+             extra_sections: dict[str, Any] | None = None,
+             ) -> dict[str, Any]:
         """Flight-recorder-compatible JSON dump with a ``serving`` extra
         section (``tools/flight_report.py`` renders it). ``stats`` lets
-        the engine pass its merged summary (queue counters included)."""
-        return self.recorder.dump(
-            path, reason=reason,
-            extra={"serving": self._serving_section(stats)})
+        the engine pass its merged summary (queue counters included);
+        ``extra_sections`` rides additional top-level sections exactly
+        as :meth:`snapshot` does."""
+        extra = {"serving": self._serving_section(stats)}
+        if extra_sections:
+            extra.update(extra_sections)
+        return self.recorder.dump(path, reason=reason, extra=extra)

@@ -228,8 +228,29 @@ def add_argument() -> argparse.Namespace:
                              "text, incl. TTFT/TPOT histograms + KV/slot "
                              "utilization), /healthz (serving/swapping/"
                              "draining/drained phase + weights_epoch and "
-                             "swap counters) and /vars, scrapeable while "
-                             "the engine serves (loopback; 0 = ephemeral)")
+                             "swap counters), /vars, /timeseries and "
+                             "/alerts, scrapeable while the engine "
+                             "serves (loopback; 0 = ephemeral)")
+    # Serving control room (serving/timeseries.py + serving/alerts.py;
+    # docs/OBSERVABILITY.md "Serving SLO alerting & incident capture").
+    parser.add_argument("--slo-rules", type=str, default=None,
+                        help="SLO burn-rate alerting: 'default' for "
+                             "the built-in rule set, or ';'-separated "
+                             "name:metric[/den]>objective[@fast,slow]"
+                             "[xburn][~clear] clauses "
+                             "(serving/alerts.py); evaluated every "
+                             "--sample-every iterations; off when "
+                             "unset")
+    parser.add_argument("--incident-dir", type=str, default=None,
+                        help="write one atomic incident bundle per "
+                             "alert fire (firing alert + alert log + "
+                             "last time-series window + flight "
+                             "snapshot) into this directory, off the "
+                             "hot path (tools/incident_report.py "
+                             "renders them); requires --slo-rules")
+    parser.add_argument("--sample-every", type=int, default=16,
+                        help="telemetry time-series sample cadence in "
+                             "iterations (never wall time)")
     parser.add_argument("--trace", action=argparse.BooleanOptionalAction,
                         default=False,
                         help="span-level Perfetto trace: one track per "
@@ -353,6 +374,9 @@ def main() -> int:
         journal_dir=args.journal_dir,
         journal_fsync=args.journal_fsync,
         journal_segment_bytes=args.journal_segment_bytes,
+        sample_every=args.sample_every,
+        slo_rules=args.slo_rules,
+        incident_dir=args.incident_dir,
         seed=args.seed,
     ), trace=trace, weights_epoch=restored_epoch)
 
@@ -516,6 +540,12 @@ def main() -> int:
               f"p95 {stats['tpot_p95_ms']:.2f} ms | "
               f"queue depth max {stats['queue_depth_max']}",
               file=sys.stderr)
+        if args.slo_rules:
+            print(f"[serve] alerts: {stats['alerts_fired']} fired, "
+                  f"{stats['alerts_cleared']} cleared, "
+                  f"{stats['alerts_active']} active | "
+                  f"incidents {stats['incidents_captured']}",
+                  file=sys.stderr)
     if args.ledger_out:
         from distributed_training_tpu.serving.ledger import dump_ledgers
 
@@ -526,6 +556,14 @@ def main() -> int:
     if args.flight_dump:
         engine.dump_flight(args.flight_dump)
         print(f"[serve] flight record: {args.flight_dump}", file=sys.stderr)
+    # Drain the incident writer so every captured bundle is on disk
+    # before the process exits (same discipline as journal.shutdown).
+    engine.close_incidents()
+    if args.incident_dir and engine.incidents is not None:
+        print(f"[serve] incidents: {args.incident_dir} "
+              f"({engine.incidents.captured} captured, "
+              f"{engine.incidents.write_errors} write error(s))",
+              file=sys.stderr)
     if trace is not None:
         trace.save(trace_path)
         print(f"[serve] trace: {trace_path} ({len(trace)} events)",

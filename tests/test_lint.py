@@ -138,6 +138,38 @@ class TestScrapeSafety:
                     return dict(self.recorder.stats())
         """, "scrape-safety")
 
+    def test_positive_control_room_provider_mutating_exits_1(
+            self, tmp_path, capsys):
+        # The control-room bug class this rule now guards: a
+        # /timeseries or /alerts provider that force-fills the ring or
+        # re-runs alert evaluation on the handler thread races the
+        # engine thread's sampling cadence and double-counts fires.
+        assert _exit_code(tmp_path, """
+            class Engine:
+                def timeseries_snapshot(self):
+                    self.timeseries.record_sample(self._sample())
+                    return self.timeseries.to_dict()
+
+                def alerts_snapshot(self):
+                    self.alerts.evaluate(self.timeseries, 0)
+                    return self.alerts.to_dict()
+        """, "scrape-safety") == 1
+        out = capsys.readouterr().out
+        assert "record_sample" in out and "evaluate" in out
+
+    def test_negative_control_room_to_dict_views_are_clean(
+            self, tmp_path):
+        # The shipped design: providers return to_dict() views only;
+        # record_sample/evaluate/capture live on the engine thread.
+        assert not _lint(tmp_path, """
+            class Engine:
+                def timeseries_snapshot(self):
+                    return self.timeseries.to_dict(last_n=64)
+
+                def alerts_snapshot(self):
+                    return self.alerts.to_dict()
+        """, "scrape-safety")
+
 
 class TestLockSignalSafety:
     # The pre-fix round-13 hot-swap pattern, minimized: serve()'s

@@ -1,0 +1,506 @@
+"""Serving control room: time-series ring, SLO burn-rate alerts,
+incident capture (serving/timeseries.py + serving/alerts.py).
+
+Load-bearing properties, in order:
+
+1. **Ring arithmetic**: fixed-capacity wrap, iteration-count cadence
+   metadata, windowed delta/rate/mean and the histogram-delta window
+   quantile (the Prometheus ``histogram_quantile(rate(...))`` idiom) —
+   all clamped, all 0.0 on empty windows.
+2. **Burn-rate semantics**: an alert fires only when BOTH the fast and
+   the slow window burn; a full slow window is required first ("no
+   data, no alert"); zero-tolerance rules fire from the second sample
+   on any increase; hysteresis clears at ``objective × clear_ratio``.
+   The event log is bounded (storms count, they don't grow memory).
+3. **Zero false positives** (acceptance): the shipped ``default`` rule
+   set never fires on a healthy in-process workload.
+4. **Process-history carry** (the ``requests_recovered`` precedent):
+   ``Engine.reset_stats`` starts a fresh ring but carries the alert
+   log, the fired/cleared counters and the incident count untouched.
+5. **Determinism** (what the CI alert drill gates): two identical
+   greedy runs produce bitwise-identical alert logs and identical
+   deterministic counter columns.
+6. **Read-only scrapes**: ``timeseries_snapshot``/``alerts_snapshot``
+   (and the exporter's ``/timeseries``/``/alerts`` endpoints) copy,
+   never mutate — the scrape-safety contract.
+7. **Incident round-trip**: a fire lands one atomic bundle that
+   ``tools/incident_report.py`` renders (exit 0); torn bundles exit 2.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_tpu.config import ServeConfig
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.observability.exporter import MetricsExporter
+from distributed_training_tpu.observability.histogram import FixedHistogram
+from distributed_training_tpu.serving import Engine
+from distributed_training_tpu.serving.alerts import (
+    MAX_LOG_EVENTS,
+    AlertEngine,
+    SLORule,
+    default_rules,
+    parse_slo_rules,
+)
+from distributed_training_tpu.serving.timeseries import (
+    TelemetryRing,
+    hist_fields,
+)
+
+
+# -- the ring -----------------------------------------------------------------
+
+def _ring(rows, capacity=64, sample_every=1):
+    r = TelemetryRing(capacity, sample_every)
+    for row in rows:
+        r.record_sample(row)
+    return r
+
+
+class TestTelemetryRing:
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            TelemetryRing(1, 1)
+        with pytest.raises(ValueError):
+            TelemetryRing(8, 0)
+
+    def test_schema_pinned_by_first_sample(self):
+        r = _ring([{"a": 1.0, "b": 2.0}])
+        assert r.fields == ("a", "b")
+        with pytest.raises(ValueError):
+            r.record_sample({"a": 1.0})
+
+    def test_wrap_keeps_newest_capacity_rows(self):
+        r = _ring([{"x": float(i)} for i in range(10)], capacity=4)
+        assert len(r) == 4
+        assert r.samples_recorded_total == 10
+        assert r.value("x") == 9.0
+        assert r.value("x", back=3) == 6.0
+        assert r.window("x", 10) == [6.0, 7.0, 8.0, 9.0]  # oldest first
+
+    def test_delta_clamps_to_retained_tail(self):
+        r = _ring([{"c": float(i)} for i in range(10)], capacity=4)
+        assert r.delta("c", 2) == 2.0
+        assert r.delta("c", 100) == 3.0  # clamped to the 4 retained rows
+        assert _ring([{"c": 5.0}]).delta("c", 5) == 0.0  # n < 2
+
+    def test_rate_per_sample_and_per_denominator(self):
+        r = _ring([{"c": 0.0, "den": 0.0},
+                   {"c": 6.0, "den": 2.0},
+                   {"c": 9.0, "den": 4.0}])
+        assert r.rate("c", 1) == 3.0        # (9-6)/1 sample
+        assert r.rate("c", 2) == 4.5        # (9-0)/2 samples
+        assert r.rate("c", 2, denominator="den") == 9.0 / 4.0
+        # No denominator events in the window: no fraction to take.
+        flat = _ring([{"c": 0.0, "den": 3.0}, {"c": 5.0, "den": 3.0}])
+        assert flat.rate("c", 1, denominator="den") == 0.0
+
+    def test_mean_clamps_and_handles_empty(self):
+        r = _ring([{"g": v} for v in (1.0, 2.0, 3.0)])
+        assert r.mean("g", 2) == 2.5
+        assert r.mean("g", 100) == 2.0
+
+    def test_window_quantile_matches_direct_histogram(self):
+        bounds = (1.0, 5.0, 25.0)
+        names = hist_fields("lat_ms", bounds)
+        assert names == ["lat_ms_le_00", "lat_ms_le_01", "lat_ms_le_02",
+                         "lat_ms_le_inf"]
+        hist = FixedHistogram(bounds)
+        for v in (0.5, 3.0):
+            hist.observe(v)
+        row1 = dict(zip(names, hist.cumulative()))
+        second_batch = (3.0, 4.0, 20.0, 30.0)
+        for v in second_batch:
+            hist.observe(v)
+        row2 = dict(zip(names, hist.cumulative()))
+        r = _ring([row1, row2])
+        direct = FixedHistogram(bounds)
+        for v in second_batch:
+            direct.observe(v)
+        for q in (0.5, 0.95):
+            # Window of 1 sample back = exactly the second batch.
+            assert r.window_quantile("lat_ms", bounds, q, 1) == \
+                direct.quantile(q)
+        # An empty window saw no observations: it cannot burn an SLO.
+        r.record_sample(row2)
+        assert r.window_quantile("lat_ms", bounds, 0.95, 1) == 0.0
+
+    def test_to_dict_is_a_copy_oldest_first(self):
+        r = _ring([{"x": float(i)} for i in range(5)], capacity=4)
+        d = r.to_dict(last_n=2)
+        assert d["format_version"] == 1
+        assert d["fields"] == ["x"]
+        assert d["samples"] == [[3.0], [4.0]]
+        assert d["samples_recorded_total"] == 5
+        d["samples"][0][0] = 999.0  # a scrape copies, it never mutates
+        assert r.value("x", back=1) == 3.0
+        assert r.to_dict()["samples"] == [[1.0], [2.0], [3.0], [4.0]]
+
+
+# -- rules and parsing --------------------------------------------------------
+
+class TestSLORuleValidation:
+    def test_full_clause_grammar(self):
+        rules = parse_slo_rules(
+            "shed:requests_shed/requests_submitted>0.05@3,9x1.5~0.5")
+        (r,) = rules
+        assert r.name == "shed" and r.metric == "requests_shed"
+        assert r.denominator == "requests_submitted"
+        assert r.objective == 0.05
+        assert (r.fast_window, r.slow_window) == (3, 9)
+        assert r.burn_threshold == 1.5 and r.clear_ratio == 0.5
+
+    def test_default_expansion_and_mixing(self):
+        assert [r.name for r in parse_slo_rules("default")] == \
+            [r.name for r in default_rules()]
+        rules = parse_slo_rules("default;extra:queue_depth>2@3,10")
+        assert rules[-1].name == "extra"
+        assert len(rules) == len(default_rules()) + 1
+
+    @pytest.mark.parametrize("spec", [
+        "nope",                       # no clause shape at all
+        "a:x>",                       # missing objective
+        "a:x>1;a:y>2",                # duplicate names
+        "a:x>1@9,3",                  # fast > slow
+        "a:x>-1",                     # negative objective
+        "a:x>1x0",                    # burn_threshold must be > 0
+        "a:x>1~1.5",                  # clear_ratio outside [0, 1]
+        "a:x/den>0",                  # zero-tolerance takes a bare counter
+    ])
+    def test_bad_specs_raise(self, spec):
+        with pytest.raises(ValueError):
+            parse_slo_rules(spec)
+
+    def test_duplicate_rule_names_rejected_by_engine_too(self):
+        r = SLORule("a", "x", 1.0)
+        with pytest.raises(ValueError):
+            AlertEngine([r, r])
+
+
+class TestBurnRateSemantics:
+    RULE = SLORule("r", "g", 10.0, fast_window=2, slow_window=4,
+                   clear_ratio=0.8)
+
+    def _drive(self, ae, ring, values, start=0):
+        fired = []
+        for i, v in enumerate(values):
+            ring.record_sample({"g": float(v), "c": 0.0})
+            fired.extend(ae.evaluate(ring, start + i))
+        return fired
+
+    def test_no_full_slow_window_no_alert(self):
+        ae, ring = AlertEngine([self.RULE]), TelemetryRing(64, 1)
+        # Four samples way over the objective: slow_window + 1 = 5
+        # samples are required before the rule may speak at all.
+        assert not self._drive(ae, ring, [100, 100, 100, 100])
+        assert ae.fired == 0
+
+    def test_fast_and_slow_must_both_burn(self):
+        ae, ring = AlertEngine([self.RULE]), TelemetryRing(64, 1)
+        # n=5: fast mean(20,20)=20 burns, slow mean(0,0,20,20)=10 does
+        # not (> is strict) — the one-sample blip is absorbed.
+        assert not self._drive(ae, ring, [0, 0, 0, 20, 20])
+        # One more hot sample tips the slow window: mean(0,20,20,20)=15.
+        fired = self._drive(ae, ring, [20], start=5)
+        assert [e["rule"] for e in fired] == ["r"]
+        assert ae.fired == 1 and ae.active == ["r"]
+        ev = fired[0]
+        assert ev["event"] == "fire" and ev["iteration"] == 5
+        assert ev["value_fast"] == 20.0 and ev["value_slow"] == 15.0
+
+    def test_hysteresis_clear_band(self):
+        ae, ring = AlertEngine([self.RULE]), TelemetryRing(64, 1)
+        self._drive(ae, ring, [0, 0, 0, 20, 20, 20])
+        assert ae.active == ["r"]
+        # fast mean(20,9)=14.5 is under the objective but above the
+        # clear threshold 10*0.8=8: the alert stands (no flapping).
+        self._drive(ae, ring, [9], start=6)
+        assert ae.active == ["r"] and ae.cleared == 0
+        # fast mean(9,7)=8 <= 8: now it clears.
+        self._drive(ae, ring, [7], start=7)
+        assert ae.active == [] and ae.cleared == 1
+        assert [e["event"] for e in ae.log] == ["fire", "clear"]
+        assert ae.log[1]["iteration"] == 7
+
+    def test_zero_tolerance_fires_from_second_sample(self):
+        rule = SLORule("z", "c", 0.0, fast_window=1, slow_window=1)
+        ae, ring = AlertEngine([rule]), TelemetryRing(64, 1)
+        ring.record_sample({"g": 0.0, "c": 5.0})
+        assert not ae.evaluate(ring, 0)  # one sample: no delta yet
+        ring.record_sample({"g": 0.0, "c": 5.0})
+        assert not ae.evaluate(ring, 1)  # no increase
+        ring.record_sample({"g": 0.0, "c": 6.0})
+        assert [e["rule"] for e in ae.evaluate(ring, 2)] == ["z"]
+        ring.record_sample({"g": 0.0, "c": 6.0})
+        assert not ae.evaluate(ring, 3)
+        assert ae.cleared == 1  # delta back to 0 clears immediately
+
+    def test_unknown_metric_fails_fast(self):
+        ae = AlertEngine([SLORule("r", "not_sampled", 1.0)])
+        ring = _ring([{"g": 0.0}])
+        with pytest.raises(ValueError, match="not_sampled"):
+            ae.evaluate(ring, 0)
+
+    def test_log_bounded_under_alert_storm(self):
+        rule = SLORule("z", "c", 0.0, fast_window=1, slow_window=1)
+        ae, ring = AlertEngine([rule]), TelemetryRing(8, 1)
+        c = 0.0
+        for i in range(300):  # increment/plateau pairs: fire, clear, ...
+            c += 1.0
+            ring.record_sample({"c": c})
+            ae.evaluate(ring, 2 * i)
+            ring.record_sample({"c": c})
+            ae.evaluate(ring, 2 * i + 1)
+        assert ae.fired == ae.cleared > MAX_LOG_EVENTS // 2
+        assert len(ae.log) == MAX_LOG_EVENTS
+        assert ae.log_dropped == ae.fired + ae.cleared - MAX_LOG_EVENTS
+        assert ae.to_dict()["log_dropped"] == ae.log_dropped
+
+
+# -- config surface -----------------------------------------------------------
+
+class TestServeConfigValidation:
+    def test_bad_cadence_and_capacity_raise(self):
+        with pytest.raises(ValueError):
+            ServeConfig(sample_every=0)
+        with pytest.raises(ValueError):
+            ServeConfig(timeseries_capacity=1)
+
+    def test_incident_dir_requires_rules(self):
+        with pytest.raises(ValueError, match="incident_dir"):
+            ServeConfig(incident_dir="/tmp/nowhere")
+
+    def test_bad_slo_spec_fails_at_engine_construction(self, lm):
+        model, params = lm
+        with pytest.raises(ValueError, match="bad SLO rule clause"):
+            Engine(model, params, ServeConfig(slo_rules="not a spec"))
+
+
+# -- engine integration -------------------------------------------------------
+
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model("transformer_lm", num_classes=VOCAB, num_layers=1,
+                      num_heads=2, hidden_dim=32, max_len=48)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))["params"]
+    return model, params
+
+
+def _prompts(n=3, seed=3):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, VOCAB, size=l).astype(np.int32)
+            for l in (5, 7, 4, 6, 3)[:n]]
+
+
+# A rule that provably fires on ANY decode progress: zero-tolerance on
+# the tokens_emitted counter with one-sample windows.
+FIRING_RULES = "tok:tokens_emitted>0@1,1"
+
+
+@pytest.fixture(scope="module")
+def fired(lm, tmp_path_factory):
+    """One engine run whose rule set fired and captured incidents."""
+    model, params = lm
+    inc_dir = str(tmp_path_factory.mktemp("incidents"))
+    eng = Engine(model, params, ServeConfig(
+        max_batch=2, max_new_tokens=4, sample_every=1,
+        slo_rules=FIRING_RULES, incident_dir=inc_dir))
+    for p in _prompts():
+        eng.submit(p)
+    done = eng.run()
+    assert len(done) == 3
+    eng.close_incidents()
+    return eng, inc_dir
+
+
+class TestEngineControlRoom:
+    def test_ring_sampled_at_iteration_cadence(self, fired):
+        eng, _ = fired
+        ring = eng.timeseries
+        assert len(ring) >= 2
+        assert ring.sample_every == 1
+        its = ring.window("iteration", len(ring))
+        assert its == sorted(its) and len(set(its)) == len(its)
+        # The newest sample's counters match the engine's own stats.
+        assert ring.value("tokens_emitted") == \
+            eng.stats()["tokens_emitted"]
+
+    def test_alert_fired_and_stats_counters(self, fired):
+        eng, _ = fired
+        st = eng.stats()
+        assert st["alerts_fired"] == eng.alerts.fired >= 1
+        assert st["alerts_cleared"] == eng.alerts.cleared
+        assert st["alerts_active"] == len(eng.alerts.active)
+        assert st["incidents_captured"] == eng.incidents.captured == \
+            len(eng.incidents.paths)
+        assert eng.alerts.log[0]["rule"] == "tok"
+        assert eng.incidents.write_errors == 0
+
+    def test_flight_snapshot_carries_control_room_sections(self, fired):
+        eng, _ = fired
+        snap = eng.flight_snapshot()
+        assert snap["alerts"]["fired"] == eng.alerts.fired
+        assert snap["timeseries"]["samples"]
+        json.dumps(snap, allow_nan=False)  # dump-grade strict JSON
+
+    def test_snapshots_do_not_mutate(self, fired):
+        eng, _ = fired
+        rows_before = eng.timeseries.samples_recorded_total
+        log_before = len(eng.alerts.log)
+        a1, t1 = eng.alerts_snapshot(), eng.timeseries_snapshot()
+        a1["fired"] = 999
+        t1["samples"].clear()
+        a2, t2 = eng.alerts_snapshot(), eng.timeseries_snapshot()
+        assert a2["fired"] == eng.alerts.fired != 999
+        assert t2["samples"]
+        assert eng.timeseries.samples_recorded_total == rows_before
+        assert len(eng.alerts.log) == log_before
+
+    def test_incident_bundle_round_trip(self, fired, capsys):
+        from conftest import load_cli_module
+
+        eng, inc_dir = fired
+        paths = eng.incidents.paths
+        assert paths and paths[0].endswith("incident_000_tok.json")
+        with open(paths[0]) as fh:
+            bundle = json.load(fh)
+        assert bundle["format_version"] == 1
+        assert bundle["alert"]["rule"] == "tok"
+        assert bundle["timeseries"]["samples"]
+        # The bundle's flight section must NOT nest the control-room
+        # sections again — they live at bundle top level.
+        assert "alerts" not in bundle["flight"]
+        report = load_cli_module("tools/incident_report.py")
+        assert report.main([inc_dir]) == 0
+        out = capsys.readouterr().out
+        assert "incident: rule 'tok'" in out
+        assert "alerts:" in out and "timeseries:" in out
+        assert report.main(["--json", paths[0]]) == 0
+        json.loads(capsys.readouterr().out)  # one strict-JSON summary
+
+    def test_incident_report_torn_bundle_exits_2(self, tmp_path, capsys):
+        from conftest import load_cli_module
+
+        report = load_cli_module("tools/incident_report.py")
+        assert report.main([str(tmp_path / "gone.json")]) == 2
+        torn = tmp_path / "incident_000_torn.json"
+        torn.write_text('{"format_version": 1}')
+        assert report.main([str(torn)]) == 2
+        assert "missing" in capsys.readouterr().err
+
+    def test_reset_stats_carries_alert_history(self, fired):
+        """Runs last in this class: the window reset starts a fresh
+        ring but alert/incident history is PROCESS history (the
+        requests_recovered precedent) and must survive."""
+        eng, _ = fired
+        fired_before = eng.alerts.fired
+        log_before = list(eng.alerts.log)
+        incidents_before = eng.incidents.captured
+        assert fired_before >= 1
+        eng.reset_stats()
+        assert len(eng.timeseries) == 0
+        assert eng.timeseries.samples_recorded_total == 0
+        assert eng.alerts.fired == fired_before
+        assert eng.alerts.log == log_before
+        st = eng.stats()
+        assert st["alerts_fired"] == fired_before
+        assert st["incidents_captured"] == incidents_before
+
+
+class TestZeroFalsePositives:
+    def test_default_rules_silent_on_healthy_run(self, lm):
+        """Acceptance pin: the shipped rule set must never fire on a
+        healthy workload — an alert that cries wolf is worse than no
+        alert."""
+        model, params = lm
+        eng = Engine(model, params, ServeConfig(
+            max_batch=2, max_new_tokens=4, sample_every=1,
+            slo_rules="default"))
+        for p in _prompts():
+            eng.submit(p)
+        eng.run()
+        st = eng.stats()
+        assert st["alerts_fired"] == 0
+        assert st["alerts_cleared"] == 0
+        assert st["alerts_active"] == 0
+        assert st["incidents_captured"] == 0
+        assert eng.alerts.log == []
+
+
+class TestDeterminism:
+    def test_identical_runs_identical_alert_logs(self, lm):
+        """The CI drill's contract, in-process: same config + same
+        greedy workload → bitwise-identical alert logs and identical
+        deterministic counter columns (wall-derived columns may
+        differ)."""
+        model, params = lm
+
+        def run():
+            eng = Engine(model, params, ServeConfig(
+                max_batch=2, max_new_tokens=4, sample_every=1,
+                slo_rules=FIRING_RULES))
+            for p in _prompts():
+                eng.submit(p)
+            eng.run()
+            return eng
+
+        a, b = run(), run()
+        assert json.dumps(a.alerts.to_dict(), sort_keys=True) == \
+            json.dumps(b.alerts.to_dict(), sort_keys=True)
+        assert a.alerts.fired >= 1
+        for col in ("iteration", "tokens_emitted", "requests_finished",
+                    "queue_depth", "requests_shed"):
+            assert a.timeseries.window(col, len(a.timeseries)) == \
+                b.timeseries.window(col, len(b.timeseries)), col
+
+
+# -- exporter endpoints -------------------------------------------------------
+
+class TestControlRoomEndpoints:
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=10.0) as resp:
+            return (resp.status, resp.headers.get("Content-Type", ""),
+                    resp.read().decode("utf-8"))
+
+    def test_timeseries_and_alerts_endpoints(self):
+        ring = _ring([{"x": 1.0}, {"x": 2.0}])
+        ae = AlertEngine([SLORule("r", "x", 10.0)])
+        exp = MetricsExporter(
+            lambda: {"format_version": 1}, port=0,
+            timeseries_provider=ring.to_dict,
+            alerts_provider=ae.to_dict).start()
+        try:
+            code, ctype, body = self._get(exp.url("/timeseries"))
+            assert code == 200 and ctype.startswith("application/json")
+            ts = json.loads(body)
+            assert ts["fields"] == ["x"] and len(ts["samples"]) == 2
+            code, _, body = self._get(exp.url("/alerts"))
+            assert code == 200
+            al = json.loads(body)
+            assert al["fired"] == 0 and al["rules"][0]["name"] == "r"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(exp.url("/nope"))
+            endpoints = json.loads(ei.value.read().decode())["endpoints"]
+            assert "/timeseries" in endpoints and "/alerts" in endpoints
+        finally:
+            exp.close()
+
+    def test_unregistered_providers_404(self):
+        exp = MetricsExporter(lambda: {"format_version": 1},
+                              port=0).start()
+        try:
+            for path in ("/timeseries", "/alerts"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    self._get(exp.url(path))
+                assert ei.value.code == 404
+        finally:
+            exp.close()

@@ -160,6 +160,16 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     # kill/restart cycles
     "requests_recovered": ("both", 0.0),
     "tokens_recomputed_on_recovery": ("both", 0.0),
+    # serving control room (serving/alerts.py): on every baseline row
+    # the bench runs with no SLO rules configured, so all three
+    # counters are exactly zero — and the zero-baseline zero-tolerance
+    # semantics turn ANY fired alert or captured incident in a clean
+    # smoke into a gate failure (false-positive rate pinned at 0). The
+    # CI alert drill separately proves the rules DO fire (bitwise) on
+    # the degrading scenario.
+    "alerts_fired": ("both", 0.0),
+    "alerts_cleared": ("both", 0.0),
+    "incidents_captured": ("both", 0.0),
 }
 
 

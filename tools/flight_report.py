@@ -76,6 +76,13 @@ def summarize(snap: dict) -> dict:
     # retries, chaos faults — resilience/; docs/RESILIENCE.md).
     if snap.get("resilience"):
         out["resilience"] = snap["resilience"]
+    # Serving control room (serving/alerts.py + serving/timeseries.py):
+    # the SLO alert log and the sampled telemetry window ride engine
+    # dumps as top-level sections.
+    if snap.get("alerts"):
+        out["alerts"] = snap["alerts"]
+    if snap.get("timeseries"):
+        out["timeseries"] = snap["timeseries"]
     return out
 
 
@@ -203,6 +210,44 @@ def render(summary: dict) -> str:
                 f" (expired {degraded['requests_preempt_timed_out']}, "
                 f"recompute "
                 f"{srv.get('preempted_token_recompute', 0):.0f} tok)")
+    al = summary.get("alerts")
+    if al:
+        active = ", ".join(al.get("active") or []) or "none"
+        add(f"  alerts: {al.get('fired', 0)} fired  "
+            f"{al.get('cleared', 0)} cleared  active: {active}  "
+            f"({len(al.get('rules') or [])} rule(s))")
+        for ev in (al.get("log") or [])[-8:]:
+            add(f"    [{ev['event']}] {ev['rule']} @ iteration "
+                f"{ev['iteration']}: {ev['metric']} fast "
+                f"{ev['value_fast']:.4g} / slow {ev['value_slow']:.4g} "
+                f"(objective {ev['objective']:.4g})")
+        if al.get("log_dropped"):
+            add(f"    ({al['log_dropped']} older event(s) dropped)")
+    ts = summary.get("timeseries")
+    if ts and ts.get("samples"):
+        fields = ts.get("fields") or []
+        samples = ts["samples"]
+        idx = {k: i for i, k in enumerate(fields)}
+
+        def col(name, row):
+            return row[idx[name]] if name in idx else 0.0
+
+        first, newest = samples[0], samples[-1]
+        add(f"  timeseries: {len(samples)} sample(s) retained "
+            f"(of {ts.get('samples_recorded_total', 0)} recorded, "
+            f"every {ts.get('sample_every', 0)} iteration(s))")
+        add(f"    window: iterations {col('iteration', first):.0f}.."
+            f"{col('iteration', newest):.0f}  tokens "
+            f"+{col('tokens_emitted', newest) - col('tokens_emitted', first):.0f}"
+            f"  finished "
+            f"+{col('requests_finished', newest) - col('requests_finished', first):.0f}"
+            f"  shed "
+            f"+{col('requests_shed', newest) - col('requests_shed', first):.0f}")
+        if "queue_depth" in idx:
+            depths = [r[idx["queue_depth"]] for r in samples]
+            add(f"    queue depth: last {depths[-1]:.0f}  mean "
+                f"{sum(depths) / len(depths):.1f}  max "
+                f"{max(depths):.0f}")
     hosts = summary.get("hosts")
     if hosts:
         line = f"  hosts: {hosts['num_hosts']}"

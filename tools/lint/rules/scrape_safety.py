@@ -37,7 +37,8 @@ from tools.lint.graph import FunctionInfo, ProjectIndex
 NAME = "scrape-safety"
 
 HANDLER_NAMES = {"do_GET", "do_POST"}
-PROVIDER_NAMES = {"flight_snapshot", "scrape_snapshot", "health"}
+PROVIDER_NAMES = {"flight_snapshot", "scrape_snapshot", "health",
+                  "timeseries_snapshot", "alerts_snapshot"}
 
 DEVICE_READS = {"device_get", "block_until_ready", "item", "tolist",
                 "memory_stats", "device_memory_metrics"}
@@ -50,7 +51,13 @@ TELEMETRY_MUTATION = {"flush", "record_flush", "record_step", "mark_gap",
                       "end_work", "on_step", "on_flush", "on_tokens",
                       "on_kv", "on_admitted", "on_finished",
                       "on_iteration", "on_idle", "on_admission_blocked",
-                      "on_swap_applied", "on_swap_rejected"}
+                      "on_swap_applied", "on_swap_rejected",
+                      # Serving control room (serving/timeseries.py +
+                      # serving/alerts.py): ring appends, alert-engine
+                      # evaluation, and incident capture are engine-
+                      # thread mutations — /timeseries and /alerts
+                      # scrapes only read to_dict() views.
+                      "record_sample", "evaluate", "capture"}
 COMPILED_DISPATCH = {"apply"}
 
 

@@ -224,8 +224,36 @@ def add_argument() -> argparse.Namespace:
     p.add_argument("--flight-dump", type=str, default=None)
     p.add_argument("--metrics-port", type=int, default=None,
                    help="live telemetry plane: /metrics (Prometheus "
-                        "text), /healthz and /vars scrapeable while the "
-                        "bench runs (loopback; 0 = ephemeral port)")
+                        "text), /healthz, /vars, /timeseries and "
+                        "/alerts scrapeable while the bench runs "
+                        "(loopback; 0 = ephemeral port)")
+    # Serving control room (serving/timeseries.py + serving/alerts.py;
+    # docs/OBSERVABILITY.md "Serving SLO alerting & incident capture").
+    p.add_argument("--slo-rules", type=str, default=None,
+                   help="SLO burn-rate alerting: 'default' for the "
+                        "built-in rule set, or ';'-separated "
+                        "name:metric[/den]>objective[@fast,slow]"
+                        "[xburn][~clear] clauses (serving/alerts.py). "
+                        "Rules are evaluated every --sample-every "
+                        "iterations over the telemetry ring; off when "
+                        "unset")
+    p.add_argument("--incident-dir", type=str, default=None,
+                   help="write one atomic incident bundle (firing "
+                        "alert + alert log + last time-series window + "
+                        "flight snapshot) per alert fire into this "
+                        "directory, off the hot path "
+                        "(tools/incident_report.py renders them); "
+                        "requires --slo-rules")
+    p.add_argument("--sample-every", type=int, default=16,
+                   help="telemetry ring sample cadence in iterations "
+                        "(iteration count, never wall time — "
+                        "--virtual-dt alert drills are bitwise "
+                        "reproducible)")
+    p.add_argument("--alert-log-out", type=str, default=None,
+                   help="write the full alert-engine state (rules, "
+                        "counters, fire/clear event log) as strict "
+                        "JSON at exit — the CI alert drill's bitwise "
+                        "determinism artifact")
     p.add_argument("--trace", action=argparse.BooleanOptionalAction,
                    default=False,
                    help="span-level Perfetto trace of the measured "
@@ -317,6 +345,9 @@ def main() -> int:
         journal_dir=args.journal_dir,
         journal_fsync=args.journal_fsync,
         journal_segment_bytes=args.journal_segment_bytes,
+        sample_every=args.sample_every,
+        slo_rules=args.slo_rules,
+        incident_dir=args.incident_dir,
         seed=args.seed), trace=trace)
 
     # Crash-durable serving: replay the write-ahead journal BEFORE any
@@ -603,6 +634,23 @@ def main() -> int:
         engine.dump_flight(args.flight_dump, reason="serve_bench")
         print(f"[serve_bench] flight record: {args.flight_dump}",
               file=sys.stderr)
+    # Control room artifacts: drain the incident writer (bundles hit
+    # disk before the process exits), then the alert log — the CI
+    # drill diffs two --virtual-dt runs' logs byte for byte.
+    engine.close_incidents()
+    if args.incident_dir and engine.incidents is not None:
+        print(f"[serve_bench] incidents: {args.incident_dir} "
+              f"({engine.incidents.captured} captured, "
+              f"{engine.incidents.write_errors} write error(s))",
+              file=sys.stderr)
+    if args.alert_log_out:
+        with open(args.alert_log_out, "w") as fh:
+            json.dump(engine.alerts.to_dict(), fh, indent=1,
+                      allow_nan=False)
+            fh.write("\n")
+        print(f"[serve_bench] alert log: {args.alert_log_out} "
+              f"({engine.alerts.fired} fired, "
+              f"{engine.alerts.cleared} cleared)", file=sys.stderr)
     if trace is not None:
         trace.save(trace_path)
         print(f"[serve_bench] trace: {trace_path} ({len(trace)} events)",

@@ -237,6 +237,34 @@ def _preempt_storm(rng: np.random.RandomState,
     return out
 
 
+def _degrading(rng: np.random.RandomState,
+               p: ScenarioParams) -> list[TrafficRequest]:
+    """The SLO alert drill workload: a healthy steady state that takes
+    a seeded mid-run step change for the worse. The first 40% of
+    requests arrive at the configured rate with short prompts and
+    half-budget completions (baseline-shaped: no rule should burn);
+    from the knee on, arrivals jump to 8x the rate with double-length
+    prompts and full-budget completions — queue depth, shed counters,
+    and latency all degrade together, so the burn-rate rules MUST fire
+    in the degraded half and provably cannot in the healthy half. A
+    pure function of (seed, params) like every scenario: the knee is a
+    request index, never a wall-clock time."""
+    knee = max(int(round(p.requests * 0.4)), 1)
+    n_after = p.requests - knee
+    out: list[TrafficRequest] = []
+    t = np.cumsum(rng.exponential(1.0 / p.rate, size=knee))
+    for i in range(knee):
+        out.append(_req(rng, p, t[i],
+                        max(p.mean_prompt_len // 2, 1),
+                        max(p.max_new_tokens // 2, 1)))
+    t0 = float(t[-1]) if knee else 0.0
+    dt = np.cumsum(rng.exponential(1.0 / (8.0 * p.rate), size=n_after))
+    for i in range(n_after):
+        out.append(_req(rng, p, t0 + dt[i], 2 * p.mean_prompt_len,
+                        p.max_new_tokens))
+    return out
+
+
 _SHARED_PREFIX_TENANTS = ("alpha", "beta", "gamma")
 
 
@@ -318,6 +346,11 @@ SCENARIOS: dict[str, Scenario] = {
                               "slots filled with best-effort work, "
                               "then high-tier waves force repeated "
                               "lossless preemptions"),
+    "degrading": Scenario(_degrading, 1, None,
+                          "healthy steady state, then a seeded mid-run "
+                          "8x rate + prompt-length step change (the "
+                          "SLO alert drill: rules must fire after the "
+                          "knee, never before)"),
     "shared_prefix": Scenario(_shared_prefix, 1, None,
                               "tenants sharing Zipf-weighted "
                               "system-prompt preambles + unique "
