@@ -89,6 +89,9 @@ from distributed_training_tpu.serving.alerts import (  # noqa: F401
     parse_slo_rules,
 )
 from distributed_training_tpu.serving.engine import Engine  # noqa: F401
+from distributed_training_tpu.serving.frontend import (  # noqa: F401
+    ServingFrontend,
+)
 from distributed_training_tpu.serving.journal import (  # noqa: F401
     JournaledRequest,
     RecoveredState,
@@ -122,6 +125,11 @@ from distributed_training_tpu.serving.request import (  # noqa: F401
     ActiveSequence,
     FinishedRequest,
     Request,
+)
+from distributed_training_tpu.serving.router import (  # noqa: F401
+    HttpReplica,
+    Router,
+    RouterFrontDoor,
 )
 from distributed_training_tpu.serving.scheduler import SlotScheduler  # noqa: F401
 from distributed_training_tpu.serving.speculative import (  # noqa: F401

@@ -393,6 +393,14 @@ class Engine:
             if cfg.incident_dir else None)
         self._base_rng = jax.random.PRNGKey(cfg.seed)
         self._iteration = 0
+        # Network front door (serving/frontend.py): an optional token
+        # listener rides the per-iteration landing — _finish_iteration
+        # publishes each active sequence's newly landed tokens (host
+        # ints, past a per-uid cursor) and every completion, exactly
+        # like the journal sweep it mirrors. One dynamic callable, set
+        # before serving; None costs nothing.
+        self._token_listener = None
+        self._stream_cursor: dict[int, int] = {}
 
         # Donation keeps one cache resident instead of two per decode
         # step; the CPU backend can't donate (it would only warn noisily).
@@ -690,17 +698,20 @@ class Engine:
     # -- host-side lifecycle -------------------------------------------------
     def submit(self, prompt, max_new_tokens: int | None = None,
                arrival_t: float | None = None, priority: int = 0,
-               tenant: str = "default") -> Request:
+               tenant: str = "default",
+               deadline_ms: float | None = None) -> Request:
         """Enqueue a request (thread-safe). ``priority`` is its SLO tier
         (0 = highest, < ``cfg.num_tiers``), ``tenant`` its fairness
-        principal. Raises :class:`~distributed_training_tpu.inference.
-        sampler.CacheBudgetError` when it can never fit a slot's page
-        table (or the legacy contiguous budget). With a journal, the
-        admission record is durable before this returns — a request the
-        journal never saw was never accepted."""
+        principal, ``deadline_ms`` an optional per-request total
+        deadline overriding the configured default (the front door's
+        deadline field). Raises :class:`~distributed_training_tpu.
+        inference.sampler.CacheBudgetError` when it can never fit a
+        slot's page table (or the legacy contiguous budget). With a
+        journal, the admission record is durable before this returns —
+        a request the journal never saw was never accepted."""
         req = self.queue.submit(prompt, max_new_tokens=max_new_tokens,
                                 arrival_t=arrival_t, priority=priority,
-                                tenant=tenant)
+                                tenant=tenant, deadline_ms=deadline_ms)
         if self.journal is not None:
             try:
                 self.journal.log_admit(req)
@@ -1103,10 +1114,23 @@ class Engine:
                         if req.priority > 0 else 0)
             return self.pool.available + freeable >= need + headroom
 
-        seated = self.scheduler.admit(self.queue, can_seat,
-                                      on_seat=on_seat,
-                                      on_preempt=on_preempt,
-                                      preempt_helps=preempt_helps)
+        def prefix_probe(entry) -> int:
+            # Cache-aware seat ordering (read-only trie walk): among
+            # equal-fairness tenant heads, the queue seats the one with
+            # the larger resident prefix first — it commits fewer pages
+            # and prefills only its tail. With the cache off the probe
+            # is never passed, so candidate order is bitwise the old
+            # (service, tenant, uid) key (pinned by test_frontend.py).
+            toks = (entry.prefill_tokens
+                    if isinstance(entry, ActiveSequence) else entry.prompt)
+            return len(self.prefix_cache.probe(
+                toks, max_tokens=self._hit_cap(entry))) * self.page_size
+
+        seated = self.scheduler.admit(
+            self.queue, can_seat, on_seat=on_seat, on_preempt=on_preempt,
+            preempt_helps=preempt_helps,
+            prefix_probe=(prefix_probe if self.prefix_cache is not None
+                          else None))
         # Anything still queued is head-of-line blocked on slots or
         # pages until the next boundary (preemption included) — the
         # /healthz "overloaded" signal.
@@ -1818,6 +1842,25 @@ class Engine:
                 self.journal.note_tokens(seq)
             for fin in finished:
                 self.journal.note_finish(fin)
+        if self._token_listener is not None:
+            # Streaming sweep (serving/frontend.py): publish newly
+            # landed tokens per active sequence past the per-uid
+            # cursor, then every completion with its authoritative
+            # token array — the SSE delivery point, same boundary the
+            # journal sweep rides. Host ints only (note_token casts at
+            # landing); the listener buffers, it never blocks.
+            cb = self._token_listener
+            for seq in self.scheduler.active():
+                uid = seq.request.uid
+                have = self._stream_cursor.get(uid, 0)
+                if len(seq.tokens) > have:
+                    cb(uid, list(seq.tokens[have:]), None)
+                    self._stream_cursor[uid] = len(seq.tokens)
+            for fin in finished:
+                have = self._stream_cursor.pop(fin.uid, 0)
+                # graftlint: disable=hot-path-transfer -- fin.tokens is the host int32 completion array by contract; no device value involved
+                tail = [int(t) for t in fin.tokens[have:]]
+                cb(fin.uid, tail, fin)
         if had_work:
             self.telemetry.on_iteration(
                 it, queue_depth=len(self.queue),
@@ -1979,6 +2022,33 @@ class Engine:
         out = self.run(max_iterations)
         self._drained = self.idle
         return out
+
+    def close_admission(self) -> None:
+        """Close admission WITHOUT driving the loop (idempotent) — the
+        front-door drain path (serving/frontend.py): its serve-loop
+        thread keeps stepping until idle, so a blocking :meth:`drain`
+        from a handler thread would race it. Pair with
+        :meth:`poll_drained` from the loop thread."""
+        self.queue.close()
+
+    def poll_drained(self) -> bool:
+        """Latch (and report) drain completion: True once admission is
+        closed and every accepted request has finished. The frontend's
+        serve loop calls this each iteration while draining — the latch
+        is what flips :attr:`phase` to ``drained``, the signal a
+        rolling-deploy driver waits on before swapping weights."""
+        if self.draining and self.idle:
+            self._drained = True
+        return self._drained
+
+    def reopen(self) -> None:
+        """Reopen admission after a completed drain (idempotent): the
+        zero-downtime rolling-deploy step (serving/router.py) — drain,
+        apply the staged swap at the empty-engine boundary, reopen.
+        The engine is the same engine: uid sequence, telemetry, journal
+        and fairness state all carry across."""
+        self.queue.reopen()
+        self._drained = False
 
     def recover(self) -> dict[str, Any]:
         """Replay the write-ahead journal BEFORE serving (crash-durable
@@ -2170,6 +2240,45 @@ class Engine:
                 if self.journal is not None else 0),
             "journal_fsyncs": (self.journal.fsyncs
                                if self.journal is not None else 0),
+        }
+
+    def set_token_listener(self, listener) -> None:
+        """Register (or clear, with None) the per-iteration token
+        listener the network front door streams from
+        (serving/frontend.py). ``listener(uid, new_tokens, fin)`` is
+        called at every iteration tail on the ENGINE thread: once per
+        active sequence that landed tokens this iteration
+        (``fin=None``), and once per completion with the remaining tail
+        and the :class:`FinishedRequest`. Set before serving; the
+        listener must only buffer (hot-path discipline: the decode loop
+        never blocks on a consumer)."""
+        self._token_listener = listener
+        self._stream_cursor.clear()
+
+    def probe_snapshot(self, tokens=None) -> dict[str, Any]:
+        """Read-only routing probe for the front door (serving/
+        router.py): the resident-prefix coverage the radix trie holds
+        for ``tokens`` plus the replica-selection signals — ledger
+        ``queue_wait`` p95 (the fallback routing key), queue/slot
+        occupancy, phase, and the deployed weights epoch. Scrape-safe
+        by construction (the graftlint scrape-safety rule roots here):
+        :meth:`PrefixCache.probe` walks the trie without touching
+        refcounts or recency, and everything else is host-side state
+        the hot loop already materialized."""
+        hit = 0
+        if (self.prefix_cache is not None and tokens is not None
+                and len(tokens) > 1):
+            arr = np.asarray(tokens, np.int32)
+            hit = len(self.prefix_cache.probe(
+                arr, max_tokens=arr.size - 1)) * self.page_size
+        return {
+            "hit_tokens": hit,
+            "queue_wait_p95_ms": self.telemetry.queue_wait_p95_ms(),
+            "queue_depth": len(self.queue),
+            "active_slots": self.scheduler.num_active,
+            "draining": bool(self.draining or self._drained),
+            "phase": self.phase,
+            "weights_epoch": int(self.weights_epoch),
         }
 
     def compiled_programs(self) -> dict[str, int | None]:

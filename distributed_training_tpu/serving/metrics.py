@@ -486,6 +486,14 @@ class ServeTelemetry:
         })
 
     # -- derived -------------------------------------------------------------
+    def queue_wait_p95_ms(self) -> float:
+        """The routing fallback signal (serving/router.py): ledger
+        queue-wait p95 over the current window, 0.0 with no samples.
+        One percentile over one list — cheap enough for a per-request
+        probe, and read-only (scrape-safe from the probe endpoint)."""
+        return (percentile(self.queue_wait_ms, 95)
+                if self.queue_wait_ms else 0.0)
+
     def stats(self) -> dict[str, Any]:
         """The serving SLA summary; every field always present (0.0 when
         no sample exists) so downstream JSON consumers need no key

@@ -149,7 +149,8 @@ class RequestQueue:
 
     def submit(self, prompt, max_new_tokens: int | None = None,
                arrival_t: float | None = None, priority: int = 0,
-               tenant: str = "default") -> Request:
+               tenant: str = "default",
+               deadline_ms: float | None = None) -> Request:
         """Enqueue one request; returns its admission record.
 
         Raises :class:`CacheBudgetError` when the request can never fit a
@@ -159,6 +160,9 @@ class RequestQueue:
         defaults to now (perf_counter) — the bench passes its scheduled
         arrival so queueing delay is measured from the intended arrival,
         not from when the host thread got around to the submit call.
+        ``deadline_ms`` overrides the configured total deadline for this
+        one request (the network front door's per-request deadline
+        field); None keeps the engine-wide default.
         """
         tokens = np.ascontiguousarray(np.asarray(prompt).reshape(-1),
                                       dtype=np.int32)
@@ -234,7 +238,9 @@ class RequestQueue:
                 arrival_t=arrival,
                 ttft_deadline_t=(arrival + self.ttft_deadline_ms / 1e3
                                  if self.ttft_deadline_ms else None),
-                deadline_t=(arrival + self.deadline_ms / 1e3
+                deadline_t=(arrival + float(deadline_ms) / 1e3
+                            if deadline_ms else
+                            arrival + self.deadline_ms / 1e3
                             if self.deadline_ms else None),
                 priority=prio, tenant=str(tenant))
             self._next_uid += 1
@@ -275,7 +281,8 @@ class RequestQueue:
         return float(self.tenant_weights.get(tenant, 1.0))
 
     # -- scheduler interface -------------------------------------------------
-    def next_candidate(self, tenant_active: dict[str, int] | None = None):
+    def next_candidate(self, tenant_active: dict[str, int] | None = None,
+                       prefix_probe=None):
         """The entry the scheduler should try to seat next, or None.
 
         Tier order is strict: the highest-priority nonempty tier whose
@@ -286,6 +293,17 @@ class RequestQueue:
         tier form). Within the tier: the eligible tenant with the least
         accumulated weighted service, then that tenant's oldest entry.
         Single tenant, single tier = the old strict FIFO.
+
+        ``prefix_probe`` (cache-aware seat ordering): an optional
+        ``entry -> resident-prefix tokens`` callable (the engine wraps
+        a read-only trie probe). Among tenant heads of EQUAL weighted-
+        service rank, the head with the larger resident prefix seats
+        first — it admits with fewer committed pages and prefills only
+        its tail, so under pressure it is the cheapest seat. The probe
+        never reorders across fairness ranks or within a tenant's FIFO
+        lane, and with no probe (prefix cache off) the key degenerates
+        to the old ``(service, tenant, uid)`` ordering bitwise — pinned
+        by tests/test_frontend.py.
         """
         active = tenant_active or {}
         with self._lock:
@@ -305,8 +323,10 @@ class RequestQueue:
                 best = min(
                     heads.items(),
                     key=lambda te: (self._tenant_service.get(te[0], 0.0)
-                                    / self._weight(te[0]), te[0],
-                                    _request_of(te[1]).uid))
+                                    / self._weight(te[0]),
+                                    -prefix_probe(te[1])
+                                    if prefix_probe is not None else 0,
+                                    te[0], _request_of(te[1]).uid))
                 return best[1]
         return None
 
@@ -421,6 +441,16 @@ class RequestQueue:
     def closed(self) -> bool:
         with self._lock:
             return self._closed
+
+    def reopen(self) -> None:
+        """Reopen admission after a completed drain (idempotent) — the
+        rolling-deploy path (serving/router.py): a replica drains,
+        applies its staged weight swap at the empty-engine boundary,
+        and reopens for traffic with the new epoch. Counters, the uid
+        sequence, and tenant fairness state all carry across — the
+        reopened queue is the same queue, not a restart."""
+        with self._lock:
+            self._closed = False
 
     def reset_counters(self) -> None:
         """Zero the telemetry counters (depth high-water, submitted,

@@ -1,0 +1,532 @@
+"""Network front door, router half: cache-aware multi-replica routing.
+
+Puts N engine replicas (separate processes on one host, each one a
+:class:`~distributed_training_tpu.serving.frontend.ServingFrontend` —
+the engines themselves are unchanged) behind a single HTTP front door.
+Each ``POST /generate`` is routed to the replica whose radix prefix
+trie holds the request's longest resident prefix (SGLang-style
+cache-aware routing: the replica answers a cheap read-only
+``POST /probe``), falling back to the least ledger ``queue_wait`` p95
+when no replica holds any of the prompt. The policy is deterministic:
+ties break to the lowest replica index, so the same probe answers
+always produce the same route.
+
+Counters (``router_snapshot``, scraped at ``GET /metrics`` and
+``/router/stats`` and merged into the serve_net SLA row):
+``router_requests_routed`` / ``router_prefix_routed`` /
+``router_fallback_routed`` plus per-replica routed/error counts — the
+bench_compare zero-drift gate holds them at 0 on single-engine rows.
+
+**Zero-downtime rolling deploys** ride the existing drain + hot-swap
+machinery, one replica at a time: take it out of rotation → ``POST
+/admin/drain`` (admission closes; accepted work finishes) → wait for
+phase ``drained`` → ``POST /admin/deploy`` (the replica's serve loop
+arms + applies the swap at the empty-engine boundary) → ``POST
+/admin/reopen`` → back into rotation. Requests never see the draining
+replica (it leaves rotation first), so a mid-load deploy completes
+with zero failed and zero duplicated requests — the CI chaos drill.
+
+Scrape-safety: the front door's handler threads route, proxy bytes,
+and read counters — they never touch an engine, a device, or a trie
+(the graftlint scrape-safety rule covers these handlers and the
+``router_snapshot`` provider).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+# Phases a request must never be routed to: admission is closed (or
+# not open yet). "overloaded" stays routable — shedding is the
+# replica's own tier-aware decision.
+UNROUTABLE_PHASES = {"draining", "drained", "recovering"}
+
+
+class HttpReplica:
+    """One replica endpoint (a ServingFrontend, usually in another
+    process). Thin stdlib-urllib client: probe, generate (streaming
+    passthrough), admin, healthz."""
+
+    def __init__(self, url: str, *, name: str | None = None,
+                 timeout_s: float = 30.0):
+        self.url = url.rstrip("/")
+        self.name = name or self.url
+        self.timeout_s = float(timeout_s)
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            self.url + path,
+            data=json.dumps(payload, allow_nan=False).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def probe(self, prompt: list[int] | None) -> dict:
+        """The routing probe: resident-prefix tokens + queue-wait
+        fallback signal + phase (Engine.probe_snapshot over HTTP)."""
+        return self._post("/probe", {"prompt": prompt})
+
+    def generate_raw(self, body: bytes):
+        """Open a streaming /generate against this replica; returns the
+        live HTTPResponse (SSE bytes relay through unparsed)."""
+        req = urllib.request.Request(
+            self.url + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=self.timeout_s)
+
+    def admin(self, cmd: str) -> dict:
+        return self._post(f"/admin/{cmd}", {})
+
+    def healthz(self) -> dict:
+        with urllib.request.urlopen(self.url + "/healthz",
+                                    timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+
+class Router:
+    """Deterministic cache-aware routing policy over N replicas.
+
+    ``policy``: ``"prefix"`` (the default — longest resident prefix,
+    least-queue-wait fallback) or ``"round_robin"`` (the CI drill's
+    baseline: prefix-blind rotation over in-rotation replicas).
+    """
+
+    def __init__(self, replicas: list, *, policy: str = "prefix"):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        if policy not in ("prefix", "round_robin"):
+            raise ValueError(f"unknown routing policy {policy!r} "
+                             f"(have: prefix, round_robin)")
+        self.replicas = list(replicas)
+        self.policy = policy
+        self._lock = threading.Lock()
+        self._in_rotation = [True] * len(self.replicas)
+        self._rr_next = 0
+        self.requests_routed = 0
+        self.prefix_routed = 0
+        self.fallback_routed = 0
+        self.routed_by_replica = [0] * len(self.replicas)
+        self.errors_by_replica = [0] * len(self.replicas)
+        self.retries = 0
+        self.deploys_completed = 0
+        self.deploy_errors = 0
+
+    # -- rotation ------------------------------------------------------------
+    def set_rotation(self, index: int, in_rotation: bool) -> None:
+        with self._lock:
+            self._in_rotation[index] = bool(in_rotation)
+
+    def in_rotation(self) -> list[int]:
+        with self._lock:
+            return [i for i, ok in enumerate(self._in_rotation) if ok]
+
+    # -- policy --------------------------------------------------------------
+    def route(self, prompt: list[int] | None) -> list[tuple[int, bool]]:
+        """``(replica_index, by_prefix)`` pairs to try, best first —
+        ``by_prefix`` marks candidates whose trie holds part of the
+        prompt (so the winner's counter attribution is decided here,
+        not by a second probe). Probes every in-rotation replica;
+        unreachable or unroutable (draining/recovering) ones are
+        skipped. Deterministic: ties break to the lowest index."""
+        candidates = self.in_rotation()
+        if self.policy == "round_robin":
+            if not candidates:
+                return []
+            with self._lock:
+                self._rr_next += 1
+                k = self._rr_next % len(candidates)
+            return [(i, False) for i in candidates[k:] + candidates[:k]]
+        probes: list[tuple[int, dict]] = []
+        for i in candidates:
+            try:
+                snap = self.replicas[i].probe(prompt)
+            except (urllib.error.URLError, OSError, ValueError):
+                with self._lock:
+                    self.errors_by_replica[i] += 1
+                continue
+            if snap.get("phase") in UNROUTABLE_PHASES \
+                    or snap.get("draining"):
+                continue
+            probes.append((i, snap))
+        # Longest resident prefix wins outright; with no residency
+        # anywhere, least queue-wait (then least occupancy, then lowest
+        # index — all deterministic).
+        probes.sort(key=lambda p: (
+            -int(p[1].get("hit_tokens", 0)),
+            float(p[1].get("queue_wait_p95_ms", 0.0)),
+            int(p[1].get("queue_depth", 0))
+            + int(p[1].get("active_slots", 0)),
+            p[0]))
+        return [(i, int(s.get("hit_tokens", 0)) > 0) for i, s in probes]
+
+    def note_routed(self, index: int, *, by_prefix: bool,
+                    retried: bool = False) -> None:
+        with self._lock:
+            self.requests_routed += 1
+            self.routed_by_replica[index] += 1
+            if self.policy == "prefix":
+                if by_prefix:
+                    self.prefix_routed += 1
+                else:
+                    self.fallback_routed += 1
+            if retried:
+                self.retries += 1
+
+    # -- observability -------------------------------------------------------
+    def router_snapshot(self) -> dict[str, Any]:
+        """Read-only counter view (scrape-safe: host ints under one
+        lock) — the /router/stats payload, the front door's /metrics
+        families, and the serve_net SLA-row merge all read this."""
+        with self._lock:
+            return {
+                "policy": self.policy,
+                "router_requests_routed": self.requests_routed,
+                "router_prefix_routed": self.prefix_routed,
+                "router_fallback_routed": self.fallback_routed,
+                "router_retries": self.retries,
+                "router_deploys_completed": self.deploys_completed,
+                "router_deploy_errors": self.deploy_errors,
+                "replicas": [
+                    {"name": self.replicas[i].name,
+                     "in_rotation": self._in_rotation[i],
+                     "requests_routed": self.routed_by_replica[i],
+                     "probe_errors": self.errors_by_replica[i]}
+                    for i in range(len(self.replicas))],
+            }
+
+    # -- rolling deploy ------------------------------------------------------
+    def rolling_deploy(self, *, poll_s: float = 0.05,
+                       timeout_s: float = 120.0) -> dict[str, Any]:
+        """Drain → deploy → reopen each replica in turn (zero-downtime:
+        the replica leaves rotation before its admission closes, so no
+        request is ever routed into a drain). Returns a per-replica
+        report; raises TimeoutError when a replica wedges mid-phase."""
+        report = []
+        for i, rep in enumerate(self.replicas):
+            self.set_rotation(i, False)
+            try:
+                epoch0 = int(rep.healthz().get("weights_epoch", -1))
+                rep.admin("drain")
+                self._wait(rep, lambda h: h.get("phase") == "drained",
+                           poll_s, timeout_s,
+                           what=f"{rep.name}: drain")
+                rep.admin("deploy")
+                self._wait(rep,
+                           lambda h: int(h.get("weights_epoch", -1))
+                           > epoch0,
+                           poll_s, timeout_s,
+                           what=f"{rep.name}: deploy")
+                rep.admin("reopen")
+                self._wait(rep,
+                           lambda h: h.get("phase") not in
+                           UNROUTABLE_PHASES,
+                           poll_s, timeout_s,
+                           what=f"{rep.name}: reopen")
+            except Exception:
+                with self._lock:
+                    self.deploy_errors += 1
+                raise
+            finally:
+                # Back into rotation even on failure: a wedged deploy
+                # must not silently halve capacity forever.
+                self.set_rotation(i, True)
+            with self._lock:
+                self.deploys_completed += 1
+            report.append({"replica": rep.name, "from_epoch": epoch0,
+                           "to_epoch": int(
+                               rep.healthz().get("weights_epoch", -1))})
+        return {"deployed": report}
+
+    @staticmethod
+    def _wait(rep, pred, poll_s: float, timeout_s: float,
+              what: str) -> None:
+        t0 = time.monotonic()
+        while True:
+            try:
+                if pred(rep.healthz()):
+                    return
+            except (urllib.error.URLError, OSError, ValueError):
+                pass  # replica mid-restart: keep polling to timeout
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"rolling deploy wedged waiting for {what} "
+                    f"(> {timeout_s:.0f}s)")
+            time.sleep(poll_s)
+
+
+class RouterFrontDoor:
+    """The router's own HTTP server: routes + proxies ``POST
+    /generate`` byte-for-byte (SSE streams relay through live), serves
+    the router counters, and exposes the rolling-deploy trigger.
+
+    - ``POST /generate`` — route (probe fan-out) then proxy to the
+      chosen replica; a replica that refuses (503 / connection error)
+      falls through to the next candidate, so a drain race never fails
+      a request. 502 only when every replica refused.
+    - ``GET /router/stats`` — :meth:`Router.router_snapshot` JSON.
+    - ``GET /metrics`` — the router counters in Prometheus text.
+    - ``GET /healthz`` — aggregate: front-door status + each replica's
+      /healthz under its name.
+    - ``POST /admin/rolling_deploy`` — start a background rolling
+      deploy; poll ``/router/stats`` (``router_deploys_completed``)
+      for completion.
+    """
+
+    def __init__(self, router: Router, *, port: int = 0,
+                 host: str = "127.0.0.1",
+                 route_wait_s: float = 10.0):
+        self.router = router
+        self._route_wait_s = float(route_wait_s)
+        self._deploy_thread: threading.Thread | None = None
+        self.proxy_errors = 0
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args: Any) -> None:
+                pass
+
+            def do_GET(self) -> None:
+                front._handle_get(self)
+
+            def do_POST(self) -> None:
+                front._handle_post(self)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host = self._server.server_address[0]
+        self.port = int(self._server.server_address[1])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="router-front-door", daemon=True)
+        self._started = False
+        self._closed = False
+
+    def start(self) -> "RouterFrontDoor":
+        if not self._started:
+            self._started = True
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Shut the front door down (idempotent). Named ``stop`` for
+        the same lint-call-graph reason as ``ServingFrontend.stop``."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._started:
+            self._server.shutdown()
+            self._thread.join(timeout=5.0)
+        self._server.server_close()
+
+    def url(self, path: str = "/generate") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    # -- handlers ------------------------------------------------------------
+    def _handle_get(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0]
+        snap = self.router.router_snapshot()
+        if path == "/router/stats":
+            self._send(req, 200, "application/json",
+                       json.dumps(snap, allow_nan=False) + "\n")
+        elif path == "/metrics":
+            lines = []
+            for k, v in snap.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    lines.append(f"# TYPE {k} counter")
+                    lines.append(f"{k} {v}")
+            for r in snap["replicas"]:
+                tag = f'{{replica="{r["name"]}"}}'
+                lines.append(
+                    f"router_replica_requests_routed{tag} "
+                    f"{r['requests_routed']}")
+                lines.append(f"router_replica_probe_errors{tag} "
+                             f"{r['probe_errors']}")
+            self._send(req, 200, "text/plain; version=0.0.4; "
+                       "charset=utf-8", "\n".join(lines) + "\n")
+        elif path == "/healthz":
+            payload = {"status": "ok", "policy": self.router.policy,
+                       "replicas": {}}
+            for rep in self.router.replicas:
+                try:
+                    payload["replicas"][rep.name] = rep.healthz()
+                except (urllib.error.URLError, OSError, ValueError) as e:
+                    payload["replicas"][rep.name] = {
+                        "status": "unreachable",
+                        "error": str(e)}
+            self._send(req, 200, "application/json",
+                       json.dumps(payload, allow_nan=False) + "\n")
+        else:
+            self._send(req, 404, "application/json", json.dumps(
+                {"error": "not found",
+                 "endpoints": ["/generate", "/router/stats", "/metrics",
+                               "/healthz",
+                               "/admin/rolling_deploy"]}) + "\n")
+
+    def _handle_post(self, req: BaseHTTPRequestHandler) -> None:
+        path = req.path.split("?", 1)[0]
+        if path == "/admin/rolling_deploy":
+            if self._deploy_thread is not None \
+                    and self._deploy_thread.is_alive():
+                self._send(req, 409, "application/json",
+                           json.dumps({"error": "rolling deploy already "
+                                       "in progress"}) + "\n")
+                return
+            self._deploy_thread = threading.Thread(
+                target=self._run_deploy, name="rolling-deploy",
+                daemon=True)
+            self._deploy_thread.start()
+            self._send(req, 202, "application/json",
+                       json.dumps({"started": True}) + "\n")
+            return
+        if path != "/generate":
+            self._send(req, 404, "application/json",
+                       json.dumps({"error": "not found"}) + "\n")
+            return
+        try:
+            length = int(req.headers.get("Content-Length") or 0)
+            raw = req.rfile.read(length)
+            body = json.loads(raw or b"{}")
+            prompt = body.get("prompt")
+            if prompt is None and body.get("text") is not None:
+                prompt = [b for b in str(body["text"]).encode("utf-8")]
+        except (ValueError, OSError) as e:
+            self._send(req, 400, "application/json",
+                       json.dumps({"error": f"bad body: {e}"}) + "\n")
+            return
+        self._proxy_generate(req, raw, prompt)
+
+    def _proxy_generate(self, req: BaseHTTPRequestHandler, raw: bytes,
+                        prompt) -> None:
+        """Route then relay. Candidate replicas are tried best-first; a
+        refusal (503/conn error — e.g. a drain racing the probe) falls
+        through to the next. The rotation can be momentarily empty
+        mid-deploy, so an empty route re-polls briefly before giving
+        up."""
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            order = self.router.route(prompt)
+            for idx, by_prefix in order:
+                rep = self.router.replicas[idx]
+                try:
+                    resp = rep.generate_raw(raw)
+                except urllib.error.HTTPError as e:
+                    if e.code in (503, 429):
+                        attempt += 1
+                        continue  # draining/shedding: try the next
+                    self.proxy_errors += 1
+                    self._send(req, e.code, "application/json",
+                               e.read().decode("utf-8", "replace")
+                               or json.dumps({"error": str(e)}) + "\n")
+                    return
+                except (urllib.error.URLError, OSError):
+                    attempt += 1
+                    continue
+                self.router.note_routed(idx, by_prefix=by_prefix,
+                                        retried=attempt > 0)
+                self._relay(req, resp)
+                return
+            if time.monotonic() - t0 > self._route_wait_s:
+                self.proxy_errors += 1
+                self._send(req, 502, "application/json", json.dumps(
+                    {"error": "no replica accepted the request"}) + "\n")
+                return
+            time.sleep(0.02)
+
+    @staticmethod
+    def _relay(req: BaseHTTPRequestHandler, resp) -> None:
+        """Stream the replica's response through byte-for-byte (SSE
+        events relay as they arrive — read1 never waits for a full
+        buffer). ``contextlib.closing`` releases the upstream socket
+        on every exit path."""
+        with contextlib.closing(resp):
+            try:
+                req.send_response(resp.status)
+                ctype = resp.headers.get("Content-Type",
+                                         "application/json")
+                req.send_header("Content-Type", ctype)
+                clen = resp.headers.get("Content-Length")
+                if clen is not None:
+                    req.send_header("Content-Length", clen)
+                else:
+                    req.send_header("Connection", "close")
+                req.end_headers()
+                while True:
+                    chunk = resp.read1(65536)
+                    if not chunk:
+                        break
+                    req.wfile.write(chunk)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client hung up; the replica's ack gate handles it
+
+    def _run_deploy(self) -> None:
+        try:
+            self.router.rolling_deploy()
+        except Exception:
+            pass  # counted in router.deploy_errors; surfaced on /stats
+
+    @staticmethod
+    def _send(req: BaseHTTPRequestHandler, code: int, ctype: str,
+              body: str) -> None:
+        data = body.encode("utf-8")
+        try:
+            req.send_response(code)
+            req.send_header("Content-Type", ctype)
+            req.send_header("Content-Length", str(len(data)))
+            req.end_headers()
+            req.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+# -- SSE client helpers (traffic.py client mode + tests) ---------------------
+def sse_events(resp):
+    """Parse a live SSE byte stream into ``(event, payload)`` pairs —
+    the client half of the frontend's framing (event: NAME / data: one
+    JSON object / blank line)."""
+    event, data = None, []
+    for raw in resp:
+        line = raw.decode("utf-8").rstrip("\n")
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+        elif line.startswith("data: "):
+            data.append(line[len("data: "):])
+        elif not line and (event is not None or data):
+            yield event, json.loads("\n".join(data))
+            event, data = None, []
+
+
+def generate_over_http(url: str, payload: dict, *,
+                       timeout_s: float = 60.0) -> dict:
+    """One streamed /generate round-trip: POST, consume the SSE stream,
+    return the terminal ``done`` payload with the streamed-token
+    concatenation under ``streamed_tokens`` (the bitwise pin compares
+    both against the batch engine's output)."""
+    req = urllib.request.Request(
+        url, data=json.dumps(payload, allow_nan=False).encode(),
+        headers={"Content-Type": "application/json"})
+    streamed: list[int] = []
+    done: dict | None = None
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        ctype = resp.headers.get("Content-Type", "")
+        if not ctype.startswith("text/event-stream"):
+            done = json.loads(resp.read())
+        else:
+            for event, data in sse_events(resp):
+                if event == "tokens":
+                    streamed.extend(data["tokens"])
+                elif event == "done":
+                    done = data
+    if done is None:
+        raise RuntimeError(f"stream from {url} ended without a "
+                           f"'done' event")
+    done["streamed_tokens"] = streamed
+    return done

@@ -124,7 +124,7 @@ class SlotScheduler:
         return best
 
     def admit(self, queue, can_seat=None, *, on_seat=None,
-              on_preempt=None, preempt_helps=None
+              on_preempt=None, preempt_helps=None, prefix_probe=None
               ) -> list[ActiveSequence]:
         """One admission pass; returns the newly seated sequences (the
         engine prefills each — resumptions re-prefill their carried
@@ -153,10 +153,15 @@ class SlotScheduler:
         rank-ordered. (A candidate vanishing between the queue's
         ``next_candidate`` and ``take`` — a producer-side tier-aware
         shed racing this pass — just re-polls.)
+
+        ``prefix_probe`` threads through to ``queue.next_candidate``
+        (cache-aware seat ordering): among equal-fairness tenant heads,
+        the one with the larger resident prefix seats first.
         """
         seated: list[ActiveSequence] = []
         while True:
-            cand = queue.next_candidate(self.tenant_active())
+            cand = queue.next_candidate(self.tenant_active(),
+                                        prefix_probe=prefix_probe)
             if cand is None:
                 break
             req: Request = (cand.request

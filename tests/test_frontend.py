@@ -1,0 +1,323 @@
+"""Network front door, frontend half (serving/frontend.py).
+
+The headline pin: SSE-streamed completions over HTTP are **bitwise
+identical** to the batch engine's output for the same seeded workload —
+greedy AND sampled — because tokens are a pure function of
+``(seed, uid, position)`` and the sequential client preserves uid
+order. Plus: per-token streaming framing, journal-backed exactly-once
+delivery via the ack cursor, the read-only routing probe, drain/reopen
+admin flow over HTTP, and the cache-aware seat-ordering satellite
+(bitwise-neutral when the prefix cache is off).
+
+Everything here runs one tiny CPU model in-process; the multi-replica
+subprocess drills live in tests/test_router.py.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_training_tpu.config import ServeConfig
+from distributed_training_tpu.models import get_model
+from distributed_training_tpu.serving import Engine, RequestQueue
+from distributed_training_tpu.serving.frontend import ServingFrontend
+from distributed_training_tpu.serving.router import (
+    generate_over_http,
+    sse_events,
+)
+
+VOCAB = 31
+MAX_LEN = 64
+PS = 4
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = get_model(
+        "transformer_lm", num_classes=VOCAB, num_layers=1, num_heads=2,
+        hidden_dim=16, max_len=MAX_LEN)
+    params = model.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8), np.int32))["params"]
+    return model, params
+
+
+def make_engine(lm, **kw):
+    model, params = lm
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("kv_page_size", PS)
+    kw.setdefault("prefill_chunk", 4)
+    return Engine(model, params, ServeConfig(**kw))
+
+
+PROMPTS = [((np.arange(1, 9 + i, dtype=np.int32) * (2 + i)) % VOCAB)
+           for i in range(5)]
+
+
+def _serve_batch(eng, prompts):
+    """The batch CLI path: submit in order, run each to completion —
+    the reference stream the HTTP pin compares against."""
+    out = {}
+    for p in prompts:
+        r = eng.submit(p)
+        for f in eng.run():
+            out[f.uid] = f
+    return [out[u] for u in sorted(out)]
+
+
+def _serve_http(frontend, prompts, *, stream=True):
+    """The network path: same prompts, same order, one at a time."""
+    results = []
+    for p in prompts:
+        results.append(generate_over_http(
+            frontend.url("/generate"),
+            {"prompt": [int(t) for t in p], "stream": stream},
+            timeout_s=60.0))
+    return results
+
+
+def _post(url, payload, timeout=10.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+class TestStreamEqualsBatch:
+    def test_sse_bitwise_equals_batch_greedy(self, lm):
+        batch = _serve_batch(make_engine(lm, prefix_cache=True), PROMPTS)
+        fe = ServingFrontend(make_engine(lm, prefix_cache=True)).start()
+        try:
+            net = _serve_http(fe, PROMPTS)
+        finally:
+            fe.stop()
+        assert [r["tokens"] for r in net] == \
+            [[int(t) for t in f.tokens] for f in batch]
+        # The stream IS the completion: per-token events concatenate to
+        # exactly the done payload (no token lost, none duplicated).
+        for r in net:
+            assert r["streamed_tokens"] == r["tokens"]
+
+    def test_sse_bitwise_equals_batch_sampled(self, lm):
+        kw = dict(temperature=0.7, seed=11)
+        batch = _serve_batch(make_engine(lm, **kw), PROMPTS)
+        fe = ServingFrontend(make_engine(lm, **kw)).start()
+        try:
+            net = _serve_http(fe, PROMPTS)
+        finally:
+            fe.stop()
+        assert [r["tokens"] for r in net] == \
+            [[int(t) for t in f.tokens] for f in batch]
+        for r in net:
+            assert r["streamed_tokens"] == r["tokens"]
+
+    def test_unary_mode_matches_streamed(self, lm):
+        fe = ServingFrontend(make_engine(lm)).start()
+        try:
+            streamed = _serve_http(fe, PROMPTS[:2], stream=True)
+            unary = _serve_http(fe, PROMPTS[:2], stream=False)
+        finally:
+            fe.stop()
+        assert [r["tokens"] for r in unary] == \
+            [r["tokens"] for r in streamed]
+
+    def test_sse_framing_is_event_per_iteration(self, lm):
+        """Raw SSE check: tokens arrive as typed events ending in one
+        'done' carrying the full completion."""
+        fe = ServingFrontend(make_engine(lm)).start()
+        try:
+            req = urllib.request.Request(
+                fe.url("/generate"),
+                data=json.dumps({"prompt": [3, 5, 7],
+                                 "stream": True}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60.0) as resp:
+                assert resp.headers["Content-Type"].startswith(
+                    "text/event-stream")
+                events = list(sse_events(resp))
+        finally:
+            fe.stop()
+        names = [e for e, _ in events]
+        assert names[-1] == "done"
+        assert set(names[:-1]) == {"tokens"}
+        streamed = [t for e, d in events if e == "tokens"
+                    for t in d["tokens"]]
+        assert streamed == events[-1][1]["tokens"]
+
+
+class TestExactlyOnce:
+    def test_delivered_stream_acks_the_journal(self, lm, tmp_path):
+        jdir = str(tmp_path / "j1")
+        eng = make_engine(lm, journal_dir=jdir)
+        eng.recover()
+        fe = ServingFrontend(eng).start()
+        try:
+            _serve_http(fe, PROMPTS[:2])
+        finally:
+            fe.stop()
+            eng.journal.shutdown()
+        # Delivery acked the cursor: a recovery replays NOTHING.
+        eng2 = make_engine(lm, journal_dir=jdir)
+        report = eng2.recover()
+        assert report["redelivered"] == []
+        eng2.journal.shutdown()
+
+    def test_unacked_completion_redelivers(self, lm, tmp_path):
+        """The contrast pin: same workload WITHOUT the frontend's ack
+        (a client that never got its stream) must redeliver."""
+        jdir = str(tmp_path / "j2")
+        eng = make_engine(lm, journal_dir=jdir)
+        eng.recover()
+        eng.submit(PROMPTS[0])
+        list(eng.run())
+        eng.journal.shutdown()
+        eng2 = make_engine(lm, journal_dir=jdir)
+        report = eng2.recover()
+        assert len(report["redelivered"]) == 1
+        eng2.journal.shutdown()
+
+
+class TestProbeAndAdmin:
+    def test_probe_reports_residency_read_only(self, lm):
+        eng = make_engine(lm, prefix_cache=True)
+        fe = ServingFrontend(eng).start()
+        try:
+            prompt = [int(t) for t in PROMPTS[0]]
+            _serve_http(fe, [PROMPTS[0]])
+            st, cold = _post(fe.url("/probe"), {"prompt": [9, 9, 9, 9]})
+            assert st == 200 and cold["hit_tokens"] == 0
+            st, warm = _post(fe.url("/probe"), {"prompt": prompt})
+            assert st == 200 and warm["hit_tokens"] > 0
+            # Read-only: probing twice is idempotent (no recency or
+            # refcount movement observable through the probe itself).
+            st, warm2 = _post(fe.url("/probe"), {"prompt": prompt})
+            assert warm2["hit_tokens"] == warm["hit_tokens"]
+            assert warm["phase"] in ("idle", "serving")
+            assert "queue_wait_p95_ms" in warm
+        finally:
+            fe.stop()
+
+    def test_drain_deploy_reopen_over_http(self, lm):
+        eng = make_engine(lm)
+        fe = ServingFrontend(eng).start()
+        try:
+            _serve_http(fe, [PROMPTS[0]])
+            st, _ = _post(fe.url("/admin/drain"), {})
+            assert st == 200
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                hz = json.loads(_get(fe.url("/healthz")))
+                if hz["phase"] == "drained":
+                    break
+                time.sleep(0.02)
+            assert hz["phase"] == "drained"
+            # Admission is closed: a submit is refused, not queued.
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(fe.url("/generate"),
+                      {"prompt": [1, 2, 3], "stream": False},
+                      timeout=30.0)
+            assert ei.value.code == 503
+            # No-op redeploy at the drained boundary bumps the epoch.
+            epoch0 = int(hz["weights_epoch"])
+            st, _ = _post(fe.url("/admin/deploy"), {})
+            assert st == 202
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                hz = json.loads(_get(fe.url("/healthz")))
+                if int(hz["weights_epoch"]) > epoch0:
+                    break
+                time.sleep(0.02)
+            assert int(hz["weights_epoch"]) == epoch0 + 1
+            st, _ = _post(fe.url("/admin/reopen"), {})
+            assert st == 200
+            out = _serve_http(fe, [PROMPTS[1]])
+            assert out[0]["tokens"]
+        finally:
+            fe.stop()
+
+    def test_healthz_and_metrics_delegate_to_exporter(self, lm):
+        fe = ServingFrontend(make_engine(lm)).start()
+        try:
+            hz = json.loads(_get(fe.url("/healthz")))
+            assert hz["status"] == "ok" and "weights_epoch" in hz
+            text = _get(fe.url("/metrics")).decode()
+            assert "# TYPE" in text
+        finally:
+            fe.stop()
+
+    def test_bad_requests_are_4xx_not_500(self, lm):
+        fe = ServingFrontend(make_engine(lm)).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(fe.url("/generate"), {"stream": False})
+            assert ei.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(fe.url("/nope"), {})
+            assert ei.value.code == 404
+        finally:
+            fe.stop()
+
+
+class TestSeatOrdering:
+    """Satellite: cache-aware seat ordering inside the queue."""
+
+    def test_probe_breaks_equal_service_ties(self):
+        q = RequestQueue(budget=32)
+        ra = q.submit([1, 2, 3], 4, tenant="a", arrival_t=0.0)
+        rb = q.submit([4, 5, 6], 4, tenant="b", arrival_t=0.0)
+        # No probe: equal service → alphabetical tenant tie-break.
+        assert q.next_candidate() is ra
+        # Probe says tenant b's head is resident → b seats first.
+        probe = (lambda e: 8 if e.tenant == "b" else 0)
+        assert q.next_candidate(prefix_probe=probe) is rb
+        # Equal residency degenerates to the no-probe order.
+        flat = (lambda e: 8)
+        assert q.next_candidate(prefix_probe=flat) is ra
+
+    def test_probe_never_reorders_within_a_tenant(self):
+        q = RequestQueue(budget=32)
+        first = q.submit([1, 2, 3], 4, tenant="a", arrival_t=0.0)
+        q.submit([7, 8, 9], 4, tenant="a", arrival_t=0.0)
+        # Even when the probe would prefer the SECOND entry, only the
+        # tenant's FIFO head is a candidate.
+        probe = (lambda e: 16 if e.uid != first.uid else 0)
+        assert q.next_candidate(prefix_probe=probe) is first
+
+    def test_probe_never_crosses_fairness_ranks(self):
+        q = RequestQueue(budget=32)
+        a1 = q.submit([1, 2, 3], 4, tenant="a", arrival_t=0.0)
+        b1 = q.submit([4, 5, 6], 4, tenant="b", arrival_t=0.0)
+        # Seat a's head: tenant a accrues weighted service.
+        assert q.take(a1)
+        q.submit([1, 2, 3], 4, tenant="a", arrival_t=0.0)
+        # A huge resident prefix on a's next entry must NOT outrank
+        # b's lower accumulated service.
+        probe = (lambda e: 999 if e.tenant == "a" else 0)
+        assert q.next_candidate(prefix_probe=probe) is b1
+
+    def test_cache_off_is_bitwise_neutral(self, lm):
+        """With the prefix cache off the engine never passes a probe,
+        so the admission schedule — and therefore every token — is
+        bitwise the pre-round-22 ordering (two fresh engines agree,
+        and the multi-tenant interleave matches the no-probe key)."""
+        runs = []
+        for _ in range(2):
+            eng = make_engine(lm, prefix_cache=False, max_batch=2)
+            uids = []
+            for i, p in enumerate(PROMPTS):
+                eng.submit(p, tenant="ab"[i % 2])
+            for f in eng.run():
+                uids.append((f.uid, [int(t) for t in f.tokens]))
+            runs.append(uids)
+        assert runs[0] == runs[1]

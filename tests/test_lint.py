@@ -170,6 +170,58 @@ class TestScrapeSafety:
                     return self.alerts.to_dict()
         """, "scrape-safety")
 
+    def test_positive_post_handler_driving_engine_exits_1(
+            self, tmp_path, capsys):
+        # The network-front-door bug class (round 22): a /generate
+        # handler that steps the engine itself — instead of submitting
+        # and letting the frontend's single serve-loop thread step —
+        # races the scheduler and double-dispatches compiled programs.
+        assert _exit_code(tmp_path, """
+            class Handler:
+                def do_POST(self):
+                    self.engine.submit(self._parse())
+                    self.engine.step()
+        """, "scrape-safety") == 1
+        assert "engine-driving" in capsys.readouterr().out
+
+    def test_positive_probe_endpoint_mutating_trie_exits_1(
+            self, tmp_path, capsys):
+        # A routing probe must read residency, never claim pages — a
+        # claim from the router's probe thread leaks refcounts against
+        # requests that may never arrive.
+        assert _exit_code(tmp_path, """
+            class Engine:
+                def probe_snapshot(self, tokens):
+                    pages = self.prefix_cache.claim(tokens)
+                    return {"hit_tokens": len(pages) * 8}
+        """, "scrape-safety") == 1
+        assert "prefix-trie mutation" in capsys.readouterr().out
+
+    def test_negative_front_door_admission_surface_is_clean(
+            self, tmp_path):
+        # The shipped round-22 design: the handler submits (lock-
+        # guarded queue work), acks the journal delivery cursor, and
+        # reads the probe via the read-only PrefixCache.probe; the
+        # serve loop owns step/drain/arm_swap. router_snapshot is a
+        # counter view.
+        assert not _lint(tmp_path, """
+            class Engine:
+                def probe_snapshot(self, tokens):
+                    hit = self.prefix_cache.probe(tokens, max_tokens=4)
+                    return {"hit_tokens": len(hit) * 8}
+
+            class Handler:
+                def do_POST(self):
+                    req = self.engine.submit(self._parse())
+                    self._stream(req)
+                    self.engine.journal.ack([req.uid])
+
+            class Router:
+                def router_snapshot(self):
+                    with self._lock:
+                        return {"routed": self.requests_routed}
+        """, "scrape-safety")
+
 
 class TestLockSignalSafety:
     # The pre-fix round-13 hot-swap pattern, minimized: serve()'s

@@ -170,6 +170,17 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     "alerts_fired": ("both", 0.0),
     "alerts_cleared": ("both", 0.0),
     "incidents_captured": ("both", 0.0),
+    # network front door (serving/router.py; docs/SERVING.md "Network
+    # front door & routing"): the network smoke's sequential seeded
+    # client makes routing deterministic — each decision is a pure
+    # function of the replicas' trie state, which is itself a pure
+    # function of the request order — so all three counters are
+    # zero-drift. On single-replica (non-network) rows every one is
+    # exactly zero and the zero-baseline zero-tolerance semantics keep
+    # stray routing from hiding there.
+    "router_requests_routed": ("both", 0.0),
+    "router_prefix_routed": ("both", 0.0),
+    "router_fallback_routed": ("both", 0.0),
 }
 
 

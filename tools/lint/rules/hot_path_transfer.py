@@ -14,8 +14,10 @@ flags host-materialization calls inside the codebase's hot scopes:
 - nested step bodies defined inside ``make_*``/``build_*`` builders
   (``train/step.py``, ``train/lm_step.py``) and their callees;
 - ``Engine.step`` and everything it reaches inside ``serving/``;
-- HTTP handler methods (``do_GET``/``do_POST``) and their callees —
-  the exporter's handler thread must never touch a device.
+- HTTP ``do_GET`` handler methods and their callees — the exporter's
+  scrape thread must never touch a device. (``do_POST`` is the
+  admission plane and is covered by the scrape-safety rule instead:
+  see ``HANDLER_NAMES`` below.)
 
 The same scopes must never BLOCK ON THE FILESYSTEM either (the
 crash-durability round): the request journal's contract is that
@@ -45,7 +47,15 @@ NAME = "hot-path-transfer"
 
 # Methods that ARE the hot loop, by (class, method) shape.
 HOT_ROOT_METHODS = {("Engine", "step")}
-HANDLER_NAMES = {"do_GET", "do_POST"}
+# Scrape handlers only: GET is the read-only telemetry plane and must
+# never materialize device state. POST handlers are the ADMISSION plane
+# (serving/frontend.py, round 22) — durable-before-return journal
+# writes and host-side numpy staging of the submitted prompt are their
+# job, on their own handler thread, never inside the compiled-dispatch
+# window. The scrape-safety rule still covers do_POST for the things a
+# request handler genuinely must not do (device reads, collectives,
+# engine driving, trie mutation).
+HANDLER_NAMES = {"do_GET"}
 # Step builders specifically (make_train_step, make_lm_eval_fn, ...):
 # data-loader builders (build_dataloaders) are HOST pipelines by design
 # — numpy materialization there is the job, not a leak.

@@ -20,11 +20,27 @@ snapshot provider may
 - **dispatch a compiled program** (any ``jax.jit``-marked callee, or a
   flax ``.apply``).
 
+The network front door (round 22: ``serving/frontend.py`` +
+``serving/router.py``) extends the same contract to request handling:
+a ``POST /generate`` handler thread may *submit* (lock-guarded queue
+work) and *wait* (condition variables) but must never
+
+- **drive the engine** (``step``/``drain``/``arm_swap``) — only the
+  frontend's single serve-loop thread steps; a handler that steps
+  races the scheduler and double-dispatches compiled programs;
+- **mutate the prefix trie** (``claim``/``insert_chain``/
+  ``evict_until``) — the routing probe (``probe_snapshot``) and the
+  router's fingerprint endpoints are read-only by contract
+  (``PrefixCache.probe`` touches no refcount and no recency state).
+
 Roots: HTTP ``do_GET``/``do_POST`` methods (and everything they reach,
-including ``MetricsExporter._handle``), plus the known snapshot-provider
+including ``MetricsExporter._handle``, the frontend's request handlers
+and the router's probe/proxy endpoints — their nested ``Handler``
+classes are indexed like any other), plus the known snapshot-provider
 surface — functions named ``flight_snapshot``/``scrape_snapshot``/
-``health``, and the ``phase`` property of classes that expose a
-``flight_snapshot`` (the exporter's ``phase_provider`` wiring).
+``health``/``probe_snapshot``/``router_snapshot``, and the ``phase``
+property of classes that expose a ``flight_snapshot`` (the exporter's
+``phase_provider`` wiring).
 """
 
 from __future__ import annotations
@@ -38,7 +54,11 @@ NAME = "scrape-safety"
 
 HANDLER_NAMES = {"do_GET", "do_POST"}
 PROVIDER_NAMES = {"flight_snapshot", "scrape_snapshot", "health",
-                  "timeseries_snapshot", "alerts_snapshot"}
+                  "timeseries_snapshot", "alerts_snapshot",
+                  # Network front door (serving/frontend.py + router.py):
+                  # the routing probe and the router's counter view run
+                  # on handler threads too.
+                  "probe_snapshot", "router_snapshot"}
 
 DEVICE_READS = {"device_get", "block_until_ready", "item", "tolist",
                 "memory_stats", "device_memory_metrics"}
@@ -59,6 +79,15 @@ TELEMETRY_MUTATION = {"flush", "record_flush", "record_step", "mark_gap",
                       # scrapes only read to_dict() views.
                       "record_sample", "evaluate", "capture"}
 COMPILED_DISPATCH = {"apply"}
+# Engine-driving calls: the frontend's serve loop owns these; a request
+# handler that reaches one races the single-stepper. (``submit``/
+# ``close_admission``/``reopen``/``ack`` are deliberately NOT here —
+# admission, drain latching and delivery cursors are lock-guarded
+# host-side state, the exact work a front-door handler exists to do.)
+ENGINE_DRIVE = {"step", "drain", "arm_swap"}
+# Prefix-trie mutation: a probe endpoint reads residency, it must never
+# claim pages, insert chains, or trigger eviction from a handler thread.
+CACHE_MUTATION = {"claim", "insert_chain", "evict_until"}
 
 
 def _roots(index: ProjectIndex) -> list[FunctionInfo]:
@@ -84,6 +113,10 @@ def check(index: ProjectIndex) -> Iterator[Finding]:
                 kind = "a collective"
             elif cs.name in TELEMETRY_MUTATION:
                 kind = "telemetry mutation"
+            elif cs.name in ENGINE_DRIVE:
+                kind = "an engine-driving call"
+            elif cs.name in CACHE_MUTATION:
+                kind = "a prefix-trie mutation"
             elif cs.name in COMPILED_DISPATCH or any(
                     callee.jitted for callee in index.resolve(fn, cs)):
                 kind = "a compiled-program dispatch"

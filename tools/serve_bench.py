@@ -609,6 +609,13 @@ def main() -> int:
     stats["max_batch"] = args.max_batch
     stats["scenario"] = args.scenario
     stats["shed_at_submit"] = shed_at_submit
+    # Network front door (serving/router.py): this bench drives ONE
+    # engine in-process, so the router counters are definitionally zero
+    # — emitted anyway so bench_compare's zero-drift gate pins them on
+    # every non-network row (serve_net.py fills them in for real).
+    stats["router_requests_routed"] = 0
+    stats["router_prefix_routed"] = 0
+    stats["router_fallback_routed"] = 0
     if args.completions_out:
         with open(args.completions_out, "w") as fh:
             json.dump([{"uid": int(f.uid), "reason": f.finish_reason,

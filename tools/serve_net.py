@@ -1,0 +1,317 @@
+"""Network serving launcher: N engine replicas behind the front door.
+
+Two modes, one file:
+
+- ``--replica``: run ONE engine + SSE frontend (serving/frontend.py)
+  in THIS process on an ephemeral port, print ``{"port": N}`` as the
+  first stdout line, and serve until stdin closes (the parent's exit
+  hangs up the pipe — no orphan pollers). This is the unit the front
+  door spawns, and the unit a real deployment would run per host.
+
+- front-door mode (default): spawn ``--replicas N`` replica
+  subprocesses (same model seed → identical weights, so completions
+  are bitwise-independent of routing), put them behind the cache-aware
+  router (serving/router.py), and either serve (``--serve``) or run
+  the seeded network smoke (``--smoke``): replay a tools/traffic.py
+  scenario through the door and print a serve_bench-compatible SLA row
+  as the LAST stdout line — requests/token counters from the client's
+  own ledger, router counters from the router, global prefix-hit
+  tokens summed over the replicas' ``/vars`` scrapes. The smoke's
+  sequential replay makes every one of those numbers a pure function
+  of the seed (the bench_compare zero-drift contract; wall-clock
+  throughput is deliberately NOT emitted on network rows).
+
+The CI "Network serving drill" runs ``--smoke`` twice on
+``shared_prefix`` (``--policy prefix`` vs ``--policy round_robin``) to
+pin cache-aware routing's global prefix-hit win, and once more with
+``--rolling-deploy-at K --concurrency 4`` to prove a mid-load rolling
+deploy completes with zero failed and zero duplicated requests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def add_engine_args(p: argparse.ArgumentParser) -> None:
+    """The serve_bench-compatible subset of engine knobs a replica
+    needs (tiny random-weight model: this drills the NETWORK plane —
+    routing, streaming, deploys — not model quality)."""
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--num-layers", type=int, default=2)
+    p.add_argument("--num-heads", type=int, default=2)
+    p.add_argument("--hidden-dim", type=int, default=64)
+    p.add_argument("--model-max-len", type=int, default=256)
+    p.add_argument("--max-batch", type=int, default=4)
+    p.add_argument("--max-len", type=int, default=192)
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--kv-page-size", type=int, default=8)
+    p.add_argument("--kv-pages", type=int, default=256)
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   default=False)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--journal-dir", type=str, default=None)
+
+
+def build_engine(args: argparse.Namespace):
+    import jax
+    import numpy as np
+
+    from distributed_training_tpu.config import ServeConfig
+    from distributed_training_tpu.models import get_model
+    from distributed_training_tpu.serving import Engine
+
+    model = get_model("transformer_lm", num_classes=args.vocab_size,
+                      num_layers=args.num_layers,
+                      num_heads=args.num_heads,
+                      hidden_dim=args.hidden_dim,
+                      max_len=args.model_max_len)
+    params = model.init(jax.random.PRNGKey(args.seed),
+                        np.zeros((1, 8), np.int32))["params"]
+    cfg = ServeConfig(
+        max_batch=args.max_batch, max_len=args.max_len,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        kv_page_size=args.kv_page_size or None, kv_pages=args.kv_pages,
+        prefix_cache=not args.no_prefix_cache,
+        journal_dir=args.journal_dir, seed=args.seed)
+    return Engine(model, params, cfg)
+
+
+def run_replica(args: argparse.Namespace) -> int:
+    from distributed_training_tpu.serving.frontend import ServingFrontend
+
+    engine = build_engine(args)
+    engine.recover()
+    frontend = ServingFrontend(engine, port=args.port).start()
+    print(json.dumps({"replica": args.name, "port": frontend.port}),
+          flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        # Park until the parent hangs up the pipe or SIGTERMs us.
+        while not stop.is_set():
+            if not sys.stdin.read(1):
+                break
+    except (KeyboardInterrupt, OSError):
+        pass
+    frontend.stop()
+    if engine.journal is not None:
+        engine.journal.shutdown()
+    return 0
+
+
+class ReplicaProc:
+    """One spawned replica subprocess + its discovered port."""
+
+    def __init__(self, index: int, args: argparse.Namespace):
+        cmd = [sys.executable, "-m", "tools.serve_net", "--replica",
+               "--name", f"r{index}", "--port", "0",
+               "--vocab-size", str(args.vocab_size),
+               "--num-layers", str(args.num_layers),
+               "--num-heads", str(args.num_heads),
+               "--hidden-dim", str(args.hidden_dim),
+               "--model-max-len", str(args.model_max_len),
+               "--max-batch", str(args.max_batch),
+               "--max-len", str(args.max_len),
+               "--max-new-tokens", str(args.max_new_tokens),
+               "--temperature", str(args.temperature),
+               "--kv-page-size", str(args.kv_page_size),
+               "--kv-pages", str(args.kv_pages),
+               "--seed", str(args.seed)]
+        if args.no_prefix_cache:
+            cmd.append("--no-prefix-cache")
+        if args.journal_dir:
+            cmd += ["--journal-dir",
+                    os.path.join(args.journal_dir, f"r{index}")]
+        self.name = f"r{index}"
+        self.proc = subprocess.Popen(
+            cmd, cwd=REPO_ROOT, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, text=True)
+        line = self.proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"replica {self.name} died before reporting its port "
+                f"(exit {self.proc.poll()})")
+        self.port = int(json.loads(line)["port"])
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                self.proc.stdin.close()  # replica parks on stdin EOF
+                self.proc.wait(timeout=10.0)
+            except (OSError, subprocess.TimeoutExpired):
+                self.proc.terminate()
+                try:
+                    self.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    self.proc.kill()
+
+
+def _replica_stats(url: str) -> dict:
+    """One replica's serving stats via its /vars scrape."""
+    import urllib.request
+
+    with urllib.request.urlopen(url + "/vars", timeout=10.0) as resp:
+        return json.loads(resp.read())["serving"]
+
+
+def run_front_door(args: argparse.Namespace) -> int:
+    from distributed_training_tpu.serving.router import (
+        HttpReplica, Router, RouterFrontDoor)
+    from tools.traffic import make_scenario, replay_over_http
+
+    replicas = [ReplicaProc(i, args) for i in range(args.replicas)]
+    router = Router([HttpReplica(r.url, name=r.name) for r in replicas],
+                    policy=args.policy)
+    door = RouterFrontDoor(router, port=args.port).start()
+    print(json.dumps({"port": door.port, "policy": args.policy,
+                      "replicas": [{"name": r.name, "port": r.port}
+                                   for r in replicas]}), flush=True)
+    try:
+        if not args.smoke:
+            print(f"[serve_net] front door on {door.url('')} "
+                  f"({args.replicas} replica(s), policy={args.policy}); "
+                  f"Ctrl-C to stop", file=sys.stderr)
+            try:
+                while True:
+                    time.sleep(1.0)
+            except KeyboardInterrupt:
+                return 0
+            finally:
+                pass
+
+        reqs = make_scenario(
+            args.scenario, seed=args.seed, requests=args.requests,
+            rate=args.rate, mean_prompt_len=args.mean_prompt_len,
+            max_prompt_len=args.max_prompt_len,
+            max_new_tokens=args.max_new_tokens,
+            vocab_size=args.vocab_size,
+            budget=args.max_len)
+        deploy_thread = None
+        if args.rolling_deploy_at > 0:
+            # Chaos drill: fire the rolling deploy while the replay is
+            # mid-load (after a short head-start so every replica has
+            # accepted work), from a side thread — requests keep
+            # flowing through the rotation the whole time.
+            def _deploy() -> None:
+                time.sleep(args.rolling_deploy_delay_s)
+                router.rolling_deploy()
+
+            deploy_thread = threading.Thread(
+                target=_deploy, name="chaos-deploy", daemon=True)
+            deploy_thread.start()
+        t0 = time.monotonic()
+        results = replay_over_http(
+            door.url("/generate"), reqs, stream=not args.unary,
+            concurrency=args.concurrency, timeout_s=args.timeout_s)
+        wall_s = time.monotonic() - t0
+        if deploy_thread is not None:
+            deploy_thread.join(timeout=120.0)
+
+        done = [r for r in results if r is not None]
+        mismatched = sum(1 for r in done
+                         if r.get("streamed_tokens") is not None
+                         and r["streamed_tokens"] != r["tokens"])
+        if args.completions_out:
+            with open(args.completions_out, "w") as fh:
+                json.dump([{"index": i, "uid": int(r["uid"]),
+                            "reason": r["finish_reason"],
+                            "tokens": [int(t) for t in r["tokens"]]}
+                           for i, r in enumerate(results)
+                           if r is not None], fh)
+            print(f"[serve_net] completions: {args.completions_out} "
+                  f"({len(done)} requests)", file=sys.stderr)
+
+        snap = router.router_snapshot()
+        per_replica = [_replica_stats(r.url) for r in replicas]
+        row = {
+            "scenario": args.scenario,
+            "requests": len(reqs),
+            "requests_finished": len(done),
+            "requests_failed": len(reqs) - len(done),
+            "tokens_emitted": sum(len(r["tokens"]) for r in done),
+            "stream_vs_done_mismatches": mismatched,
+            "replicas": args.replicas,
+            "concurrency": args.concurrency,
+            "router_requests_routed": snap["router_requests_routed"],
+            "router_prefix_routed": snap["router_prefix_routed"],
+            "router_fallback_routed": snap["router_fallback_routed"],
+            "router_retries": snap["router_retries"],
+            "router_deploys_completed": snap["router_deploys_completed"],
+            "router_deploy_errors": snap["router_deploy_errors"],
+            # Global cache economics: prefill compute saved ACROSS the
+            # fleet — the number cache-aware routing exists to raise.
+            "prefix_cache_hit_tokens": sum(
+                int(s.get("prefix_cache_hit_tokens", 0))
+                for s in per_replica),
+            "prefix_cache_hit_requests": sum(
+                int(s.get("prefix_cache_hit_requests", 0))
+                for s in per_replica),
+            # Wall time rides as context only (never gated: network
+            # smoke wall-clock on shared CI is not a metric).
+            "wall_s": round(wall_s, 3),
+        }
+        print(json.dumps(row, allow_nan=False))
+        return 0 if (not row["requests_failed"] and not mismatched
+                     and not row["router_deploy_errors"]) else 1
+    finally:
+        door.stop()
+        for r in replicas:
+            r.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.serve_net",
+        description="network serving: replicas + cache-aware front door")
+    p.add_argument("--replica", action="store_true", default=False,
+                   help="internal: run ONE replica (engine + frontend) "
+                        "in this process")
+    p.add_argument("--name", type=str, default="r0")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--policy", type=str, default="prefix",
+                   choices=["prefix", "round_robin"])
+    p.add_argument("--serve", action="store_true", default=False,
+                   help="front-door mode: serve until interrupted "
+                        "(default when --smoke is not given)")
+    p.add_argument("--smoke", action="store_true", default=False,
+                   help="replay a seeded scenario through the door and "
+                        "print a serve_bench-compatible SLA row")
+    # Smoke / client knobs (mirror tools/traffic.py client mode).
+    p.add_argument("--scenario", type=str, default="shared_prefix")
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--rate", type=float, default=16.0)
+    p.add_argument("--mean-prompt-len", type=int, default=32)
+    p.add_argument("--max-prompt-len", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=1)
+    p.add_argument("--unary", action="store_true", default=False)
+    p.add_argument("--timeout-s", type=float, default=180.0)
+    p.add_argument("--completions-out", type=str, default=None)
+    p.add_argument("--rolling-deploy-at", type=int, default=0,
+                   help="chaos drill: >0 starts a rolling deploy from a "
+                        "side thread while the replay is in flight")
+    p.add_argument("--rolling-deploy-delay-s", type=float, default=0.5)
+    add_engine_args(p)
+    args = p.parse_args(argv)
+    if args.replica:
+        return run_replica(args)
+    return run_front_door(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

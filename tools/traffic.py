@@ -20,6 +20,16 @@ shape here and add ``--swap-at-request`` / ``--spec-k`` on the bench.
 Every prompt/completion pair is clamped to the engine budget the caller
 passes (``prompt + max_new <= budget``), so a generated request can
 never die with a CacheBudgetError mid-measurement.
+
+**Client mode** (``python -m tools.traffic --url ...``): replay any of
+these seeded scenarios over HTTP against the network front door
+(serving/frontend.py, serving/router.py) instead of an in-process
+engine — the same pure-function-of-seed contract, so the workload a
+networked drill submits is byte-identical to what ``serve_bench
+--scenario NAME`` submits locally. Sequential replay (the default)
+preserves submission order end-to-end, which is what makes the
+SSE-vs-batch bitwise pin possible; ``--concurrency N`` trades that for
+in-flight parallelism in the routing drills.
 """
 
 from __future__ import annotations
@@ -383,3 +393,156 @@ def make_scenario(name: str, *, seed: int, requests: int, rate: float,
     out = SCENARIOS[name].build(rng, params)
     out.sort(key=lambda r: (r.arrival_s, r.tenant, r.priority))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Client mode: replay a seeded scenario over HTTP (network front door).
+# ---------------------------------------------------------------------------
+def request_payload(req: TrafficRequest, *, stream: bool = True) -> dict:
+    """The ``POST /generate`` body for one scheduled arrival — the
+    HTTP twin of ``engine.submit(prompt, ...)`` in serve_bench."""
+    return {"prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "priority": int(req.priority),
+            "tenant": req.tenant,
+            "stream": bool(stream)}
+
+
+def replay_over_http(url: str, reqs: list[TrafficRequest], *,
+                     stream: bool = True, concurrency: int = 1,
+                     timeout_s: float = 120.0) -> list[dict | None]:
+    """Replay ``reqs`` against a front door's ``/generate``; returns
+    one ``done`` payload (with ``streamed_tokens``) per request, in
+    submission order — ``None`` where the request failed.
+
+    ``concurrency=1`` submits strictly sequentially: each request's
+    stream is fully consumed before the next is sent, so the server
+    sees the exact submission order ``serve_bench`` would produce
+    (the bitwise-pin mode). ``concurrency>1`` keeps that many requests
+    in flight via worker threads (arrival ORDER is still the seeded
+    order; completion interleaving is not) — the routing-drill mode.
+    """
+    from distributed_training_tpu.serving.router import generate_over_http
+
+    results: list[dict | None] = [None] * len(reqs)
+    if concurrency <= 1:
+        for i, r in enumerate(reqs):
+            results[i] = generate_over_http(
+                url, request_payload(r, stream=stream),
+                timeout_s=timeout_s)
+        return results
+
+    import queue as _queue
+    import threading
+
+    work: _queue.Queue = _queue.Queue()
+    for item in enumerate(reqs):
+        work.put(item)
+    errors: list[tuple[int, Exception]] = []
+    err_lock = threading.Lock()
+
+    def worker() -> None:
+        while True:
+            try:
+                i, r = work.get_nowait()
+            except _queue.Empty:
+                return
+            try:
+                results[i] = generate_over_http(
+                    url, request_payload(r, stream=stream),
+                    timeout_s=timeout_s)
+            except Exception as e:  # collected, not raised: the drill
+                with err_lock:      # counts failures itself
+                    errors.append((i, e))
+
+    threads = [threading.Thread(target=worker, name=f"traffic-{k}",
+                                daemon=True)
+               for k in range(int(concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        i, e = errors[0]
+        raise RuntimeError(
+            f"{len(errors)}/{len(reqs)} requests failed; first: "
+            f"request {i}: {e}") from e
+    return results
+
+
+def _client_main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m tools.traffic",
+        description="replay a seeded traffic scenario over HTTP "
+                    "against a serving front door")
+    p.add_argument("--url", type=str, required=True,
+                   help="front door base URL, e.g. http://127.0.0.1:8080")
+    p.add_argument("--scenario", type=str, default="poisson",
+                   choices=sorted(SCENARIOS))
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--rate", type=float, default=8.0)
+    p.add_argument("--mean-prompt-len", type=int, default=32)
+    p.add_argument("--max-prompt-len", type=int, default=64)
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--budget", type=int, default=96)
+    p.add_argument("--unary", action="store_true", default=False,
+                   help="plain JSON responses instead of SSE streams")
+    p.add_argument("--concurrency", type=int, default=1,
+                   help="requests kept in flight (1 = strictly "
+                        "sequential, the bitwise-pin mode)")
+    p.add_argument("--timeout-s", type=float, default=120.0)
+    p.add_argument("--completions-out", type=str, default=None,
+                   help="write delivered completions as one JSON list "
+                        "(submission order) — the artifact the bitwise "
+                        "pin diffs against the batch CLI's")
+    args = p.parse_args(argv)
+
+    reqs = make_scenario(
+        args.scenario, seed=args.seed, requests=args.requests,
+        rate=args.rate, mean_prompt_len=args.mean_prompt_len,
+        max_prompt_len=args.max_prompt_len,
+        max_new_tokens=args.max_new_tokens,
+        vocab_size=args.vocab_size, budget=args.budget)
+    base = args.url.rstrip("/")
+    try:
+        results = replay_over_http(
+            base + "/generate", reqs, stream=not args.unary,
+            concurrency=args.concurrency, timeout_s=args.timeout_s)
+    except RuntimeError as e:
+        print(f"traffic: error: {e}", file=sys.stderr)
+        return 1
+
+    done = [r for r in results if r is not None]
+    tokens = sum(len(r["tokens"]) for r in done)
+    mismatched = sum(1 for r in done
+                     if r.get("streamed_tokens") is not None
+                     and r["streamed_tokens"] != r["tokens"])
+    if args.completions_out:
+        with open(args.completions_out, "w") as fh:
+            json.dump([{"uid": int(r["uid"]),
+                        "reason": r["finish_reason"],
+                        "tokens": [int(t) for t in r["tokens"]]}
+                       for r in done], fh)
+        print(f"[traffic] completions: {args.completions_out} "
+              f"({len(done)} requests)", file=sys.stderr)
+    print(json.dumps({
+        "scenario": args.scenario, "seed": args.seed,
+        "requests": len(reqs), "completed": len(done),
+        "failed": len(reqs) - len(done),
+        "tokens_received": tokens,
+        "stream_vs_done_mismatches": mismatched,
+        "concurrency": args.concurrency,
+    }, allow_nan=False))
+    return 0 if len(done) == len(reqs) and mismatched == 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(_client_main())
