@@ -67,6 +67,13 @@ restated for XLA's static-shape world:
   requests keep their KV pages); torn/corrupt candidates are
   quarantined and never touch the engine, and ``Engine.rollback()``
   re-arms the previous weights.
+- :mod:`supervisor` — fleet fault tolerance over the :mod:`frontend` /
+  :mod:`router` network plane: a ReplicaSupervisor that owns replica
+  processes, detects death (waitpid + failed health probes) and
+  wedged serve loops (frozen ``/healthz`` heartbeat), and restarts
+  each with its journal dir so recovery replays before the port
+  reopens; the router adds per-replica circuit breakers and
+  mid-stream SSE failover with a resume cursor.
 
 Surfaces: ``gpt/jax_tpu/serve.py`` (interactive/file serving CLI) and
 ``tools/serve_bench.py`` driving the seeded traffic-scenario library
@@ -117,6 +124,7 @@ from distributed_training_tpu.serving.prefix_cache import (  # noqa: F401
 )
 from distributed_training_tpu.serving.queue import RequestQueue  # noqa: F401
 from distributed_training_tpu.serving.request import (  # noqa: F401
+    FINISH_CANCELLED,
     FINISH_EOS,
     FINISH_LENGTH,
     FINISH_PREEMPT_TIMEOUT,
@@ -130,6 +138,9 @@ from distributed_training_tpu.serving.router import (  # noqa: F401
     HttpReplica,
     Router,
     RouterFrontDoor,
+)
+from distributed_training_tpu.serving.supervisor import (  # noqa: F401
+    ReplicaSupervisor,
 )
 from distributed_training_tpu.serving.scheduler import SlotScheduler  # noqa: F401
 from distributed_training_tpu.serving.speculative import (  # noqa: F401

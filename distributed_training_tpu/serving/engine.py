@@ -116,6 +116,7 @@ from distributed_training_tpu.serving.alerts import (
 )
 from distributed_training_tpu.serving.journal import RequestJournal, perf_of
 from distributed_training_tpu.serving.ledger import (
+    CAUSE_CANCELLED,
     CAUSE_DECODE,
     CAUSE_JOURNAL_ADMIT,
     CAUSE_PRE_CRASH,
@@ -136,6 +137,7 @@ from distributed_training_tpu.serving.pages import PagePool, pages_for
 from distributed_training_tpu.serving.prefix_cache import PrefixCache
 from distributed_training_tpu.serving.queue import RequestQueue
 from distributed_training_tpu.serving.request import (
+    FINISH_CANCELLED,
     FINISH_PREEMPT_TIMEOUT,
     FINISH_SHED,
     FINISH_TIMEOUT,
@@ -401,6 +403,13 @@ class Engine:
         # before serving; None costs nothing.
         self._token_listener = None
         self._stream_cursor: dict[int, int] = {}
+        # Client-disconnect cancellation: handler threads MARK a uid
+        # here (under the lock — that is their whole write); the engine
+        # loop consumes the set at its next step boundary and performs
+        # the actual eviction, so slot/page/queue state keeps its
+        # single-mutator discipline.
+        self._cancel_lock = threading.Lock()
+        self._cancel_uids: set[int] = set()
 
         # Donation keeps one cache resident instead of two per decode
         # step; the CPU backend can't donate (it would only warn noisily).
@@ -885,6 +894,48 @@ class Engine:
             finished.append(self._queue_evict_finish(
                 entry, FINISH_PREEMPT_TIMEOUT
                 if isinstance(entry, ActiveSequence) else FINISH_TIMEOUT))
+
+    def cancel(self, uid: int) -> None:
+        """Mark ``uid`` for cancellation (thread-safe, non-blocking).
+
+        The client-disconnect path: a handler thread that catches a
+        broken pipe mid-SSE calls this instead of letting the engine
+        decode to completion for a dead socket. The mark is the only
+        cross-thread write; the engine loop consumes it at its next
+        step boundary (:meth:`_cancel_pass`), evicts the entry wherever
+        it lives (queue or slot), frees its pages through the ordinary
+        finish sweep, and completes it with reason ``cancelled``.
+        Unknown / already-finished uids are dropped silently — the
+        race with a natural completion is benign."""
+        with self._cancel_lock:
+            self._cancel_uids.add(int(uid))
+
+    def _cancel_pass(self, finished: list) -> None:
+        """Consume pending cancellation marks (engine thread only,
+        start-of-step). Sorted drain → deterministic completion order
+        when several sockets die between two steps."""
+        with self._cancel_lock:
+            if not self._cancel_uids:
+                return
+            uids, self._cancel_uids = sorted(self._cancel_uids), set()
+        for uid in uids:
+            entry = self.queue.remove_uid(uid)
+            if entry is not None:
+                finished.append(
+                    self._queue_evict_finish(entry, FINISH_CANCELLED))
+                continue
+            seq = self.scheduler.evict_uid(uid)
+            if seq is not None:
+                # Free the pages NOW (the preemption idiom, engine.py
+                # on_preempt): this runs before admission, so the slot
+                # may be re-seated this very step — deferring the free
+                # to _finish_iteration would reclaim the new tenant's
+                # pages. slot=None keeps the finish sweep from freeing
+                # twice.
+                if self.paged:
+                    self._free_slot_pages(seq.slot)
+                finished.append(FinishedRequest.from_active(
+                    seq, FINISH_CANCELLED, slot=None))
 
     def _admit_pass(self, finished: list) -> list[ActiveSequence]:
         """One tier-aware admission pass: complete pending tier-aware
@@ -1519,6 +1570,7 @@ class Engine:
         finished: list[FinishedRequest] = []
         if deadlines:
             self._expire_queue(finished, time.perf_counter())
+        self._cancel_pass(finished)
 
         had_work = not self.idle
         if had_work:
@@ -1725,6 +1777,7 @@ class Engine:
         # completes with finish reason 'timeout' and zero tokens.
         if deadlines:
             self._expire_queue(finished, time.perf_counter())
+        self._cancel_pass(finished)
 
         had_work = not self.idle
         if had_work:
@@ -1880,7 +1933,13 @@ class Engine:
             t_fin = time.perf_counter()
             for fin in finished:
                 if fin.ledger is not None and not fin.ledger.closed:
-                    fin.ledger.close(self._finish_cause(fin), t_fin)
+                    # A cancelled request's tail bills to ``cancelled``
+                    # regardless of phase: the time was spent serving a
+                    # socket that was already gone.
+                    cause = (CAUSE_CANCELLED
+                             if fin.finish_reason == FINISH_CANCELLED
+                             else self._finish_cause(fin))
+                    fin.ledger.close(cause, t_fin)
         for fin in finished:
             self.telemetry.on_finished(fin)
             if self.trace is not None:
@@ -2254,6 +2313,26 @@ class Engine:
         never blocks on a consumer)."""
         self._token_listener = listener
         self._stream_cursor.clear()
+
+    def stream_attach(self, uid: int):
+        """Re-attach a stream to a LIVE uid (ENGINE thread only — the
+        front door's serve loop calls this for a mid-stream failover
+        resume). Returns the tokens already landed for ``uid`` (host
+        ints; ``[]`` for a still-queued fresh request) and aligns the
+        listener cursor so the next iteration tail publishes only what
+        follows — or None when the uid is neither seated nor queued
+        (finished, acked, or never seen here)."""
+        for seq in self.scheduler.active():
+            if seq.request.uid == uid:
+                self._stream_cursor[uid] = len(seq.tokens)
+                return [int(t) for t in seq.tokens]
+        entry = self.queue.find_uid(uid)
+        if entry is None:
+            return None
+        toks = (list(entry.tokens)
+                if isinstance(entry, ActiveSequence) else [])
+        self._stream_cursor[uid] = len(toks)
+        return [int(t) for t in toks]
 
     def probe_snapshot(self, tokens=None) -> dict[str, Any]:
         """Read-only routing probe for the front door (serving/

@@ -59,6 +59,10 @@ from distributed_training_tpu.resilience.errors import (
     DrainingError,
     QueueFullError,
 )
+from distributed_training_tpu.serving.httpbody import (
+    NoBodyLength,
+    read_body,
+)
 
 # One SSE frame: "event: <name>\ndata: <one JSON object>\n\n".
 SSE_CONTENT_TYPE = "text/event-stream; charset=utf-8"
@@ -108,6 +112,13 @@ class ServingFrontend:
         self._closed = False
         self.requests_served = 0    # completions fully delivered
         self.requests_failed = 0    # submit rejections + client hangups
+        self.requests_resumed = 0   # mid-stream failover re-attaches
+        # Serve-loop liveness epoch: bumped once per loop pass and
+        # exported on /healthz. A replica whose process answers HTTP
+        # but whose engine thread is stuck (deadlock, hung dispatch)
+        # keeps a FROZEN heartbeat — the supervisor's wedged-replica
+        # detector watches exactly this.
+        self._heartbeat = 0
         if exporter is None:
             # Delegation-only exporter: bound to an ephemeral port but
             # never started — only its _handle logic runs, on THIS
@@ -116,7 +127,7 @@ class ServingFrontend:
             exporter = MetricsExporter(
                 engine.flight_snapshot, port=0, host=host,
                 phase_provider=lambda: engine.phase,
-                health_provider=engine.health,
+                health_provider=self._health,
                 timeseries_provider=engine.timeseries_snapshot,
                 alerts_provider=engine.alerts_snapshot)
             self._owns_exporter = True
@@ -180,6 +191,15 @@ class ServingFrontend:
     def url(self, path: str = "/generate") -> str:
         return f"http://{self.host}:{self.port}{path}"
 
+    def _health(self) -> dict:
+        """/healthz payload: the engine's health extras plus this
+        frontend's serve-loop liveness epoch (the supervisor's
+        wedged-replica signal) and delivery counters. Read-only."""
+        h = self._engine.health()
+        h["serve_loop_heartbeat"] = int(self._heartbeat)
+        h["requests_resumed"] = int(self.requests_resumed)
+        return h
+
     # -- engine thread -------------------------------------------------------
     def _serve_loop(self) -> None:
         """The single engine-driving thread: drain admin commands, step
@@ -187,6 +207,7 @@ class ServingFrontend:
         idle (a submit wakes it)."""
         engine = self._engine
         while True:
+            self._heartbeat += 1
             with self._cond:
                 if self._closed:
                     return
@@ -201,6 +222,22 @@ class ServingFrontend:
                     # Apply at this (possibly empty) boundary: step()
                     # runs the swap barrier even with nothing seated.
                     engine.step()
+                elif isinstance(cmd, tuple) and cmd[0] == "attach":
+                    # Mid-stream failover re-attach: stream_attach is
+                    # engine-thread-only (it aligns the listener
+                    # cursor), so the handler parks a box here and the
+                    # loop answers it. Registering the stream and
+                    # seeding it with the already-landed tokens happens
+                    # under the SAME lock the listener publishes under,
+                    # so no token can fall between seed and listener.
+                    _, uid, st, box = cmd
+                    landed = engine.stream_attach(uid)
+                    with self._cond:
+                        if landed is not None:
+                            st.tokens.extend(landed)
+                            self._streams[uid] = st
+                        box["attached"] = landed is not None
+                        self._cond.notify_all()
             if not engine.idle:
                 engine.step()
                 continue
@@ -229,8 +266,15 @@ class ServingFrontend:
     def _handle_post(self, req: BaseHTTPRequestHandler) -> None:
         path = req.path.split("?", 1)[0]
         try:
-            length = int(req.headers.get("Content-Length") or 0)
-            body = json.loads(req.rfile.read(length) or b"{}")
+            raw = read_body(req.headers, req.rfile)
+            body = json.loads(raw or b"{}")
+        except NoBodyLength:
+            # 411 ONLY here: the request declared neither
+            # Content-Length nor chunked framing (ROADMAP item 2c).
+            self._send_json(req, 411, {
+                "error": "Content-Length or Transfer-Encoding: "
+                         "chunked required"})
+            return
         except (ValueError, OSError) as e:
             self._send_json(req, 400, {"error": f"bad request body: {e}"})
             return
@@ -261,14 +305,43 @@ class ServingFrontend:
             self._engine.reopen()
             self._send_json(req, 200, {"draining": False,
                                        "phase": self._engine.phase})
+        elif path == "/admin/check_balanced":
+            # Read-only page-leak audit; meaningful at the drained
+            # steady state only (callers poll /probe for idle first —
+            # the serve_net chaos drills gate on this after a
+            # disconnect-cancel leg).
+            try:
+                self._engine.check_balanced()
+            except AssertionError as e:
+                self._send_json(req, 200, {"balanced": False,
+                                           "error": str(e)})
+                return
+            self._send_json(req, 200, {"balanced": True})
         else:
             self._send_json(req, 404, {
                 "error": "not found",
                 "endpoints": ["/generate", "/probe", "/admin/drain",
-                              "/admin/deploy", "/admin/reopen"]})
+                              "/admin/deploy", "/admin/reopen",
+                              "/admin/check_balanced"]})
 
     def _handle_generate(self, req: BaseHTTPRequestHandler,
                          body: dict) -> None:
+        resume = body.get("resume")
+        if resume is not None:
+            try:
+                uid = int(resume["uid"])
+                delivered = int(resume.get("delivered", 0))
+            except (KeyError, TypeError, ValueError) as e:
+                self._send_json(req, 400, {
+                    "error": f"bad resume cursor: {e}"})
+                return
+            if self._handle_resume(req, body, uid, delivered):
+                return
+            # Unknown uid here (another replica's stream, or journaled
+            # state already compacted): fall through to a fresh submit
+            # with the delivered head suppressed — greedy decoding makes
+            # the regenerated stream bitwise the original, so the
+            # client's concatenation is seamless.
         try:
             prompt = self._parse_prompt(body)
         except ValueError as e:
@@ -301,14 +374,23 @@ class ServingFrontend:
             self._send_json(req, 400, {"error": str(e),
                                        "kind": type(e).__name__})
             return
+        skip = (int(resume.get("delivered", 0))
+                if resume is not None else 0)
         try:
             if stream:
-                delivered = self._stream_response(req, r.uid, st)
+                delivered = self._stream_response(req, r.uid, st,
+                                                  skip=skip)
             else:
                 delivered = self._unary_response(req, r.uid, st)
         finally:
             with self._cond:
                 self._streams.pop(r.uid, None)
+        if not delivered and st.fin is None and not self._closed:
+            # The client hung up while the engine was still decoding:
+            # cancel instead of finishing tokens nobody will read. The
+            # engine evicts at its next step boundary; the serve loop
+            # is already awake (the request keeps it non-idle).
+            self._engine.cancel(r.uid)
         if delivered:
             # Exactly-once cursor: the result is durably delivered, so
             # a future recovery must not redeliver it. Ack strictly
@@ -319,6 +401,92 @@ class ServingFrontend:
             self.requests_served += 1
         else:
             self.requests_failed += 1
+
+    def _handle_resume(self, req: BaseHTTPRequestHandler, body: dict,
+                       uid: int, delivered: int) -> bool:
+        """Mid-stream failover resume for a uid THIS replica owns.
+
+        Returns True when the resume was answered here — from the
+        journal's finished-unacked record (the replica died after the
+        last token but before the client took delivery) or by
+        re-attaching to the still-running/recovered sequence. False →
+        the uid is unknown here and the caller falls back to a fresh
+        submit with the delivered head suppressed."""
+        if self._try_journal_tail(req, uid, delivered):
+            return True
+        # Re-attach to a live sequence: stream_attach must run on the
+        # serve-loop (engine) thread, so park an attach command and
+        # wait for its verdict.
+        st = _Stream()
+        box: dict = {}
+        with self._cond:
+            self._commands.append(("attach", uid, st, box))
+            self._cond.notify_all()
+            while "attached" not in box:
+                if self._closed:
+                    self._send_json(req, 503, {"error": "shutting down"})
+                    return True
+                self._cond.wait(timeout=0.1)
+        if not box["attached"]:
+            # Lost the race with the finish sweep: the sequence may
+            # have completed between the journal check and the attach.
+            return self._try_journal_tail(req, uid, delivered)
+        try:
+            ok = self._stream_response(req, uid, st, skip=delivered)
+        finally:
+            with self._cond:
+                self._streams.pop(uid, None)
+        if ok:
+            if self._engine.journal is not None:
+                self._engine.journal.ack([uid])
+            self.requests_served += 1
+            self.requests_resumed += 1
+        else:
+            if st.fin is None and not self._closed:
+                self._engine.cancel(uid)
+            self.requests_failed += 1
+        return True
+
+    def _try_journal_tail(self, req: BaseHTTPRequestHandler, uid: int,
+                          delivered: int) -> bool:
+        """Serve a finished-unacked journal record's undelivered tail
+        as a normal SSE stream; ack only after the last byte (the
+        exactly-once cursor, unchanged). False when the journal holds
+        no finished record for ``uid``."""
+        journal = self._engine.journal
+        if journal is None:
+            return False
+        snap = journal.live_snapshot(uid)
+        if snap is None or not snap.finished:
+            return False
+        tokens = (snap.finish_tokens if snap.finish_tokens is not None
+                  else snap.tokens)
+        payload = {
+            "uid": int(uid),
+            "finish_reason": str(snap.finish_reason),
+            "tokens": [int(t) for t in tokens],
+            "prompt_len": len(snap.prompt),
+            "priority": int(snap.priority),
+            "tenant": str(snap.tenant),
+        }
+        try:
+            req.send_response(200)
+            req.send_header("Content-Type", SSE_CONTENT_TYPE)
+            req.send_header("Cache-Control", "no-store")
+            req.send_header("Connection", "close")
+            req.end_headers()
+            tail = payload["tokens"][delivered:]
+            if tail:
+                req.wfile.write(_sse_event("tokens", {
+                    "uid": int(uid), "tokens": tail}))
+            req.wfile.write(_sse_event("done", payload))
+        except (BrokenPipeError, ConnectionResetError):
+            self.requests_failed += 1
+            return True  # handled: not acked, a later resume retries
+        journal.ack([uid])
+        self.requests_served += 1
+        self.requests_resumed += 1
+        return True
 
     def _await(self, st: _Stream, sent: int) -> tuple[list[int], Any]:
         """Block until ``st`` holds tokens past ``sent`` (or its finish
@@ -334,10 +502,13 @@ class ServingFrontend:
         return batch, fin
 
     def _stream_response(self, req: BaseHTTPRequestHandler, uid: int,
-                         st: _Stream) -> bool:
+                         st: _Stream, *, skip: int = 0) -> bool:
         """SSE delivery: one ``tokens`` event per landed batch, one
-        terminal ``done`` event. Returns True iff every byte reached
-        the socket (the ack gate)."""
+        terminal ``done`` event. ``skip`` suppresses the first N tokens
+        (a failover resume: the client already holds them from the dead
+        relay — the ``done`` payload still carries the FULL array, so
+        ``streamed == done`` holds for head + tail concatenation).
+        Returns True iff every byte reached the socket (the ack gate)."""
         try:
             req.send_response(200)
             req.send_header("Content-Type", SSE_CONTENT_TYPE)
@@ -351,6 +522,10 @@ class ServingFrontend:
                 if not batch and fin is None:
                     return False  # frontend closing mid-stream
                 sent += len(batch)
+                if skip:
+                    drop = min(skip, len(batch))
+                    batch = batch[drop:]
+                    skip -= drop
                 if batch:
                     req.wfile.write(_sse_event("tokens", {
                         "uid": uid, "tokens": batch}))

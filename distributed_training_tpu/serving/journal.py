@@ -583,6 +583,27 @@ class RequestJournal:
             self._pending.append(rec)
             self._apply(rec)
 
+    def live_snapshot(self, uid: int) -> JournaledRequest | None:
+        """A point-in-time COPY of one live-mirror entry (any thread).
+
+        The mid-stream failover read: a resume request asks "is this
+        uid finished-unacked (serve the tail from here) or still in
+        flight (re-attach to the engine)?" The copy detaches the
+        mutable ``tokens``/``finish_tokens`` lists so the caller can
+        stream from it while the engine keeps appending. None when the
+        uid was never admitted here or is finished AND acked (deleted
+        from the mirror at ack)."""
+        with self._lock:
+            entry = self._live.get(int(uid))
+            if entry is None:
+                return None
+            snap = dataclasses.replace(
+                entry, tokens=list(entry.tokens),
+                finish_tokens=(list(entry.finish_tokens)
+                               if entry.finish_tokens is not None
+                               else None))
+        return snap
+
     def ack(self, uids: int | Iterable[int]) -> None:
         """The client cursor: the consumer durably took these finished
         results — they stop being redelivered and compaction may drop

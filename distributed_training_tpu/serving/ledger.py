@@ -53,6 +53,7 @@ recompute        re-prefilling a carried prefix after preempt/recovery
 swap_barrier     a hot-swap barrier pausing this in-flight request
 pre_crash        arrival → last durable token of the process that died
 recovery         crash downtime + journal replay (wall-anchored)
+cancelled        last stamp → the cancel eviction (client hung up)
 ===============  =========================================================
 
 The ledger also counts **tokens per cause** (``TOKEN_CAUSES``): cache
@@ -80,13 +81,17 @@ CAUSE_RECOMPUTE = "recompute"
 CAUSE_SWAP_BARRIER = "swap_barrier"
 CAUSE_PRE_CRASH = "pre_crash"
 CAUSE_RECOVERY = "recovery"
+# Client-disconnect cancellation: the tail span between the request's
+# last ordinary stamp and the engine's cancel eviction. A terminal
+# cause like ``timeout`` — conservation still tiles the full lifetime.
+CAUSE_CANCELLED = "cancelled"
 
 # Every wall cause, in lifecycle order — the fixed key set telemetry
 # exports (``ledger_<cause>_ms_total`` always present, 0.0 when unused).
 LEDGER_CAUSES = (
     CAUSE_JOURNAL_ADMIT, CAUSE_QUEUE_WAIT, CAUSE_PREFILL, CAUSE_DECODE,
     CAUSE_SPEC_ROLLBACK, CAUSE_PREEMPT_REQUEUE, CAUSE_RECOMPUTE,
-    CAUSE_SWAP_BARRIER, CAUSE_PRE_CRASH, CAUSE_RECOVERY,
+    CAUSE_SWAP_BARRIER, CAUSE_PRE_CRASH, CAUSE_RECOVERY, CAUSE_CANCELLED,
 )
 
 CAUSE_SPEC_DRAFT = "spec_draft"

@@ -507,6 +507,7 @@ class ServeTelemetry:
             busy_s += max(self._busy_t1 - self._seg_t0, 0.0)
         tput = self.tokens_emitted / busy_s if busy_s > 0 else 0.0
         from distributed_training_tpu.serving.request import (
+            FINISH_CANCELLED,
             FINISH_PREEMPT_TIMEOUT,
             FINISH_TIMEOUT,
         )
@@ -570,6 +571,11 @@ class ServeTelemetry:
             # miss belongs to preemption pressure, not service time.
             "requests_preempt_timed_out":
                 self.finish_reasons.get(FINISH_PREEMPT_TIMEOUT, 0),
+            # Client-disconnect cancellations (broken pipe on an SSE
+            # write → engine eviction). Zero-drift on no-fault rows:
+            # bench-gated at zero tolerance.
+            "requests_cancelled":
+                self.finish_reasons.get(FINISH_CANCELLED, 0),
             # Lossless preempt-and-requeue economics (deterministic
             # under the bench's virtual-time drive; CI-gated zero-drift).
             "requests_preempted": int(self.requests_preempted),

@@ -409,6 +409,31 @@ class RequestQueue:
                     return True
         return False
 
+    def find_uid(self, uid: int):
+        """Read-only lookup of a queued entry by uid across all tiers
+        (the stream re-attach path); None when not queued."""
+        with self._lock:
+            for tier in self._tiers:
+                for entry in tier:
+                    if _request_of(entry).uid == uid:
+                        return entry
+        return None
+
+    def remove_uid(self, uid: int):
+        """Remove a queued entry by uid across ALL tiers (the
+        client-disconnect cancellation path: the frontend only knows
+        the uid, not the tier) and return it — a fresh ``Request`` or a
+        preempted ``ActiveSequence`` — or None when the uid is not
+        queued (already seated, finished, or never admitted). No
+        fairness charge: a cancelled request consumed no seat."""
+        with self._lock:
+            for tier in self._tiers:
+                for entry in tier:
+                    if _request_of(entry).uid == uid:
+                        tier.remove(entry)
+                        return entry
+        return None
+
     def reserve_uids(self, next_uid: int) -> None:
         """Advance the uid sequence past everything the journal ever
         assigned (dropped/compacted entries included): a fresh submit

@@ -37,6 +37,11 @@ FINISH_SHED = "shed"
 # ``timeout`` so telemetry attributes the miss to preemption pressure,
 # not to the request's own service time.
 FINISH_PREEMPT_TIMEOUT = "preempted_timeout"
+# The client hung up (broken pipe on an SSE write): the frontend asks
+# the engine to cancel, the engine evicts at its next step boundary and
+# frees the pages — decoding to completion for a dead socket would burn
+# slots and skew every latency percentile with tokens nobody received.
+FINISH_CANCELLED = "cancelled"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -35,6 +35,7 @@ and read counters — they never touch an engine, a device, or a trie
 from __future__ import annotations
 
 import contextlib
+import http.client
 import json
 import threading
 import time
@@ -42,6 +43,11 @@ import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
+
+from distributed_training_tpu.serving.httpbody import (
+    NoBodyLength,
+    read_body,
+)
 
 # Phases a request must never be routed to: admission is closed (or
 # not open yet). "overloaded" stays routable — shedding is the
@@ -98,12 +104,16 @@ class Router:
     baseline: prefix-blind rotation over in-rotation replicas).
     """
 
-    def __init__(self, replicas: list, *, policy: str = "prefix"):
+    def __init__(self, replicas: list, *, policy: str = "prefix",
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 5.0):
         if not replicas:
             raise ValueError("router needs at least one replica")
         if policy not in ("prefix", "round_robin"):
             raise ValueError(f"unknown routing policy {policy!r} "
                              f"(have: prefix, round_robin)")
+        if breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
         self.replicas = list(replicas)
         self.policy = policy
         self._lock = threading.Lock()
@@ -117,6 +127,22 @@ class Router:
         self.retries = 0
         self.deploys_completed = 0
         self.deploy_errors = 0
+        # Per-replica circuit breaker: closed → open after
+        # ``breaker_threshold`` CONSECUTIVE connection/5xx failures →
+        # (cooldown elapses) half_open, ONE trial → closed on success,
+        # straight back to open on failure. An open replica is skipped
+        # before its probe, so a dead process costs the route pass
+        # nothing — no probe timeout, no burned fallback slot.
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self._brk_state = ["closed"] * len(self.replicas)
+        self._brk_failures = [0] * len(self.replicas)
+        self._brk_opened_t = [0.0] * len(self.replicas)
+        self.breaker_opens = 0
+        self.breaker_closes = 0
+        self.breaker_reopens = 0   # half-open trial failed
+        self.breaker_opens_by_replica = [0] * len(self.replicas)
+        self.failover_resumes = 0  # mid-stream relays re-issued
 
     # -- rotation ------------------------------------------------------------
     def set_rotation(self, index: int, in_rotation: bool) -> None:
@@ -127,22 +153,95 @@ class Router:
         with self._lock:
             return [i for i, ok in enumerate(self._in_rotation) if ok]
 
+    # -- circuit breaker -----------------------------------------------------
+    def _brk_open_locked(self, index: int) -> None:
+        self._brk_state[index] = "open"
+        self._brk_opened_t[index] = time.monotonic()
+        self._brk_failures[index] = 0
+
+    def note_replica_failure(self, index: int) -> None:
+        """One connection/5xx failure against a replica (probe,
+        connect, or a relay dying mid-stream). Consecutive failures
+        open the breaker; a half-open trial failure re-opens it
+        immediately (the single trial is spent)."""
+        with self._lock:
+            state = self._brk_state[index]
+            if state == "half_open":
+                self.breaker_reopens += 1
+                self._brk_open_locked(index)
+                return
+            if state == "open":
+                return  # already open; the cooldown clock keeps running
+            self._brk_failures[index] += 1
+            if self._brk_failures[index] >= self.breaker_threshold:
+                self.breaker_opens += 1
+                self.breaker_opens_by_replica[index] += 1
+                self._brk_open_locked(index)
+
+    def note_replica_success(self, index: int) -> None:
+        """A completed interaction closes the breaker (the half-open
+        trial succeeding is the canonical path) and resets the
+        consecutive-failure count."""
+        with self._lock:
+            if self._brk_state[index] != "closed":
+                self.breaker_closes += 1
+                self._brk_state[index] = "closed"
+            self._brk_failures[index] = 0
+
+    def breaker_state(self, index: int) -> str:
+        with self._lock:
+            return self._brk_state[index]
+
+    def note_failover_resume(self) -> None:
+        """One mid-stream relay death turned into a resume re-issue
+        (counted once per client request, not per retry)."""
+        with self._lock:
+            self.failover_resumes += 1
+
+    def _brk_admit(self, candidates: list[int]) -> tuple[list[int],
+                                                         set[int]]:
+        """Breaker gate for one route pass: open replicas whose
+        cooldown has not elapsed are dropped WITHOUT a probe; expired
+        ones transition to half_open and are admitted as trials (the
+        caller orders them last). Returns (admitted, half_open set)."""
+        now = time.monotonic()
+        admitted: list[int] = []
+        trials: set[int] = set()
+        with self._lock:
+            for i in candidates:
+                state = self._brk_state[i]
+                if state == "open":
+                    if now - self._brk_opened_t[i] < \
+                            self.breaker_cooldown_s:
+                        continue
+                    self._brk_state[i] = state = "half_open"
+                if state == "half_open":
+                    trials.add(i)
+                admitted.append(i)
+        return admitted, trials
+
     # -- policy --------------------------------------------------------------
     def route(self, prompt: list[int] | None) -> list[tuple[int, bool]]:
         """``(replica_index, by_prefix)`` pairs to try, best first —
         ``by_prefix`` marks candidates whose trie holds part of the
         prompt (so the winner's counter attribution is decided here,
-        not by a second probe). Probes every in-rotation replica;
-        unreachable or unroutable (draining/recovering) ones are
-        skipped. Deterministic: ties break to the lowest index."""
-        candidates = self.in_rotation()
+        not by a second probe). Probes every in-rotation replica whose
+        breaker admits it (open → skipped probe-free; half-open →
+        probed, ordered last as the single trial); unreachable or
+        unroutable (draining/recovering) ones are skipped.
+        Deterministic: ties break to the lowest index."""
+        candidates, trials = self._brk_admit(self.in_rotation())
         if self.policy == "round_robin":
             if not candidates:
                 return []
+            solid = [i for i in candidates if i not in trials]
+            if not solid:
+                return [(i, False) for i in candidates]
             with self._lock:
                 self._rr_next += 1
-                k = self._rr_next % len(candidates)
-            return [(i, False) for i in candidates[k:] + candidates[:k]]
+                k = self._rr_next % len(solid)
+            return ([(i, False) for i in solid[k:] + solid[:k]]
+                    + [(i, False) for i in candidates if i in trials])
         probes: list[tuple[int, dict]] = []
         for i in candidates:
             try:
@@ -150,6 +249,7 @@ class Router:
             except (urllib.error.URLError, OSError, ValueError):
                 with self._lock:
                     self.errors_by_replica[i] += 1
+                self.note_replica_failure(i)
                 continue
             if snap.get("phase") in UNROUTABLE_PHASES \
                     or snap.get("draining"):
@@ -157,8 +257,12 @@ class Router:
             probes.append((i, snap))
         # Longest resident prefix wins outright; with no residency
         # anywhere, least queue-wait (then least occupancy, then lowest
-        # index — all deterministic).
+        # index — all deterministic). Half-open trials sort strictly
+        # after every closed-breaker candidate regardless of their
+        # probe signals: a recovering replica gets ONE chance, never
+        # priority.
         probes.sort(key=lambda p: (
+            p[0] in trials,
             -int(p[1].get("hit_tokens", 0)),
             float(p[1].get("queue_wait_p95_ms", 0.0)),
             int(p[1].get("queue_depth", 0))
@@ -193,11 +297,21 @@ class Router:
                 "router_retries": self.retries,
                 "router_deploys_completed": self.deploys_completed,
                 "router_deploy_errors": self.deploy_errors,
+                # Fleet fault tolerance: deterministic breaker
+                # transitions (opens/closes are schedule-driven under
+                # seeded chaos; reopens count spent half-open trials)
+                # and mid-stream failover re-issues.
+                "router_breaker_opens": self.breaker_opens,
+                "router_breaker_closes": self.breaker_closes,
+                "router_breaker_reopens": self.breaker_reopens,
+                "router_failover_resumes": self.failover_resumes,
                 "replicas": [
                     {"name": self.replicas[i].name,
                      "in_rotation": self._in_rotation[i],
                      "requests_routed": self.routed_by_replica[i],
-                     "probe_errors": self.errors_by_replica[i]}
+                     "probe_errors": self.errors_by_replica[i],
+                     "breaker_state": self._brk_state[i],
+                     "breaker_opens": self.breaker_opens_by_replica[i]}
                     for i in range(len(self.replicas))],
             }
 
@@ -281,9 +395,20 @@ class RouterFrontDoor:
 
     def __init__(self, router: Router, *, port: int = 0,
                  host: str = "127.0.0.1",
-                 route_wait_s: float = 10.0):
+                 route_wait_s: float = 10.0,
+                 failover_wait_s: float = 60.0,
+                 chaos_hook=None):
         self.router = router
         self._route_wait_s = float(route_wait_s)
+        self._failover_wait_s = float(failover_wait_s)
+        # Chaos injection (tools/serve_net.py drills):
+        # ``chaos_hook(request_seq, tokens_relayed, replica_index)``
+        # fires after every relayed frame — the kill-replica-at-
+        # request-N drill SIGKILLs the serving replica mid-stream from
+        # exactly this callback.
+        self._chaos_hook = chaos_hook
+        self._seq_lock = threading.Lock()
+        self._gen_seq = 0
         self._deploy_thread: threading.Thread | None = None
         self.proxy_errors = 0
         front = self
@@ -391,81 +516,182 @@ class RouterFrontDoor:
                        json.dumps({"error": "not found"}) + "\n")
             return
         try:
-            length = int(req.headers.get("Content-Length") or 0)
-            raw = req.rfile.read(length)
+            raw = read_body(req.headers, req.rfile)
             body = json.loads(raw or b"{}")
             prompt = body.get("prompt")
             if prompt is None and body.get("text") is not None:
                 prompt = [b for b in str(body["text"]).encode("utf-8")]
+        except NoBodyLength:
+            # 411 ONLY here: neither Content-Length nor chunked
+            # framing (same contract as the replica frontend).
+            self._send(req, 411, "application/json", json.dumps(
+                {"error": "Content-Length or Transfer-Encoding: "
+                          "chunked required"}) + "\n")
+            return
         except (ValueError, OSError) as e:
             self._send(req, 400, "application/json",
                        json.dumps({"error": f"bad body: {e}"}) + "\n")
             return
-        self._proxy_generate(req, raw, prompt)
+        self._proxy_generate(req, raw, body, prompt)
 
     def _proxy_generate(self, req: BaseHTTPRequestHandler, raw: bytes,
-                        prompt) -> None:
-        """Route then relay. Candidate replicas are tried best-first; a
-        refusal (503/conn error — e.g. a drain racing the probe) falls
-        through to the next. The rotation can be momentarily empty
-        mid-deploy, so an empty route re-polls briefly before giving
-        up."""
+                        body: dict, prompt) -> None:
+        """Route, relay, and fail over. Candidate replicas are tried
+        best-first; a refusal (503/conn error — e.g. a drain racing
+        the probe) falls through to the next. The rotation can be
+        momentarily empty mid-deploy, so an empty route re-polls
+        briefly before giving up. A relay that dies MID-STREAM (the
+        replica was SIGKILLed under it) re-issues against the next
+        healthy replica with a resume cursor — the client keeps one
+        socket and one seamless stream."""
+        with self._seq_lock:
+            self._gen_seq += 1
+            seq = self._gen_seq
+        # Mutable relay state, shared across failover attempts: the
+        # client headers go out once, the delivered-token cursor and
+        # upstream uid survive a dead upstream.
+        state = {"seq": seq, "uid": None, "delivered": 0,
+                 "headers_sent": False, "done": False,
+                 "client_gone": False}
         t0 = time.monotonic()
         attempt = 0
+        resumed = False
         while True:
             order = self.router.route(prompt)
             for idx, by_prefix in order:
                 rep = self.router.replicas[idx]
+                send_raw = raw
+                if resumed:
+                    resume_body = dict(body)
+                    resume_body["resume"] = {
+                        "uid": state["uid"],
+                        "delivered": state["delivered"]}
+                    send_raw = json.dumps(
+                        resume_body, allow_nan=False).encode()
                 try:
-                    resp = rep.generate_raw(raw)
+                    resp = rep.generate_raw(send_raw)
                 except urllib.error.HTTPError as e:
                     if e.code in (503, 429):
                         attempt += 1
                         continue  # draining/shedding: try the next
+                    if e.code >= 500:
+                        self.router.note_replica_failure(idx)
                     self.proxy_errors += 1
+                    if state["headers_sent"]:
+                        return  # mid-stream: nothing more we can send
                     self._send(req, e.code, "application/json",
                                e.read().decode("utf-8", "replace")
                                or json.dumps({"error": str(e)}) + "\n")
                     return
                 except (urllib.error.URLError, OSError):
+                    self.router.note_replica_failure(idx)
                     attempt += 1
                     continue
                 self.router.note_routed(idx, by_prefix=by_prefix,
                                         retried=attempt > 0)
-                self._relay(req, resp)
-                return
-            if time.monotonic() - t0 > self._route_wait_s:
+                state["replica"] = idx
+                upstream_died = self._relay(req, resp, state)
+                if state["client_gone"]:
+                    return  # the replica's cancel/ack gate handles it
+                if not upstream_died:
+                    self.router.note_replica_success(idx)
+                    return
+                # Upstream died mid-stream: penalize its breaker and
+                # re-issue with the resume cursor. The route pass is
+                # re-run fresh — the dead replica's breaker is open
+                # now, so it is skipped without burning anything.
+                self.router.note_replica_failure(idx)
+                if not resumed:
+                    resumed = True
+                    self.router.note_failover_resume()
+                break  # back to the outer loop for a fresh route
+            wait = (self._failover_wait_s if resumed
+                    else self._route_wait_s)
+            if time.monotonic() - t0 > wait:
                 self.proxy_errors += 1
-                self._send(req, 502, "application/json", json.dumps(
-                    {"error": "no replica accepted the request"}) + "\n")
+                if not state["headers_sent"]:
+                    self._send(req, 502, "application/json", json.dumps(
+                        {"error": "no replica accepted the request"})
+                        + "\n")
                 return
             time.sleep(0.02)
 
-    @staticmethod
-    def _relay(req: BaseHTTPRequestHandler, resp) -> None:
-        """Stream the replica's response through byte-for-byte (SSE
-        events relay as they arrive — read1 never waits for a full
-        buffer). ``contextlib.closing`` releases the upstream socket
-        on every exit path."""
+    def _relay(self, req: BaseHTTPRequestHandler, resp,
+               state: dict) -> bool:
+        """Relay one upstream response into the client socket,
+        SSE-frame-aligned. Forwards only COMPLETE frames (a failover
+        must splice at a frame boundary or the client's SSE parse
+        breaks), tracks the resume cursor (upstream uid + tokens
+        delivered + terminal ``done``), and fires the chaos hook after
+        every forwarded frame. Returns True iff the upstream died
+        before its stream finished (the failover trigger); client
+        hangups set ``state['client_gone']`` instead.
+        ``contextlib.closing`` releases the upstream socket on every
+        exit path."""
         with contextlib.closing(resp):
+            ctype = resp.headers.get("Content-Type", "application/json")
+            streaming = ctype.startswith("text/event-stream")
             try:
-                req.send_response(resp.status)
-                ctype = resp.headers.get("Content-Type",
-                                         "application/json")
-                req.send_header("Content-Type", ctype)
-                clen = resp.headers.get("Content-Length")
-                if clen is not None:
-                    req.send_header("Content-Length", clen)
-                else:
-                    req.send_header("Connection", "close")
-                req.end_headers()
-                while True:
-                    chunk = resp.read1(65536)
-                    if not chunk:
-                        break
-                    req.wfile.write(chunk)
+                if not state["headers_sent"]:
+                    req.send_response(resp.status)
+                    req.send_header("Content-Type", ctype)
+                    clen = resp.headers.get("Content-Length")
+                    if clen is not None and not streaming:
+                        req.send_header("Content-Length", clen)
+                    else:
+                        req.send_header("Connection", "close")
+                    req.end_headers()
+                    state["headers_sent"] = True
             except (BrokenPipeError, ConnectionResetError):
-                pass  # client hung up; the replica's ack gate handles it
+                state["client_gone"] = True
+                return False
+            if not streaming:
+                # Unary JSON (stream=false or an error body): plain
+                # byte relay, no resume framing to track.
+                try:
+                    while True:
+                        chunk = resp.read1(65536)
+                        if not chunk:
+                            break
+                        req.wfile.write(chunk)
+                except (BrokenPipeError, ConnectionResetError):
+                    state["client_gone"] = True
+                except OSError:
+                    return True
+                return False
+            buf = b""
+            while True:
+                try:
+                    chunk = resp.read1(65536)
+                except (OSError, http.client.HTTPException):
+                    return not state["done"]
+                if not chunk:
+                    return not state["done"]
+                buf += chunk
+                while True:
+                    cut = buf.find(b"\n\n")
+                    if cut < 0:
+                        break
+                    frame, buf = buf[:cut + 2], buf[cut + 2:]
+                    event, payload = _parse_sse_frame(frame)
+                    if event == "tokens":
+                        if state["uid"] is None:
+                            state["uid"] = payload.get("uid")
+                        state["delivered"] += len(
+                            payload.get("tokens", ()))
+                    elif event == "done":
+                        if state["uid"] is None:
+                            state["uid"] = payload.get("uid")
+                        state["done"] = True
+                    try:
+                        req.wfile.write(frame)
+                    except (BrokenPipeError, ConnectionResetError):
+                        state["client_gone"] = True
+                        return False
+                    if self._chaos_hook is not None:
+                        self._chaos_hook(state["seq"],
+                                         state["delivered"],
+                                         state.get("replica"))
 
     def _run_deploy(self) -> None:
         try:
@@ -485,6 +711,24 @@ class RouterFrontDoor:
             req.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError):
             pass
+
+
+def _parse_sse_frame(frame: bytes) -> tuple[str | None, dict]:
+    """Parse ONE complete SSE frame ("event: NAME\\ndata: {...}\\n\\n")
+    into (event, payload). Unparseable frames (comments, keepalives)
+    come back as (None, {}) and relay through untouched."""
+    event, data = None, []
+    for line in frame.decode("utf-8", "replace").split("\n"):
+        if line.startswith("event: "):
+            event = line[len("event: "):]
+        elif line.startswith("data: "):
+            data.append(line[len("data: "):])
+    if event is None or not data:
+        return None, {}
+    try:
+        return event, json.loads("\n".join(data))
+    except ValueError:
+        return None, {}
 
 
 # -- SSE client helpers (traffic.py client mode + tests) ---------------------

@@ -96,6 +96,17 @@ class SlotScheduler:
             raise KeyError(f"slot {slot} is free")
         return seq
 
+    def evict_uid(self, uid: int) -> ActiveSequence | None:
+        """Clear and return the seated sequence with ``uid`` (the
+        cancellation path — the caller finishes it with reason
+        ``cancelled`` and the normal finish sweep frees its pages), or
+        None when the uid holds no slot."""
+        for slot, seq in enumerate(self._slots):
+            if seq is not None and seq.request.uid == uid:
+                self._slots[slot] = None
+                return seq
+        return None
+
     def tenant_active(self) -> dict[str, int]:
         """tenant -> seated-sequence count (the queue's quota input)."""
         counts: dict[str, int] = {}

@@ -269,6 +269,247 @@ class TestProbeAndAdmin:
             fe.stop()
 
 
+class TestChunkedBodies:
+    """Satellite: chunked Transfer-Encoding request bodies; 411 is
+    reserved for a body with NEITHER framing."""
+
+    def test_read_body_parses_chunked_framing(self):
+        import io
+
+        from distributed_training_tpu.serving.httpbody import read_body
+
+        wire = (b"5;ext=1\r\nhello\r\n"        # extension stripped
+                b"6\r\n world\r\n"
+                b"0\r\nTrailer: x\r\n\r\n")    # trailers consumed
+        headers = {"Transfer-Encoding": "chunked"}
+        assert read_body(headers, io.BytesIO(wire)) == b"hello world"
+
+    def test_read_body_rejects_malformed_and_oversize(self):
+        import io
+
+        from distributed_training_tpu.serving.httpbody import (
+            NoBodyLength,
+            read_body,
+        )
+
+        chunked = {"Transfer-Encoding": "chunked"}
+        with pytest.raises(ValueError):
+            read_body(chunked, io.BytesIO(b"zz\r\nhi\r\n0\r\n\r\n"))
+        with pytest.raises(ValueError):  # missing CRLF after data
+            read_body(chunked, io.BytesIO(b"2\r\nhiXX0\r\n\r\n"))
+        with pytest.raises(ValueError):  # chunk bigger than the cap
+            read_body(chunked, io.BytesIO(b"5\r\nhello\r\n0\r\n\r\n"),
+                      max_bytes=3)
+        with pytest.raises(NoBodyLength):
+            read_body({}, io.BytesIO(b""))
+
+    def test_chunked_post_equals_content_length_post(self, lm):
+        import http.client
+
+        fe = ServingFrontend(make_engine(lm)).start()
+        try:
+            plain = generate_over_http(
+                fe.url("/generate"),
+                {"prompt": [3, 5, 7], "stream": False}, timeout_s=60.0)
+            body = json.dumps({"prompt": [3, 5, 7],
+                               "stream": False}).encode()
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", fe.port, timeout=60.0)
+            try:
+                conn.request(
+                    "POST", "/generate",
+                    body=iter([body[:7], body[7:]]),
+                    headers={"Content-Type": "application/json"},
+                    encode_chunked=True)
+                resp = conn.getresponse()
+                assert resp.status == 200
+                chunked = json.loads(resp.read())
+            finally:
+                conn.close()
+            assert chunked["tokens"] == plain["tokens"]
+        finally:
+            fe.stop()
+
+    def test_411_only_when_neither_framing_present(self, lm):
+        import socket
+
+        fe = ServingFrontend(make_engine(lm)).start()
+        try:
+            s = socket.create_connection(("127.0.0.1", fe.port),
+                                         timeout=10.0)
+            try:
+                s.sendall(b"POST /generate HTTP/1.1\r\n"
+                          b"Host: t\r\n\r\n")
+                status = s.recv(4096).split(b"\r\n", 1)[0]
+            finally:
+                s.close()
+            assert b"411" in status
+            # Malformed chunked framing is a 400, NOT a 411: a length
+            # WAS declared, it just didn't parse.
+            s = socket.create_connection(("127.0.0.1", fe.port),
+                                         timeout=10.0)
+            try:
+                s.sendall(b"POST /generate HTTP/1.1\r\nHost: t\r\n"
+                          b"Transfer-Encoding: chunked\r\n\r\n"
+                          b"zz\r\nhi\r\n0\r\n\r\n")
+                status = s.recv(4096).split(b"\r\n", 1)[0]
+            finally:
+                s.close()
+            assert b"400" in status
+        finally:
+            fe.stop()
+
+
+class TestCancelOnDisconnect:
+    def test_client_hangup_cancels_and_frees_pages(self, lm):
+        """A dead SSE socket must CANCEL the in-flight request — evict
+        it, free its pages, close its ledger under 'cancelled' — not
+        let it decode its full budget for nobody."""
+        import socket
+
+        eng = make_engine(lm, max_new_tokens=24, prefix_cache=True)
+        fe = ServingFrontend(eng).start()
+        try:
+            body = json.dumps({"prompt": [3, 5, 7],
+                               "stream": True}).encode()
+            s = socket.create_connection(("127.0.0.1", fe.port),
+                                         timeout=30.0)
+            s.sendall(b"POST /generate HTTP/1.1\r\nHost: t\r\n"
+                      b"Content-Type: application/json\r\n"
+                      b"Content-Length: %d\r\n\r\n" % len(body) + body)
+            # Read until the first tokens frame lands, then hang up
+            # mid-stream with ~20 tokens of budget left.
+            buf = b""
+            while b"event: tokens" not in buf or b"\n\n" not in buf:
+                buf += s.recv(4096)
+            s.close()
+            deadline = time.monotonic() + 30.0
+            cancelled = 0
+            while time.monotonic() < deadline:
+                stats = json.loads(_get(fe.url("/vars")))["serving"]
+                cancelled = stats.get("requests_cancelled", 0)
+                if cancelled:
+                    break
+                time.sleep(0.05)
+            assert cancelled == 1
+            assert stats.get("ledger_cancelled_ms_total", 0.0) > 0.0
+            # Pages came back: the leak audit is green once idle, and
+            # the replica still serves the next client.
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                st, probe = _post(fe.url("/probe"), {})
+                if not probe["queue_depth"] and not probe["active_slots"]:
+                    break
+                time.sleep(0.05)
+            st, verdict = _post(fe.url("/admin/check_balanced"), {})
+            assert st == 200 and verdict["balanced"], verdict
+            out = _serve_http(fe, [PROMPTS[0]])
+            assert out[0]["tokens"]
+        finally:
+            fe.stop()
+
+
+class TestResumeFailover:
+    def test_journal_tail_resume_redelivers_exactly_the_tail(
+            self, lm, tmp_path):
+        """A finished-unacked journal entry answers a resume cursor
+        with the UNDELIVERED tail — and a done event carrying the full
+        array, so the client's head+tail concatenation checks out."""
+        jdir = str(tmp_path / "jr")
+        eng = make_engine(lm, journal_dir=jdir)
+        eng.recover()
+        # Finish a request WITHOUT delivering it (the journal's
+        # finished-unacked state — exactly what a dead relay leaves).
+        r = eng.submit(PROMPTS[0])
+        (fin,) = list(eng.run())
+        full = [int(t) for t in fin.tokens]
+        fe = ServingFrontend(eng).start()
+        try:
+            req = urllib.request.Request(
+                fe.url("/generate"),
+                data=json.dumps({
+                    "prompt": [int(t) for t in PROMPTS[0]],
+                    "stream": True,
+                    "resume": {"uid": r.uid, "delivered": 2}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60.0) as resp:
+                events = list(sse_events(resp))
+            tail = [t for e, d in events if e == "tokens"
+                    for t in d["tokens"]]
+            done = [d for e, d in events if e == "done"][0]
+            assert tail == full[2:]
+            assert done["tokens"] == full
+            hz = json.loads(_get(fe.url("/healthz")))
+            assert hz["requests_resumed"] == 1
+        finally:
+            fe.stop()
+            eng.journal.shutdown()
+        # The tail delivery ACKED: recovery replays nothing.
+        eng2 = make_engine(lm, journal_dir=jdir)
+        assert eng2.recover()["redelivered"] == []
+        eng2.journal.shutdown()
+
+    def test_unknown_uid_falls_through_to_fresh_submit_with_skip(
+            self, lm):
+        """Resume against a replica that never saw the uid (the
+        cross-replica failover path): fresh submit, first K tokens
+        suppressed — greedy decoding makes the regenerated stream
+        bitwise the original, so the splice is seamless."""
+        ref_eng = make_engine(lm)
+        ref_eng.submit(PROMPTS[1])
+        (fin,) = list(ref_eng.run())
+        full = [int(t) for t in fin.tokens]
+        fe = ServingFrontend(make_engine(lm)).start()
+        try:
+            req = urllib.request.Request(
+                fe.url("/generate"),
+                data=json.dumps({
+                    "prompt": [int(t) for t in PROMPTS[1]],
+                    "stream": True,
+                    "resume": {"uid": 777, "delivered": 3}}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60.0) as resp:
+                events = list(sse_events(resp))
+            tail = [t for e, d in events if e == "tokens"
+                    for t in d["tokens"]]
+            done = [d for e, d in events if e == "done"][0]
+            assert tail == full[3:]   # the head is NOT re-sent
+            assert done["tokens"] == full
+        finally:
+            fe.stop()
+
+    def test_bad_resume_cursor_is_400(self, lm):
+        fe = ServingFrontend(make_engine(lm)).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(fe.url("/generate"),
+                      {"prompt": [1, 2], "stream": False,
+                       "resume": {"uid": "not-an-int"}})
+            assert ei.value.code == 400
+        finally:
+            fe.stop()
+
+    def test_engine_stream_attach_reports_progress(self, lm):
+        """Engine-level attach (the live re-attach half of resume):
+        returns the tokens emitted so far and arms the stream cursor
+        so the listener delivers the rest exactly once."""
+        eng = make_engine(lm, max_new_tokens=6)
+        r = eng.submit(PROMPTS[2])
+        eng.step()  # seat + first chunk
+        landed = eng.stream_attach(r.uid)
+        assert landed is not None
+        got = list(landed)
+        eng.set_token_listener(
+            lambda uid, toks, fin: got.extend(int(t) for t in toks))
+        fins = []
+        while not eng.idle:
+            fins.extend(eng.step())
+        (fin,) = fins
+        assert got == [int(t) for t in fin.tokens]
+        assert eng.stream_attach(999) is None
+        eng.set_token_listener(None)
+
+
 class TestSeatOrdering:
     """Satellite: cache-aware seat ordering inside the queue."""
 

@@ -197,6 +197,70 @@ class TestScrapeSafety:
         """, "scrape-safety") == 1
         assert "prefix-trie mutation" in capsys.readouterr().out
 
+    def test_positive_supervisor_snapshot_killing_exits_1(
+            self, tmp_path, capsys):
+        # The fleet-fault-tolerance bug class: a supervisor_snapshot
+        # that notices a dead proc and restarts it INLINE runs the
+        # restart ladder on the scrape thread, racing the monitor
+        # thread's own death detection (double restart, double count).
+        assert _exit_code(tmp_path, """
+            class Supervisor:
+                def supervisor_snapshot(self):
+                    for i, h in enumerate(self.handles):
+                        if h.proc.poll() is not None:
+                            self.kill(i)
+                    return {"replica_restarts": self.replica_restarts}
+        """, "scrape-safety") == 1
+        assert "fleet-supervision mutation" in capsys.readouterr().out
+
+    def test_positive_router_snapshot_tripping_breaker_exits_1(
+            self, tmp_path, capsys):
+        # A counter view that trips breakers: two concurrent scrapes
+        # double-count breaker_opens and can evict a healthy replica
+        # from rotation without a single failed request.
+        assert _exit_code(tmp_path, """
+            class Router:
+                def router_snapshot(self):
+                    for i, r in enumerate(self.replicas):
+                        if not self._reachable(r):
+                            self.note_replica_failure(i)
+                    return {"router_breaker_opens": self.breaker_opens}
+        """, "scrape-safety") == 1
+        assert "note_replica_failure" in capsys.readouterr().out
+
+    def test_negative_breaker_accounting_on_proxy_thread_is_clean(
+            self, tmp_path):
+        # The shipped design: the do_POST proxy thread OWNS breaker
+        # accounting (it observed the failure) and the failover-resume
+        # counter; the snapshot providers are lock-guarded reads. The
+        # snapshot-only clause must not flag the proxy path.
+        assert not _lint(tmp_path, """
+            class Supervisor:
+                def supervisor_snapshot(self):
+                    with self._lock:
+                        return {
+                            "replica_restarts": self.replica_restarts,
+                            "restarts_by_replica": list(self._restarts),
+                        }
+
+            class Router:
+                def do_POST(self):
+                    idx = self._route_one()
+                    try:
+                        self._relay(idx)
+                        self.note_replica_success(idx)
+                    except OSError:
+                        self.note_replica_failure(idx)
+                        self.note_failover_resume()
+
+                def router_snapshot(self):
+                    with self._lock:
+                        return {
+                            "router_breaker_opens": self.breaker_opens,
+                            "breaker_state": list(self._brk_state),
+                        }
+        """, "scrape-safety")
+
     def test_negative_front_door_admission_surface_is_clean(
             self, tmp_path):
         # The shipped round-22 design: the handler submits (lock-

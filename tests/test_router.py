@@ -50,8 +50,10 @@ class FakeReplica:
         self.wedge_drain = wedge_drain
         self.epoch = 0
         self.admin_log = []
+        self.probe_calls = 0
 
     def probe(self, prompt):
+        self.probe_calls += 1
         if self.unreachable:
             raise OSError("connection refused")
         return {"hit_tokens": self.hit,
@@ -139,6 +141,103 @@ class TestRoutingPolicy:
             Router([FakeReplica("a")], policy="sticky")
         with pytest.raises(ValueError, match="at least one replica"):
             Router([])
+
+
+class TestCircuitBreaker:
+    """Per-replica breaker state machine on scripted fakes: closed →
+    open on consecutive failures → half-open trial after cooldown →
+    closed on success / straight back to open on failure."""
+
+    def test_consecutive_failures_open_and_skip_probe_free(self):
+        reps = [FakeReplica("a", hit=99), FakeReplica("b")]
+        r = Router(reps, breaker_threshold=3, breaker_cooldown_s=60.0)
+        r.note_replica_failure(0)
+        r.note_replica_failure(0)
+        assert r.breaker_state(0) == "closed"  # threshold not reached
+        r.note_replica_failure(0)
+        assert r.breaker_state(0) == "open"
+        snap = r.router_snapshot()
+        assert snap["router_breaker_opens"] == 1
+        assert snap["replicas"][0]["breaker_state"] == "open"
+        assert snap["replicas"][0]["breaker_opens"] == 1
+        # The open replica is dropped BEFORE its probe: no timeout
+        # burned, no fallback slot consumed — hit=99 would otherwise
+        # win the route outright.
+        before = reps[0].probe_calls
+        assert [i for i, _ in r.route([1, 2, 3])] == [1]
+        assert reps[0].probe_calls == before
+
+    def test_success_resets_consecutive_failure_count(self):
+        r = Router([FakeReplica("a"), FakeReplica("b")],
+                   breaker_threshold=3)
+        r.note_replica_failure(0)
+        r.note_replica_failure(0)
+        r.note_replica_success(0)
+        r.note_replica_failure(0)
+        r.note_replica_failure(0)
+        assert r.breaker_state(0) == "closed"  # never 3 CONSECUTIVE
+        assert r.router_snapshot()["router_breaker_opens"] == 0
+
+    def test_cooldown_expiry_admits_half_open_trial_last(self):
+        reps = [FakeReplica("a", hit=99), FakeReplica("b")]
+        r = Router(reps, breaker_threshold=1, breaker_cooldown_s=0.0)
+        r.note_replica_failure(0)
+        assert r.breaker_state(0) == "open"
+        order = r.route([1, 2, 3])
+        assert r.breaker_state(0) == "half_open"
+        # hit=99 would rank the trial first on signals alone; a
+        # recovering replica gets ONE chance, never priority.
+        assert [i for i, _ in order] == [1, 0]
+
+    def test_trial_success_closes(self):
+        r = Router([FakeReplica("a"), FakeReplica("b")],
+                   breaker_threshold=1, breaker_cooldown_s=0.0)
+        r.note_replica_failure(0)
+        r.route([1])  # cooldown elapsed → half_open
+        r.note_replica_success(0)
+        assert r.breaker_state(0) == "closed"
+        snap = r.router_snapshot()
+        assert snap["router_breaker_closes"] == 1
+        assert snap["router_breaker_reopens"] == 0
+
+    def test_trial_failure_reopens_immediately(self):
+        r = Router([FakeReplica("a"), FakeReplica("b")],
+                   breaker_threshold=1, breaker_cooldown_s=0.0)
+        r.note_replica_failure(0)
+        r.route([1])  # → half_open
+        r.note_replica_failure(0)  # the single trial is spent
+        assert r.breaker_state(0) == "open"
+        snap = r.router_snapshot()
+        assert snap["router_breaker_reopens"] == 1
+        assert snap["router_breaker_opens"] == 1  # reopen != new open
+
+    def test_round_robin_orders_trials_last(self):
+        r = Router([FakeReplica("a"), FakeReplica("b"),
+                    FakeReplica("c")], policy="round_robin",
+                   breaker_threshold=1, breaker_cooldown_s=0.0)
+        r.note_replica_failure(0)
+        order = [i for i, _ in r.route([1])]
+        assert order[-1] == 0 and set(order) == {0, 1, 2}
+
+    def test_open_replica_still_cooling_is_unroutable(self):
+        r = Router([FakeReplica("a")], breaker_threshold=1,
+                   breaker_cooldown_s=60.0)
+        r.note_replica_failure(0)
+        assert r.route([1]) == []
+
+    def test_counters_deterministic_across_two_runs(self):
+        def run():
+            r = Router([FakeReplica("a"), FakeReplica("b")],
+                       breaker_threshold=2, breaker_cooldown_s=0.0)
+            r.note_replica_failure(0)
+            r.note_replica_failure(0)   # → open
+            r.route([1, 2])             # → half_open trial
+            r.note_replica_failure(0)   # trial spent → open
+            r.route([1, 2])             # → half_open again
+            r.note_replica_success(0)   # → closed
+            r.note_failover_resume()
+            return r.router_snapshot()
+        assert run() == run()
 
 
 class TestRollingDeploy:
@@ -303,3 +402,36 @@ class TestNetworkDrills:
         assert row["stream_vs_done_mismatches"] == 0
         assert row["router_deploys_completed"] == 2
         assert row["router_deploy_errors"] == 0
+
+    def test_fleet_failover_kill_mid_stream(self, tmp_path):
+        # The CI "Fleet failover drill" kill leg, single cycle: SIGKILL
+        # the replica serving request 3 after >= 1 relayed token. The
+        # supervisor restarts it from its journal, the breaker opens
+        # (threshold 1 + long cooldown pins the dead replica out of
+        # rotation), the relay resumes mid-stream — and every client
+        # stream still matches its done payload bitwise, which serve_net
+        # itself gates (rc != 0 on any mismatch). Fault accounting is
+        # deterministic: exactly one restart/open/resume.
+        row = _run_serve_net(
+            "--journal-dir", str(tmp_path / "j"),
+            "--kill-replica-at-request", "3",
+            "--breaker-threshold", "1", "--breaker-cooldown-s", "600")
+        assert row["requests_failed"] == 0
+        assert row["stream_vs_done_mismatches"] == 0
+        assert row["requests_finished"] == row["requests"]
+        assert row["replica_restarts"] == 1
+        assert row["breaker_opens"] == 1
+        assert row["failover_resumes"] == 1
+        assert row["balance_violations"] == 0
+
+    def test_client_disconnect_cancels_and_stays_balanced(self):
+        # Disconnect leg: client 2 hangs up after 3 tokens with budget
+        # left. The replica must cancel (not decode the rest for
+        # nobody) and the drained-fleet page-leak audit must stay
+        # green — serve_net exits nonzero on a balance violation.
+        row = _run_serve_net("--max-new-tokens", "16",
+                             "--drop-client-at-token", "3",
+                             "--drop-client-at-request", "2")
+        assert row["requests_cancelled"] >= 1
+        assert row["requests_failed"] == 0
+        assert row["balance_violations"] == 0

@@ -1,0 +1,242 @@
+"""Replica supervision (serving/supervisor.py) on scripted handles.
+
+No engines, no subprocesses: a FakeProc stands in for the Popen and a
+tiny in-process HTTP server answers ``/healthz`` with a scripted
+heartbeat, so every detection channel — waitpid death, probe-failure
+death, frozen-heartbeat wedge — is driven deterministically. The real
+subprocess path (SIGKILL a journaled replica behind the router) is the
+``slow`` fleet-failover drill in tests/test_router.py.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from distributed_training_tpu.serving.supervisor import (
+    PROBE_FAILURE_THRESHOLD,
+    ReplicaSupervisor,
+)
+
+
+class FakeProc:
+    """waitpid stand-in: alive until ``die()`` or ``kill()``."""
+
+    def __init__(self):
+        self._rc = None
+        self.kills = 0
+
+    def poll(self):
+        return self._rc
+
+    def kill(self):
+        self.kills += 1
+        self._rc = -9
+
+    def wait(self, timeout=None):
+        return self._rc
+
+    def die(self, rc=1):
+        self._rc = rc
+
+
+class _HealthzServer:
+    """Scripted /healthz: returns ``beat_fn()`` as the heartbeat."""
+
+    def __init__(self, beat_fn):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self2):
+                body = json.dumps(
+                    {"serve_loop_heartbeat": beat_fn()}).encode()
+                self2.send_response(200)
+                self2.send_header("Content-Type", "application/json")
+                self2.send_header("Content-Length", str(len(body)))
+                self2.end_headers()
+                self2.wfile.write(body)
+
+            def log_message(self2, *a):
+                pass
+
+        self.srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.srv.server_address[1]}"
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+
+
+class FakeHandle:
+    def __init__(self, name, url):
+        self.name = name
+        self.url = url
+        self.proc = FakeProc()
+        self.stopped = False
+
+    def stop(self):
+        self.stopped = True
+        self.proc.kill()
+
+
+def _wait_for(pred, timeout_s=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+@pytest.fixture
+def healthz():
+    beat = {"n": 0, "advance": True}
+
+    def beat_fn():
+        if beat["advance"]:
+            beat["n"] += 1
+        return beat["n"]
+
+    srv = _HealthzServer(beat_fn)
+    try:
+        yield srv, beat
+    finally:
+        srv.close()
+
+
+def _supervisor(srv, **kw):
+    spawned = []
+
+    def spawn(i):
+        h = FakeHandle(f"r{i}-gen{len(spawned)}", srv.url)
+        spawned.append(h)
+        return h
+
+    kw.setdefault("probe_interval_s", 0.02)
+    kw.setdefault("probe_timeout_s", 1.0)
+    kw.setdefault("backoff_base_s", 0.01)
+    sup = ReplicaSupervisor(spawn, 1, **kw)
+    return sup, spawned
+
+
+class TestReplicaSupervisor:
+    def test_death_detected_and_restarted(self, healthz):
+        srv, _ = healthz
+        restarts = []
+        sup, spawned = _supervisor(
+            srv, on_restart=lambda i, h: restarts.append((i, h.name)))
+        sup.start()
+        try:
+            spawned[0].proc.die()
+            assert _wait_for(lambda: sup.replica_restarts == 1)
+            snap = sup.supervisor_snapshot()
+            assert snap["deaths_detected"] == 1
+            assert snap["restarts_by_replica"] == [1]
+            assert snap["wedged_kills"] == 0
+            assert restarts == [(0, "r0-gen1")]
+            assert spawned[0].stopped  # old handle reaped
+            assert sup.handles[0] is spawned[1]
+        finally:
+            sup.stop()
+
+    def test_injected_kill_counts_and_restarts(self, healthz):
+        srv, _ = healthz
+        sup, spawned = _supervisor(srv)
+        sup.start()
+        try:
+            sup.kill(0)
+            assert _wait_for(lambda: sup.replica_restarts == 1)
+            snap = sup.supervisor_snapshot()
+            assert snap["kills_injected"] == 1
+            assert snap["deaths_detected"] == 1
+        finally:
+            sup.stop()
+
+    def test_crash_loop_gives_up_after_max_restarts(self, healthz):
+        srv, _ = healthz
+        sup, spawned = _supervisor(srv, max_restarts=2)
+        sup.start()
+        try:
+            def keep_killing():
+                # Every generation dies as soon as the monitor can see
+                # it; the supervisor must stop at max_restarts.
+                for h in list(sup.handles):
+                    h.proc.die()
+                return sup.supervisor_snapshot()["gave_up"][0]
+
+            assert _wait_for(keep_killing)
+            snap = sup.supervisor_snapshot()
+            assert snap["replica_restarts"] == 2
+            assert snap["gave_up"] == [True]
+        finally:
+            sup.stop()
+
+    def test_unreachable_replica_force_restarted(self):
+        # url points at nothing: every probe fails. An ALIVE process
+        # that can't answer /healthz is dead for routing purposes —
+        # after PROBE_FAILURE_THRESHOLD misses it is killed+restarted.
+        spawned = []
+
+        def spawn(i):
+            h = FakeHandle(f"r{i}-gen{len(spawned)}",
+                           "http://127.0.0.1:1")  # refused
+            spawned.append(h)
+            return h
+
+        sup = ReplicaSupervisor(spawn, 1, probe_interval_s=0.02,
+                                probe_timeout_s=0.2, max_restarts=1,
+                                backoff_base_s=0.01)
+        sup.start()
+        try:
+            assert _wait_for(lambda: sup.replica_restarts == 1)
+            assert spawned[0].proc.kills >= 1
+            assert sup.supervisor_snapshot()["deaths_detected"] >= 1
+            assert PROBE_FAILURE_THRESHOLD >= 2  # never single-probe
+        finally:
+            sup.stop()
+
+    def test_wedged_heartbeat_force_killed_and_restarted(self, healthz):
+        srv, beat = healthz
+        sup, spawned = _supervisor(srv, wedge_timeout_s=0.15)
+        sup.start()
+        try:
+            # Let a couple of advancing beats land (healthy), then
+            # freeze the heartbeat while the HTTP plane stays up.
+            time.sleep(0.1)
+            beat["advance"] = False
+            assert _wait_for(lambda: sup.replica_restarts == 1)
+            snap = sup.supervisor_snapshot()
+            assert snap["wedged_kills"] == 1
+            assert spawned[0].proc.kills >= 1
+            # The replacement starts a fresh heartbeat clock: no
+            # immediate re-kill of the new generation.
+            assert not sup.gave_up[0]
+        finally:
+            sup.stop()
+
+    def test_wedge_detector_off_by_default(self, healthz):
+        srv, beat = healthz
+        beat["advance"] = False  # frozen from the start
+        sup, _ = _supervisor(srv)  # wedge_timeout_s=None
+        sup.start()
+        try:
+            time.sleep(0.3)
+            snap = sup.supervisor_snapshot()
+            assert snap["wedged_kills"] == 0
+            assert snap["replica_restarts"] == 0
+        finally:
+            sup.stop()
+
+    def test_stop_is_idempotent_and_stops_handles(self, healthz):
+        srv, _ = healthz
+        sup, spawned = _supervisor(srv)
+        sup.start()
+        sup.stop()
+        sup.stop()
+        assert spawned[0].stopped
+
+    def test_needs_at_least_one_replica(self):
+        with pytest.raises(ValueError, match="at least one"):
+            ReplicaSupervisor(lambda i: None, 0)

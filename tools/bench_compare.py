@@ -181,6 +181,17 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     "router_requests_routed": ("both", 0.0),
     "router_prefix_routed": ("both", 0.0),
     "router_fallback_routed": ("both", 0.0),
+    # Fleet fault tolerance (serving/supervisor.py + the router's
+    # circuit breakers; docs/RESILIENCE.md "Fleet fault tolerance"):
+    # on every no-fault row all four are exactly zero — the
+    # zero-baseline zero-tolerance semantics turn any spurious
+    # restart, breaker trip, cancel, or failover on a healthy run
+    # into a regression. Chaos drills pin their nonzero values
+    # bitwise in CI instead of here.
+    "replica_restarts": ("both", 0.0),
+    "breaker_opens": ("both", 0.0),
+    "requests_cancelled": ("both", 0.0),
+    "failover_resumes": ("both", 0.0),
 }
 
 

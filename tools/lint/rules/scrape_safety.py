@@ -33,6 +33,18 @@ work) and *wait* (condition variables) but must never
   router's fingerprint endpoints are read-only by contract
   (``PrefixCache.probe`` touches no refcount and no recency state).
 
+The fleet fault-tolerance round (``serving/supervisor.py`` + the
+router's circuit breaker) adds a *snapshot-only* clause: breaker
+accounting (``note_replica_failure``/``note_replica_success``/
+``note_failover_resume``) and supervision actions (``kill``/
+``_restart``) are owned by the proxy/monitor threads that observe the
+failures — a ``router_snapshot``/``supervisor_snapshot`` provider is a
+counter VIEW and must never trip a breaker or kill a replica from the
+scrape thread, or two concurrent scrapes double-count opens and race
+the monitor's restart ladder. The proxy handler itself (``do_POST``)
+legitimately reaches the ``note_*`` hooks, so this clause applies only
+to the snapshot-provider roots, not the HTTP handler roots.
+
 Roots: HTTP ``do_GET``/``do_POST`` methods (and everything they reach,
 including ``MetricsExporter._handle``, the frontend's request handlers
 and the router's probe/proxy endpoints — their nested ``Handler``
@@ -58,7 +70,11 @@ PROVIDER_NAMES = {"flight_snapshot", "scrape_snapshot", "health",
                   # Network front door (serving/frontend.py + router.py):
                   # the routing probe and the router's counter view run
                   # on handler threads too.
-                  "probe_snapshot", "router_snapshot"}
+                  "probe_snapshot", "router_snapshot",
+                  # Fleet fault tolerance (serving/supervisor.py): the
+                  # supervisor's counter view is scraped by drills and
+                  # the chaos harness while the monitor thread is hot.
+                  "supervisor_snapshot"}
 
 DEVICE_READS = {"device_get", "block_until_ready", "item", "tolist",
                 "memory_stats", "device_memory_metrics"}
@@ -88,6 +104,15 @@ ENGINE_DRIVE = {"step", "drain", "arm_swap"}
 # Prefix-trie mutation: a probe endpoint reads residency, it must never
 # claim pages, insert chains, or trigger eviction from a handler thread.
 CACHE_MUTATION = {"claim", "insert_chain", "evict_until"}
+# Fleet-supervision mutation, SNAPSHOT-ONLY clause: breaker accounting
+# and replica kill/restart belong to the proxy/monitor threads that
+# observed the failure. The proxy handler (do_POST) legitimately calls
+# the note_* hooks, so these are checked only from snapshot-provider
+# roots — a router_snapshot/supervisor_snapshot that trips a breaker or
+# kills a replica turns a read into an outage.
+FLEET_MUTATION = {"note_replica_failure", "note_replica_success",
+                  "note_failover_resume", "kill", "_restart",
+                  "force_restart"}
 
 
 def _roots(index: ProjectIndex) -> list[FunctionInfo]:
@@ -128,3 +153,23 @@ def check(index: ProjectIndex) -> Iterator[Finding]:
                     f"only read host-side state the hot loop already "
                     f"materialized (docs/OBSERVABILITY.md, round-11 "
                     f"contract)")
+    # Snapshot-only clause: providers are counter views. Breaker/
+    # supervision mutation reachable from a snapshot provider (but
+    # legal from do_POST proxy handlers) is checked against the
+    # narrower root set.
+    snap_roots = [fn for fn in index.iter_functions()
+                  if fn.name in PROVIDER_NAMES]
+    snap_reach = index.reachable(snap_roots)
+    for qualname in sorted(snap_reach):
+        fn, chain = snap_reach[qualname]
+        via = " -> ".join(q.split("::")[-1] for q in chain)
+        for cs in fn.calls:
+            if cs.name in FLEET_MUTATION:
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f"snapshot path ({via}) reaches a fleet-supervision "
+                    f"mutation '{cs.name}()' — router_snapshot/"
+                    f"supervisor_snapshot are counter views; breaker "
+                    f"trips and replica kill/restart belong to the "
+                    f"proxy/monitor threads (docs/RESILIENCE.md, fleet "
+                    f"fault tolerance)")

@@ -169,15 +169,108 @@ def _replica_stats(url: str) -> dict:
         return json.loads(resp.read())["serving"]
 
 
+def _settle_and_audit(sup, timeout_s: float = 60.0):
+    """Post-replay fleet audit: wait for each replica to drain to the
+    idle steady state (a chaos-killed replica may still be mid-restart
+    or finishing recovered zombie work), run its page-balance leak
+    audit, and scrape its /vars. Returns (per_replica_stats,
+    balance_violations); an unreachable or never-settling replica
+    counts as a violation — a leak audit that cannot run must not
+    pass silently. Re-reads ``sup.handles[i]`` every poll: a restart
+    swaps the handle (new port) while we wait."""
+    import urllib.request
+
+    stats, violations = [], 0
+    for i in range(len(sup.handles)):
+        t0 = time.monotonic()
+        audited = False
+        while time.monotonic() - t0 < timeout_s:
+            h = sup.handles[i]
+            try:
+                with urllib.request.urlopen(
+                        urllib.request.Request(
+                            h.url + "/probe", data=b"{}",
+                            headers={"Content-Type":
+                                     "application/json"}),
+                        timeout=5.0) as resp:
+                    probe = json.loads(resp.read())
+                if probe.get("queue_depth", 1) or \
+                        probe.get("active_slots", 1):
+                    time.sleep(0.1)
+                    continue
+                with urllib.request.urlopen(
+                        urllib.request.Request(
+                            h.url + "/admin/check_balanced", data=b"{}",
+                            headers={"Content-Type":
+                                     "application/json"}),
+                        timeout=10.0) as resp:
+                    verdict = json.loads(resp.read())
+                if not verdict.get("balanced", False):
+                    print(f"[serve_net] BALANCE VIOLATION on {h.name}: "
+                          f"{verdict.get('error')}", file=sys.stderr)
+                    violations += 1
+                stats.append(_replica_stats(h.url))
+                audited = True
+                break
+            except Exception:
+                time.sleep(0.25)  # mid-restart: keep polling
+        if not audited:
+            print(f"[serve_net] replica {sup.handles[i].name} never "
+                  f"settled for the balance audit", file=sys.stderr)
+            violations += 1
+            stats.append({})
+    return stats, violations
+
+
 def run_front_door(args: argparse.Namespace) -> int:
     from distributed_training_tpu.serving.router import (
         HttpReplica, Router, RouterFrontDoor)
+    from distributed_training_tpu.serving.supervisor import (
+        ReplicaSupervisor)
     from tools.traffic import make_scenario, replay_over_http
 
-    replicas = [ReplicaProc(i, args) for i in range(args.replicas)]
+    # The supervisor owns the replica processes: spawn, death/wedge
+    # detection, restart-with-journal. A restart rebinds the router's
+    # HttpReplica at the replacement port (a plain string store — the
+    # breaker keeps traffic off the replica until it proves out).
+    router_box: list = []
+
+    def _on_restart(i: int, handle) -> None:
+        if router_box:
+            router_box[0].replicas[i].url = handle.url.rstrip("/")
+        print(f"[serve_net] supervisor restarted {handle.name} on "
+              f"port {handle.port}", file=sys.stderr)
+
+    sup = ReplicaSupervisor(
+        lambda i: ReplicaProc(i, args), args.replicas,
+        wedge_timeout_s=args.wedge_timeout_s or None,
+        on_restart=_on_restart).start()
+    replicas = sup.handles
     router = Router([HttpReplica(r.url, name=r.name) for r in replicas],
-                    policy=args.policy)
-    door = RouterFrontDoor(router, port=args.port).start()
+                    policy=args.policy,
+                    breaker_threshold=args.breaker_threshold,
+                    breaker_cooldown_s=args.breaker_cooldown_s)
+    router_box.append(router)
+
+    # Chaos: SIGKILL the replica serving request N after its first
+    # relayed token — mid-stream by construction, through the
+    # supervisor's handle so detection/restart run the real path.
+    kill_state = {"killed": False}
+
+    def _chaos_hook(seq: int, delivered: int, replica_idx) -> None:
+        if (args.kill_replica_at_request > 0 and not kill_state["killed"]
+                and seq == args.kill_replica_at_request
+                and delivered >= 1 and replica_idx is not None):
+            kill_state["killed"] = True
+            print(f"[serve_net] chaos: SIGKILL replica {replica_idx} "
+                  f"mid-stream (request {seq}, {delivered} tokens "
+                  f"delivered)", file=sys.stderr)
+            sup.kill(replica_idx)
+
+    door = RouterFrontDoor(
+        router, port=args.port,
+        chaos_hook=(_chaos_hook if args.kill_replica_at_request > 0
+                    else None)).start()
     print(json.dumps({"port": door.port, "policy": args.policy,
                       "replicas": [{"name": r.name, "port": r.port}
                                    for r in replicas]}), flush=True)
@@ -214,14 +307,23 @@ def run_front_door(args: argparse.Namespace) -> int:
             deploy_thread = threading.Thread(
                 target=_deploy, name="chaos-deploy", daemon=True)
             deploy_thread.start()
+        # Chaos: the disconnect drill hangs up request M's client
+        # socket after K streamed tokens — the replica must notice the
+        # dead pipe, cancel the in-flight request, and free its pages.
+        drop_at = None
+        if args.drop_client_at_token > 0:
+            drop_at = {args.drop_client_at_request - 1:
+                       args.drop_client_at_token}
         t0 = time.monotonic()
         results = replay_over_http(
             door.url("/generate"), reqs, stream=not args.unary,
-            concurrency=args.concurrency, timeout_s=args.timeout_s)
+            concurrency=args.concurrency, timeout_s=args.timeout_s,
+            drop_at=drop_at)
         wall_s = time.monotonic() - t0
         if deploy_thread is not None:
             deploy_thread.join(timeout=120.0)
 
+        dropped = set(drop_at or ())
         done = [r for r in results if r is not None]
         mismatched = sum(1 for r in done
                          if r.get("streamed_tokens") is not None
@@ -236,13 +338,25 @@ def run_front_door(args: argparse.Namespace) -> int:
             print(f"[serve_net] completions: {args.completions_out} "
                   f"({len(done)} requests)", file=sys.stderr)
 
+        # Post-replay fleet audit FIRST: it waits out an in-flight
+        # restart (the supervisor's spawn blocks through journal
+        # recovery) and a cancel landing a step after the client
+        # vanished — the supervisor/router snapshots after it are the
+        # settled fault counters the drill pins bitwise.
+        chaos = bool(drop_at) or kill_state["killed"]
+        per_replica, balance_violations = _settle_and_audit(
+            sup, timeout_s=120.0 if chaos else 20.0)
         snap = router.router_snapshot()
-        per_replica = [_replica_stats(r.url) for r in replicas]
+        sup_snap = sup.supervisor_snapshot()
         row = {
             "scenario": args.scenario,
             "requests": len(reqs),
             "requests_finished": len(done),
-            "requests_failed": len(reqs) - len(done),
+            # A chaos-dropped client is an injected fault, not a
+            # serving failure — excluded from the failure gate.
+            "requests_failed": sum(
+                1 for i, r in enumerate(results)
+                if r is None and i not in dropped),
             "tokens_emitted": sum(len(r["tokens"]) for r in done),
             "stream_vs_done_mismatches": mismatched,
             "replicas": args.replicas,
@@ -253,6 +367,16 @@ def run_front_door(args: argparse.Namespace) -> int:
             "router_retries": snap["router_retries"],
             "router_deploys_completed": snap["router_deploys_completed"],
             "router_deploy_errors": snap["router_deploy_errors"],
+            # Fleet fault tolerance (zero on every no-fault row — the
+            # bench_compare zero-drift contract; a chaos drill pins
+            # them bitwise across independent kill cycles instead).
+            "replica_restarts": sup_snap["replica_restarts"],
+            "breaker_opens": snap["router_breaker_opens"],
+            "failover_resumes": snap["router_failover_resumes"],
+            "requests_cancelled": sum(
+                int(s.get("requests_cancelled", 0))
+                for s in per_replica),
+            "balance_violations": balance_violations,
             # Global cache economics: prefill compute saved ACROSS the
             # fleet — the number cache-aware routing exists to raise.
             "prefix_cache_hit_tokens": sum(
@@ -267,11 +391,11 @@ def run_front_door(args: argparse.Namespace) -> int:
         }
         print(json.dumps(row, allow_nan=False))
         return 0 if (not row["requests_failed"] and not mismatched
-                     and not row["router_deploy_errors"]) else 1
+                     and not row["router_deploy_errors"]
+                     and not balance_violations) else 1
     finally:
         door.stop()
-        for r in replicas:
-            r.stop()
+        sup.stop()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -306,6 +430,28 @@ def main(argv: list[str] | None = None) -> int:
                    help="chaos drill: >0 starts a rolling deploy from a "
                         "side thread while the replay is in flight")
     p.add_argument("--rolling-deploy-delay-s", type=float, default=0.5)
+    p.add_argument("--kill-replica-at-request", type=int, default=0,
+                   help="chaos drill: SIGKILL the replica serving the "
+                        "N-th routed request (1-based) after its first "
+                        "streamed token — the supervisor restarts it, "
+                        "the router fails the stream over mid-SSE")
+    p.add_argument("--drop-client-at-token", type=int, default=0,
+                   help="chaos drill: >0 hangs up one client socket "
+                        "after K streamed tokens — the replica must "
+                        "cancel the request and free its pages")
+    p.add_argument("--drop-client-at-request", type=int, default=1,
+                   help="which request (1-based) the drop-client drill "
+                        "hangs up")
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive failures before a replica's "
+                        "circuit breaker opens (chaos drills pass 1 "
+                        "for deterministic fault counters)")
+    p.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                   help="seconds an open breaker cools before its "
+                        "half-open trial probe")
+    p.add_argument("--wedge-timeout-s", type=float, default=0.0,
+                   help=">0 arms the supervisor's wedged-replica "
+                        "detector at this heartbeat-freeze timeout")
     add_engine_args(p)
     args = p.parse_args(argv)
     if args.replica:

@@ -408,9 +408,43 @@ def request_payload(req: TrafficRequest, *, stream: bool = True) -> dict:
             "stream": bool(stream)}
 
 
+def _drop_after(url: str, payload: dict, k: int,
+                timeout_s: float) -> int:
+    """Chaos client: stream one request and HANG UP the socket after
+    ``k`` tokens arrive (the serve_net disconnect drill — the server
+    must cancel the in-flight request and free its pages). Returns the
+    token count actually seen before the hangup."""
+    import json as _json
+    import urllib.request
+
+    from distributed_training_tpu.serving.router import sse_events
+
+    req = urllib.request.Request(
+        url, data=_json.dumps(payload, allow_nan=False).encode(),
+        headers={"Content-Type": "application/json"})
+    got = 0
+    resp = urllib.request.urlopen(req, timeout=timeout_s)
+    try:
+        for event, data in sse_events(resp):
+            if event == "tokens":
+                got += len(data.get("tokens", ()))
+                if got >= k:
+                    break  # hang up mid-stream, done never consumed
+            elif event == "done":
+                break  # stream ended before K tokens — still a hangup
+    finally:
+        try:
+            resp.close()
+        except OSError:
+            pass
+    return got
+
+
 def replay_over_http(url: str, reqs: list[TrafficRequest], *,
                      stream: bool = True, concurrency: int = 1,
-                     timeout_s: float = 120.0) -> list[dict | None]:
+                     timeout_s: float = 120.0,
+                     drop_at: dict[int, int] | None = None,
+                     ) -> list[dict | None]:
     """Replay ``reqs`` against a front door's ``/generate``; returns
     one ``done`` payload (with ``streamed_tokens``) per request, in
     submission order — ``None`` where the request failed.
@@ -421,12 +455,22 @@ def replay_over_http(url: str, reqs: list[TrafficRequest], *,
     (the bitwise-pin mode). ``concurrency>1`` keeps that many requests
     in flight via worker threads (arrival ORDER is still the seeded
     order; completion interleaving is not) — the routing-drill mode.
+
+    ``drop_at`` maps request index -> token count K: those requests
+    are sent by the chaos client, which hangs up after K streamed
+    tokens (their result slots stay ``None`` — injected faults, for
+    the caller to account separately from real failures).
     """
     from distributed_training_tpu.serving.router import generate_over_http
 
+    drop_at = drop_at or {}
     results: list[dict | None] = [None] * len(reqs)
     if concurrency <= 1:
         for i, r in enumerate(reqs):
+            if i in drop_at:
+                _drop_after(url, request_payload(r, stream=True),
+                            drop_at[i], timeout_s)
+                continue
             results[i] = generate_over_http(
                 url, request_payload(r, stream=stream),
                 timeout_s=timeout_s)
@@ -448,9 +492,13 @@ def replay_over_http(url: str, reqs: list[TrafficRequest], *,
             except _queue.Empty:
                 return
             try:
-                results[i] = generate_over_http(
-                    url, request_payload(r, stream=stream),
-                    timeout_s=timeout_s)
+                if i in drop_at:
+                    _drop_after(url, request_payload(r, stream=True),
+                                drop_at[i], timeout_s)
+                else:
+                    results[i] = generate_over_http(
+                        url, request_payload(r, stream=stream),
+                        timeout_s=timeout_s)
             except Exception as e:  # collected, not raised: the drill
                 with err_lock:      # counts failures itself
                     errors.append((i, e))
