@@ -708,7 +708,8 @@ class Engine:
     def submit(self, prompt, max_new_tokens: int | None = None,
                arrival_t: float | None = None, priority: int = 0,
                tenant: str = "default",
-               deadline_ms: float | None = None) -> Request:
+               deadline_ms: float | None = None,
+               trace_id: str | None = None) -> Request:
         """Enqueue a request (thread-safe). ``priority`` is its SLO tier
         (0 = highest, < ``cfg.num_tiers``), ``tenant`` its fairness
         principal, ``deadline_ms`` an optional per-request total
@@ -717,10 +718,14 @@ class Engine:
         inference.sampler.CacheBudgetError` when it can never fit a
         slot's page table (or the legacy contiguous budget). With a
         journal, the admission record is durable before this returns —
-        a request the journal never saw was never accepted."""
+        a request the journal never saw was never accepted.
+        ``trace_id`` is the fleet-tracing correlation id the front door
+        propagates (None → the queue self-mints ``uid-<uid>``); it rides
+        every trace span the request emits."""
         req = self.queue.submit(prompt, max_new_tokens=max_new_tokens,
                                 arrival_t=arrival_t, priority=priority,
-                                tenant=tenant, deadline_ms=deadline_ms)
+                                tenant=tenant, deadline_ms=deadline_ms,
+                                trace_id=trace_id)
         if self.journal is not None:
             try:
                 self.journal.log_admit(req)
@@ -1062,8 +1067,8 @@ class Engine:
                 if self.trace is not None:
                     self.trace.instant(
                         "prefix_cache.hit", track=f"slot {slot}",
-                        uid=seq.request.uid, tokens=hit,
-                        pages=len(hit_pages))
+                        uid=seq.request.uid, trace=seq.request.trace_id,
+                        tokens=hit, pages=len(hit_pages))
             # graftlint: disable=hot-path-transfer -- admission-boundary key landing: slot routing is host-side numpy by design
             self._slot_rng[slot] = np.asarray(
                 jax.random.fold_in(self._base_rng, seq.request.uid))
@@ -1102,7 +1107,8 @@ class Engine:
             if self.trace is not None:
                 self.trace.instant(
                     "request.preempted", track=f"slot {seq.slot}",
-                    uid=seq.request.uid, tier=seq.request.priority,
+                    uid=seq.request.uid, trace=seq.request.trace_id,
+                    tier=seq.request.priority,
                     tokens_emitted=len(seq.tokens),
                     # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg (prompt.size/prefill_pos arithmetic, no device value)
                     recompute_tokens=int(recompute))
@@ -1360,12 +1366,14 @@ class Engine:
             # is (t_first_token - t_arrival)*1e3 — bitwise the same
             # arithmetic ServeTelemetry performs.
             self.trace.complete("queued", req.arrival_t, seq.seated_t,
-                                track=track, uid=req.uid)
+                                track=track, uid=req.uid,
+                                trace=req.trace_id)
             self.trace.complete("prefill", seq.seated_t, t, track=track,
-                                uid=req.uid,
+                                uid=req.uid, trace=req.trace_id,
                                 prompt_len=int(req.prompt.size))
             self.trace.instant("first_token", track=track, t=t,
-                               uid=req.uid, t_arrival=req.arrival_t,
+                               uid=req.uid, trace=req.trace_id,
+                               t_arrival=req.arrival_t,
                                t_first_token=t)
 
     # -- live weight hot-swap (serving/hotswap.py drives this) ---------------
@@ -1700,6 +1708,7 @@ class Engine:
                     self.trace.complete(
                         "prefill_chunk", t_step0, t,
                         track=f"slot {chunk_seq.slot}",
+                        trace=chunk_seq.request.trace_id,
                         # graftlint: disable=hot-path-transfer -- host ints for JSON trace args
                         uid=chunk_seq.request.uid, start=int(start),
                         # graftlint: disable=hot-path-transfer -- host int for a JSON trace arg
@@ -2039,16 +2048,19 @@ class Engine:
         instead."""
         if fin.slot is None:
             self.trace.instant(f"request.{fin.finish_reason}",
-                               track="queue", uid=fin.uid)
+                               track="queue", uid=fin.uid,
+                               trace=fin.trace_id)
             return
         track = f"slot {fin.slot}"
         if (fin.first_token_t is not None and fin.last_token_t is not None
                 and fin.tokens.size > 1):
             self.trace.complete("decode", fin.first_token_t,
                                 fin.last_token_t, track=track,
-                                uid=fin.uid, tokens=int(fin.tokens.size))
+                                uid=fin.uid, trace=fin.trace_id,
+                                tokens=int(fin.tokens.size))
         self.trace.instant(f"finish:{fin.finish_reason}", track=track,
                            t=fin.last_token_t, uid=fin.uid,
+                           trace=fin.trace_id,
                            tokens=int(fin.tokens.size))
 
     def run(self, max_iterations: int | None = None

@@ -102,10 +102,24 @@ class ServingFrontend:
     def __init__(self, engine, *, port: int = 0, host: str = "127.0.0.1",
                  exporter: MetricsExporter | None = None,
                  deploy_fn: Callable[[], None] | None = None,
-                 poll_s: float = 0.005):
+                 poll_s: float = 0.005,
+                 trace=None, trace_path: str | None = None):
         self._engine = engine
         self._deploy_fn = deploy_fn
         self._poll_s = float(poll_s)
+        # Fleet tracing (docs/OBSERVABILITY.md "Fleet tracing"): this
+        # replica's own TraceSession + output path. The frontend stamps
+        # the hop handshake (``hop.recv`` on the "hop" track, paired
+        # with the door's ``hop.send`` by (trace, hop) args) and
+        # CHECKPOINTS the file around delivery: once before the first
+        # byte of every stream leaves the socket, once after the
+        # terminal frame. The pre-first-byte save is the crash
+        # contract — a SIGKILL that lands mid-stream necessarily lands
+        # after some frame was relayed, so the victim's admission spans
+        # (queued/prefill/first_token) are already durable and the
+        # merged fleet timeline renders the dead incarnation's head.
+        self._trace = trace
+        self._trace_path = trace_path
         self._cond = threading.Condition()
         self._streams: dict[int, _Stream] = {}
         self._commands: list[str] = []
@@ -187,6 +201,15 @@ class ServingFrontend:
         self._engine.set_token_listener(None)
         if self._owns_exporter:
             self._exporter.close()
+        self._trace_checkpoint()
+
+    def _trace_checkpoint(self) -> None:
+        """Persist the trace file (atomic replace) when tracing is on.
+        Handler-thread disk IO by design — the journal's writer thread
+        owns hot-loop-adjacent IO, but delivery checkpoints ride the
+        handler that just wrote the socket, never Engine.step."""
+        if self._trace is not None and self._trace_path:
+            self._trace.checkpoint(self._trace_path)
 
     def url(self, path: str = "/generate") -> str:
         return f"http://{self.host}:{self.port}{path}"
@@ -326,6 +349,16 @@ class ServingFrontend:
 
     def _handle_generate(self, req: BaseHTTPRequestHandler,
                          body: dict) -> None:
+        # Fleet tracing: the door (or any client) propagates its trace
+        # id + per-request hop counter; absent headers mean a direct
+        # client and the queue self-mints uid-<uid>. Either way the id
+        # is echoed back (response header + done frame) so the caller
+        # correlates without parsing logs.
+        trace_hdr = req.headers.get("X-Graft-Trace")
+        try:
+            hop = int(req.headers.get("X-Graft-Hop", 0))
+        except ValueError:
+            hop = 0
         resume = body.get("resume")
         if resume is not None:
             try:
@@ -335,7 +368,8 @@ class ServingFrontend:
                 self._send_json(req, 400, {
                     "error": f"bad resume cursor: {e}"})
                 return
-            if self._handle_resume(req, body, uid, delivered):
+            if self._handle_resume(req, body, uid, delivered,
+                                   trace_hdr=trace_hdr, hop=hop):
                 return
             # Unknown uid here (another replica's stream, or journaled
             # state already compacted): fall through to a fresh submit
@@ -361,7 +395,8 @@ class ServingFrontend:
                     priority=int(body.get("priority",
                                           body.get("tier", 0))),
                     tenant=str(body.get("tenant", "default")),
-                    deadline_ms=body.get("deadline_ms"))
+                    deadline_ms=body.get("deadline_ms"),
+                    trace_id=trace_hdr)
                 st = self._streams[r.uid] = _Stream()
                 self._cond.notify_all()
         except (DrainingError, QueueFullError) as e:
@@ -374,14 +409,23 @@ class ServingFrontend:
             self._send_json(req, 400, {"error": str(e),
                                        "kind": type(e).__name__})
             return
+        tid = r.trace_id
+        if self._trace is not None:
+            # One side of the hop handshake: the door stamped hop.send
+            # on ITS trace with the same (trace, hop) args; the merge
+            # tool pairs the two instants to bound clock skew.
+            self._trace.instant("hop.recv", track="hop", trace=tid,
+                               hop=hop, uid=int(r.uid))
         skip = (int(resume.get("delivered", 0))
                 if resume is not None else 0)
         try:
             if stream:
                 delivered = self._stream_response(req, r.uid, st,
-                                                  skip=skip)
+                                                  skip=skip,
+                                                  trace_id=tid)
             else:
-                delivered = self._unary_response(req, r.uid, st)
+                delivered = self._unary_response(req, r.uid, st,
+                                                 trace_id=tid)
         finally:
             with self._cond:
                 self._streams.pop(r.uid, None)
@@ -403,7 +447,9 @@ class ServingFrontend:
             self.requests_failed += 1
 
     def _handle_resume(self, req: BaseHTTPRequestHandler, body: dict,
-                       uid: int, delivered: int) -> bool:
+                       uid: int, delivered: int,
+                       trace_hdr: str | None = None,
+                       hop: int = 0) -> bool:
         """Mid-stream failover resume for a uid THIS replica owns.
 
         Returns True when the resume was answered here — from the
@@ -412,7 +458,11 @@ class ServingFrontend:
         re-attaching to the still-running/recovered sequence. False →
         the uid is unknown here and the caller falls back to a fresh
         submit with the delivered head suppressed."""
-        if self._try_journal_tail(req, uid, delivered):
+        tid = trace_hdr if trace_hdr is not None else f"uid-{uid}"
+        if self._trace is not None:
+            self._trace.instant("hop.recv", track="hop", trace=tid,
+                               hop=hop, uid=int(uid), resume=True)
+        if self._try_journal_tail(req, uid, delivered, trace_id=tid):
             return True
         # Re-attach to a live sequence: stream_attach must run on the
         # serve-loop (engine) thread, so park an attach command and
@@ -430,9 +480,11 @@ class ServingFrontend:
         if not box["attached"]:
             # Lost the race with the finish sweep: the sequence may
             # have completed between the journal check and the attach.
-            return self._try_journal_tail(req, uid, delivered)
+            return self._try_journal_tail(req, uid, delivered,
+                                          trace_id=tid)
         try:
-            ok = self._stream_response(req, uid, st, skip=delivered)
+            ok = self._stream_response(req, uid, st, skip=delivered,
+                                       trace_id=tid)
         finally:
             with self._cond:
                 self._streams.pop(uid, None)
@@ -448,7 +500,8 @@ class ServingFrontend:
         return True
 
     def _try_journal_tail(self, req: BaseHTTPRequestHandler, uid: int,
-                          delivered: int) -> bool:
+                          delivered: int,
+                          trace_id: str | None = None) -> bool:
         """Serve a finished-unacked journal record's undelivered tail
         as a normal SSE stream; ack only after the last byte (the
         exactly-once cursor, unchanged). False when the journal holds
@@ -468,11 +521,19 @@ class ServingFrontend:
             "prompt_len": len(snap.prompt),
             "priority": int(snap.priority),
             "tenant": str(snap.tenant),
+            # Redelivered verbatim from the journal: the wall detail
+            # died with the process that served it, so no ledger —
+            # the door's fleet audit skips the replica-lifetime check
+            # for this request (router-side conservation still holds).
+            "trace_id": (trace_id if trace_id is not None
+                         else f"uid-{uid}"),
+            "ledger": None,
         }
         try:
             req.send_response(200)
             req.send_header("Content-Type", SSE_CONTENT_TYPE)
             req.send_header("Cache-Control", "no-store")
+            req.send_header("X-Graft-Trace", payload["trace_id"])
             req.send_header("Connection", "close")
             req.end_headers()
             tail = payload["tokens"][delivered:]
@@ -486,6 +547,7 @@ class ServingFrontend:
         journal.ack([uid])
         self.requests_served += 1
         self.requests_resumed += 1
+        self._trace_checkpoint()
         return True
 
     def _await(self, st: _Stream, sent: int) -> tuple[list[int], Any]:
@@ -502,7 +564,8 @@ class ServingFrontend:
         return batch, fin
 
     def _stream_response(self, req: BaseHTTPRequestHandler, uid: int,
-                         st: _Stream, *, skip: int = 0) -> bool:
+                         st: _Stream, *, skip: int = 0,
+                         trace_id: str | None = None) -> bool:
         """SSE delivery: one ``tokens`` event per landed batch, one
         terminal ``done`` event. ``skip`` suppresses the first N tokens
         (a failover resume: the client already holds them from the dead
@@ -513,10 +576,13 @@ class ServingFrontend:
             req.send_response(200)
             req.send_header("Content-Type", SSE_CONTENT_TYPE)
             req.send_header("Cache-Control", "no-store")
+            if trace_id is not None:
+                req.send_header("X-Graft-Trace", trace_id)
             req.send_header("Connection", "close")
             req.end_headers()
             sent = 0
             fin = None
+            checkpointed = False
             while fin is None:
                 batch, fin = self._await(st, sent)
                 if not batch and fin is None:
@@ -527,15 +593,23 @@ class ServingFrontend:
                     batch = batch[drop:]
                     skip -= drop
                 if batch:
+                    if not checkpointed:
+                        # Durable-before-first-byte (see __init__): the
+                        # admission spans for this stream are on disk
+                        # before any frame a chaos kill could key on.
+                        self._trace_checkpoint()
+                        checkpointed = True
                     req.wfile.write(_sse_event("tokens", {
                         "uid": uid, "tokens": batch}))
             req.wfile.write(_sse_event("done", _fin_payload(fin)))
-            return True
         except (BrokenPipeError, ConnectionResetError):
             return False  # client hung up: not acked, journal redelivers
+        self._trace_checkpoint()
+        return True
 
     def _unary_response(self, req: BaseHTTPRequestHandler, uid: int,
-                        st: _Stream) -> bool:
+                        st: _Stream,
+                        trace_id: str | None = None) -> bool:
         sent = 0
         while True:
             batch, fin = self._await(st, sent)
@@ -543,7 +617,12 @@ class ServingFrontend:
                 return False
             sent += len(batch)
             if fin is not None:
-                return self._send_json(req, 200, _fin_payload(fin))
+                ok = self._send_json(
+                    req, 200, _fin_payload(fin),
+                    headers=(None if trace_id is None
+                             else {"X-Graft-Trace": trace_id}))
+                self._trace_checkpoint()
+                return ok
 
     @staticmethod
     def _parse_prompt(body: dict) -> np.ndarray:
@@ -558,12 +637,15 @@ class ServingFrontend:
 
     @staticmethod
     def _send_json(req: BaseHTTPRequestHandler, code: int,
-                   payload: dict) -> bool:
+                   payload: dict,
+                   headers: dict[str, str] | None = None) -> bool:
         data = (json.dumps(payload, allow_nan=False) + "\n").encode()
         try:
             req.send_response(code)
             req.send_header("Content-Type", "application/json")
             req.send_header("Content-Length", str(len(data)))
+            for k, v in (headers or {}).items():
+                req.send_header(k, v)
             req.end_headers()
             req.wfile.write(data)
             return True
@@ -588,4 +670,15 @@ def _fin_payload(fin) -> dict:
         "prompt_len": int(fin.prompt.size),
         "priority": int(fin.priority),
         "tenant": str(fin.tenant),
+        "trace_id": fin.trace_id,
+        # Wall-clock detail for the fleet ledger audit on the router
+        # door: the replica's conserved interval list, pre-joined so the
+        # door never needs a second round trip.  None when the record
+        # was journal-redelivered (the live ledger died with the
+        # serving process) — the door skips the replica-lifetime check.
+        "ledger": (None if fin.ledger is None else {
+            "lifetime_ms": fin.ledger.lifetime_ms,
+            "causes_ms": fin.ledger.totals_ms(),
+            "conserved": not fin.ledger.violations(ttft_ms=fin.ttft_ms),
+        }),
     }

@@ -451,6 +451,10 @@ class ServeTelemetry:
         # uid tiebreak for determinism under equal stamps.
         entry = {
             "uid": int(fin.uid),
+            # Fleet-tracing correlation: an SLA outlier surfaced here is
+            # looked up by this id on the merged tools/fleet_trace.py
+            # timeline (and in the door's fleet_ledger_top).
+            "trace_id": fin.trace_id,
             "finish_reason": fin.finish_reason,
             "lifetime_ms": led.lifetime_ms,
             "ttft_ms": fin.ttft_ms,

@@ -150,7 +150,8 @@ class RequestQueue:
     def submit(self, prompt, max_new_tokens: int | None = None,
                arrival_t: float | None = None, priority: int = 0,
                tenant: str = "default",
-               deadline_ms: float | None = None) -> Request:
+               deadline_ms: float | None = None,
+               trace_id: str | None = None) -> Request:
         """Enqueue one request; returns its admission record.
 
         Raises :class:`CacheBudgetError` when the request can never fit a
@@ -162,7 +163,11 @@ class RequestQueue:
         not from when the host thread got around to the submit call.
         ``deadline_ms`` overrides the configured total deadline for this
         one request (the network front door's per-request deadline
-        field); None keeps the engine-wide default.
+        field); None keeps the engine-wide default. ``trace_id`` is the
+        distributed-tracing correlation id propagated by the front door
+        (``X-Graft-Trace``); None self-mints ``uid-<uid>`` — either way
+        the id is a pure function of the admission order, never the
+        wall clock, so two replays mint identical ids.
         """
         tokens = np.ascontiguousarray(np.asarray(prompt).reshape(-1),
                                       dtype=np.int32)
@@ -236,6 +241,8 @@ class RequestQueue:
             req = Request(
                 uid=self._next_uid, prompt=tokens, max_new_tokens=mnt,
                 arrival_t=arrival,
+                trace_id=(str(trace_id) if trace_id is not None
+                          else f"uid-{self._next_uid}"),
                 ttft_deadline_t=(arrival + self.ttft_deadline_ms / 1e3
                                  if self.ttft_deadline_ms else None),
                 deadline_t=(arrival + float(deadline_ms) / 1e3

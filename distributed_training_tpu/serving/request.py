@@ -73,6 +73,14 @@ class Request:
     deadline_t: float | None = None
     priority: int = 0         # SLO tier, 0 = highest
     tenant: str = "default"
+    # Distributed-tracing correlation id (docs/OBSERVABILITY.md "Fleet
+    # tracing"): minted by the front door (or the queue, from the uid)
+    # and carried on every trace span/instant this request emits, so
+    # tools/fleet_trace.py can stitch one request's timeline across the
+    # door and replica processes. Deterministic by construction — never
+    # derived from the wall clock — and excluded from equality (it is
+    # correlation metadata, not part of the admission record).
+    trace_id: str | None = dataclasses.field(default=None, compare=False)
     # Per-request latency ledger (serving/ledger.py): the append-only
     # (cause, start, end) interval list whose causes partition the
     # request's wall lifetime. It travels WITH the request through
@@ -302,6 +310,10 @@ class FinishedRequest:
     # wall detail belongs to the process that served them).
     ledger: "object | None" = dataclasses.field(
         default=None, compare=False, repr=False)
+    # The request's trace correlation id (see Request.trace_id): rides
+    # into the done frame and the slowest-request views so an SLA
+    # outlier can be looked up on the merged fleet timeline.
+    trace_id: str | None = dataclasses.field(default=None, compare=False)
 
     @staticmethod
     def from_active(seq: ActiveSequence, reason: str,
@@ -335,6 +347,7 @@ class FinishedRequest:
             priority=seq.request.priority,
             tenant=seq.request.tenant,
             ledger=seq.request.ledger,
+            trace_id=seq.request.trace_id,
         )
 
     @staticmethod
@@ -354,6 +367,7 @@ class FinishedRequest:
             priority=req.priority,
             tenant=req.tenant,
             ledger=req.ledger,
+            trace_id=req.trace_id,
         )
 
     @staticmethod

@@ -48,11 +48,31 @@ from distributed_training_tpu.serving.httpbody import (
     NoBodyLength,
     read_body,
 )
+from distributed_training_tpu.serving.ledger import (
+    CAUSE_FAILOVER_RESUME,
+    CAUSE_RELAY,
+    CAUSE_RETRY_BACKOFF,
+    CAUSE_ROUTE,
+    FLEET_CAUSES,
+    FLEET_SKEW_SLACK_MS,
+    LatencyLedger,
+)
 
 # Phases a request must never be routed to: admission is closed (or
 # not open yet). "overloaded" stays routable — shedding is the
 # replica's own tier-aware decision.
 UNROUTABLE_PHASES = {"draining", "drained", "recovering"}
+
+# Numeric encoding of the per-replica breaker state for the Prometheus
+# gauge (text expositions carry numbers; the JSON snapshots keep the
+# string). Ordered healthy → tripped so an alert threshold reads
+# naturally (``state >= 2`` == open).
+BREAKER_STATE_CODES = {"closed": 0, "half_open": 1, "open": 2}
+
+# Cap on the door's slowest-proxied-requests view (``fleet_ledger_top``
+# in ``fleet_snapshot``) — the fleet twin of the replica telemetry's
+# ledger_top.
+FLEET_TOP_N = 8
 
 
 class HttpReplica:
@@ -79,12 +99,18 @@ class HttpReplica:
         fallback signal + phase (Engine.probe_snapshot over HTTP)."""
         return self._post("/probe", {"prompt": prompt})
 
-    def generate_raw(self, body: bytes):
+    def generate_raw(self, body: bytes,
+                     headers: dict[str, str] | None = None):
         """Open a streaming /generate against this replica; returns the
-        live HTTPResponse (SSE bytes relay through unparsed)."""
+        live HTTPResponse (SSE bytes relay through unparsed). ``headers``
+        adds request headers on top of the JSON content type — the door
+        injects ``X-Graft-Trace``/``X-Graft-Hop`` here so the replica's
+        spans carry the fleet trace id."""
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         req = urllib.request.Request(
-            self.url + "/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            self.url + "/generate", data=body, headers=hdrs)
         return urllib.request.urlopen(req, timeout=self.timeout_s)
 
     def admin(self, cmd: str) -> dict:
@@ -92,6 +118,20 @@ class HttpReplica:
 
     def healthz(self) -> dict:
         with urllib.request.urlopen(self.url + "/healthz",
+                                    timeout=self.timeout_s) as resp:
+            return json.loads(resp.read())
+
+    # Read-only scrape helpers (the /fleet/* fan-out): plain GETs, no
+    # admin verb, no POST — a federated scrape can never perturb the
+    # replica it reads (the graftlint scrape-safety rule additionally
+    # pins that a scrape error never trips the breaker).
+    def scrape_text(self, path: str) -> str:
+        with urllib.request.urlopen(self.url + path,
+                                    timeout=self.timeout_s) as resp:
+            return resp.read().decode("utf-8", "replace")
+
+    def scrape_json(self, path: str) -> dict:
+        with urllib.request.urlopen(self.url + path,
                                     timeout=self.timeout_s) as resp:
             return json.loads(resp.read())
 
@@ -106,7 +146,8 @@ class Router:
 
     def __init__(self, replicas: list, *, policy: str = "prefix",
                  breaker_threshold: int = 3,
-                 breaker_cooldown_s: float = 5.0):
+                 breaker_cooldown_s: float = 5.0,
+                 trace=None):
         if not replicas:
             raise ValueError("router needs at least one replica")
         if policy not in ("prefix", "round_robin"):
@@ -116,6 +157,12 @@ class Router:
             raise ValueError("breaker_threshold must be >= 1")
         self.replicas = list(replicas)
         self.policy = policy
+        # Optional TraceSession (observability/trace.py): breaker-skip
+        # decisions land as instants on the router pid's trace so a
+        # failover request's merged timeline shows WHY the dead replica
+        # was never re-probed. None (the default) keeps every route
+        # pass span-free.
+        self.trace = trace
         self._lock = threading.Lock()
         self._in_rotation = [True] * len(self.replicas)
         self._rr_next = 0
@@ -198,30 +245,42 @@ class Router:
         with self._lock:
             self.failover_resumes += 1
 
-    def _brk_admit(self, candidates: list[int]) -> tuple[list[int],
+    def _brk_admit(self, candidates: list[int],
+                   trace_id: str | None = None) -> tuple[list[int],
                                                          set[int]]:
         """Breaker gate for one route pass: open replicas whose
         cooldown has not elapsed are dropped WITHOUT a probe; expired
         ones transition to half_open and are admitted as trials (the
-        caller orders them last). Returns (admitted, half_open set)."""
+        caller orders them last). Returns (admitted, half_open set).
+        Skipped replicas land as ``breaker_skip`` instants on the
+        router trace (when tracing) so the merged fleet timeline shows
+        the probe-free drop."""
         now = time.monotonic()
         admitted: list[int] = []
         trials: set[int] = set()
+        skipped: list[int] = []
         with self._lock:
             for i in candidates:
                 state = self._brk_state[i]
                 if state == "open":
                     if now - self._brk_opened_t[i] < \
                             self.breaker_cooldown_s:
+                        skipped.append(i)
                         continue
                     self._brk_state[i] = state = "half_open"
                 if state == "half_open":
                     trials.add(i)
                 admitted.append(i)
+        if self.trace is not None:
+            for i in skipped:
+                self.trace.instant("breaker_skip", track="breaker_skip",
+                                   trace=trace_id,
+                                   replica=self.replicas[i].name)
         return admitted, trials
 
     # -- policy --------------------------------------------------------------
-    def route(self, prompt: list[int] | None) -> list[tuple[int, bool]]:
+    def route(self, prompt: list[int] | None,
+              trace_id: str | None = None) -> list[tuple[int, bool]]:
         """``(replica_index, by_prefix)`` pairs to try, best first —
         ``by_prefix`` marks candidates whose trie holds part of the
         prompt (so the winner's counter attribution is decided here,
@@ -229,8 +288,10 @@ class Router:
         breaker admits it (open → skipped probe-free; half-open →
         probed, ordered last as the single trial); unreachable or
         unroutable (draining/recovering) ones are skipped.
-        Deterministic: ties break to the lowest index."""
-        candidates, trials = self._brk_admit(self.in_rotation())
+        Deterministic: ties break to the lowest index. ``trace_id``
+        tags the breaker-skip instants when the router is tracing."""
+        candidates, trials = self._brk_admit(self.in_rotation(),
+                                             trace_id=trace_id)
         if self.policy == "round_robin":
             if not candidates:
                 return []
@@ -385,22 +446,69 @@ class RouterFrontDoor:
       falls through to the next candidate, so a drain race never fails
       a request. 502 only when every replica refused.
     - ``GET /router/stats`` — :meth:`Router.router_snapshot` JSON.
-    - ``GET /metrics`` — the router counters in Prometheus text.
+    - ``GET /metrics`` — the router counters in Prometheus text (plus
+      the per-replica breaker gauges and the fleet-ledger counters).
     - ``GET /healthz`` — aggregate: front-door status + each replica's
       /healthz under its name.
+    - ``GET /fleet/metrics`` — federated scrape: the door's own
+      families + supervisor gauges + every reachable replica's
+      ``/metrics`` exposition relabeled with ``replica="<name>"``.
+      Breaker-open or unreachable replicas are NOT probed/blocked on —
+      they surface as ``fleet_replica_stale{replica=...} 1``.
+    - ``GET /fleet/vars`` — the JSON twin: door + supervisor snapshots
+      + each replica's ``/vars`` (``{"stale": true}`` when skipped).
+    - ``GET /fleet/replicas`` — one row per replica: rotation, breaker
+      state, routing counters, supervisor restart counts.
     - ``POST /admin/rolling_deploy`` — start a background rolling
       deploy; poll ``/router/stats`` (``router_deploys_completed``)
       for completion.
+
+    Every proxied request carries a fleet trace id (client-supplied
+    ``X-Graft-Trace`` or minted ``req-<seq>`` from the door's own
+    deterministic request sequence — NEVER wall clock), propagated to
+    the replica as a request header, echoed back to the client as a
+    response header, and stamped on the door's ``route``/``relay``/
+    ``retry_backoff``/``failover_resume`` spans so
+    ``tools/fleet_trace.py`` can merge the per-process files into one
+    timeline. The door also keeps its own conserved
+    :class:`~distributed_training_tpu.serving.ledger.LatencyLedger`
+    per request and joins the replica's ledger from the ``done`` frame
+    — the cross-hop conservation audit behind the
+    ``fleet_ledger_*`` counters (zero-tolerance CI gate).
     """
 
     def __init__(self, router: Router, *, port: int = 0,
                  host: str = "127.0.0.1",
                  route_wait_s: float = 10.0,
                  failover_wait_s: float = 60.0,
-                 chaos_hook=None):
+                 chaos_hook=None, trace=None,
+                 trace_path: str | None = None,
+                 supervisor_snapshot=None):
         self.router = router
         self._route_wait_s = float(route_wait_s)
         self._failover_wait_s = float(failover_wait_s)
+        # Fleet tracing: one TraceSession for the door process
+        # (observability/trace.fleet_session). The router shares it
+        # unless it was given its own — one wiring point for the CLIs.
+        self._trace = trace
+        self._trace_path = trace_path
+        if trace is not None and router.trace is None:
+            router.trace = trace
+        # ``supervisor_snapshot``: zero-arg callable returning the
+        # ReplicaSupervisor counter view, merged into /fleet/* when the
+        # deployment runs under supervision (serve_net wires it).
+        self._supervisor_snapshot = supervisor_snapshot
+        # Fleet ledger accounting (see _fleet_account): conserved
+        # router-side intervals per proxied request, joined with the
+        # replica ledger from the done frame and audited zero-tolerance.
+        self._fleet_lock = threading.Lock()
+        self.fleet_ledger_requests = 0
+        self.fleet_ledger_conservation_violations = 0
+        self.fleet_ledger_violation_last = ""
+        self.fleet_replica_ledger_joined = 0
+        self.fleet_replica_ledger_absent = 0
+        self._fleet_cause_ms = {c: 0.0 for c in FLEET_CAUSES}
+        self._fleet_top: list[dict] = []
         # Chaos injection (tools/serve_net.py drills):
         # ``chaos_hook(request_seq, tokens_relayed, replica_index)``
         # fires after every relayed frame — the kill-replica-at-
@@ -450,6 +558,15 @@ class RouterFrontDoor:
             self._server.shutdown()
             self._thread.join(timeout=5.0)
         self._server.server_close()
+        self._trace_checkpoint()
+
+    def _trace_checkpoint(self) -> None:
+        """Persist the door trace (atomic replace). The door is never a
+        chaos target, so — unlike the replica frontend's per-stream
+        checkpoints — one save at stop() suffices; the CLIs save again
+        at exit for belt-and-braces."""
+        if self._trace is not None and self._trace_path:
+            self._trace.checkpoint(self._trace_path)
 
     def url(self, path: str = "/generate") -> str:
         return f"http://{self.host}:{self.port}{path}"
@@ -462,20 +579,20 @@ class RouterFrontDoor:
             self._send(req, 200, "application/json",
                        json.dumps(snap, allow_nan=False) + "\n")
         elif path == "/metrics":
-            lines = []
-            for k, v in snap.items():
-                if isinstance(v, (int, float)) and not isinstance(v, bool):
-                    lines.append(f"# TYPE {k} counter")
-                    lines.append(f"{k} {v}")
-            for r in snap["replicas"]:
-                tag = f'{{replica="{r["name"]}"}}'
-                lines.append(
-                    f"router_replica_requests_routed{tag} "
-                    f"{r['requests_routed']}")
-                lines.append(f"router_replica_probe_errors{tag} "
-                             f"{r['probe_errors']}")
             self._send(req, 200, "text/plain; version=0.0.4; "
-                       "charset=utf-8", "\n".join(lines) + "\n")
+                       "charset=utf-8",
+                       "\n".join(self._metrics_lines(snap)) + "\n")
+        elif path == "/fleet/metrics":
+            self._send(req, 200, "text/plain; version=0.0.4; "
+                       "charset=utf-8", self._fleet_metrics_text(snap))
+        elif path == "/fleet/vars":
+            self._send(req, 200, "application/json",
+                       json.dumps(self._fleet_vars(snap),
+                                  allow_nan=False) + "\n")
+        elif path == "/fleet/replicas":
+            self._send(req, 200, "application/json",
+                       json.dumps(self._fleet_replicas(snap),
+                                  allow_nan=False) + "\n")
         elif path == "/healthz":
             payload = {"status": "ok", "policy": self.router.policy,
                        "replicas": {}}
@@ -492,8 +609,162 @@ class RouterFrontDoor:
             self._send(req, 404, "application/json", json.dumps(
                 {"error": "not found",
                  "endpoints": ["/generate", "/router/stats", "/metrics",
-                               "/healthz",
+                               "/healthz", "/fleet/metrics",
+                               "/fleet/vars", "/fleet/replicas",
                                "/admin/rolling_deploy"]}) + "\n")
+
+    # -- federated telemetry plane -------------------------------------------
+    def fleet_snapshot(self) -> dict[str, Any]:
+        """Read-only fleet-ledger counter view (host ints/floats under
+        one lock) — the door's half of the /fleet/* surface and the
+        serve_net SLA-row merge. A snapshot PROVIDER under the
+        graftlint scrape-safety rule: it must never trip a breaker,
+        kill a replica, or drive an engine."""
+        with self._fleet_lock:
+            return {
+                "fleet_ledger_requests": self.fleet_ledger_requests,
+                "fleet_ledger_conservation_violations":
+                    self.fleet_ledger_conservation_violations,
+                "fleet_ledger_violation_last":
+                    self.fleet_ledger_violation_last,
+                "fleet_replica_ledger_joined":
+                    self.fleet_replica_ledger_joined,
+                "fleet_replica_ledger_absent":
+                    self.fleet_replica_ledger_absent,
+                "fleet_cause_ms": dict(self._fleet_cause_ms),
+                "fleet_ledger_top": [dict(e) for e in self._fleet_top],
+            }
+
+    def _metrics_lines(self, snap: dict) -> list[str]:
+        """The door's own /metrics families: router counters, per-
+        replica routing + breaker gauges, fleet-ledger counters."""
+        lines: list[str] = []
+        for k, v in snap.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f"# TYPE {k} counter")
+                lines.append(f"{k} {v}")
+        lines.append("# TYPE router_replica_requests_routed counter")
+        lines.append("# TYPE router_replica_probe_errors counter")
+        lines.append("# TYPE router_replica_breaker_state gauge")
+        lines.append("# TYPE router_replica_breaker_opens counter")
+        for r in snap["replicas"]:
+            tag = f'{{replica="{r["name"]}"}}'
+            lines.append(
+                f"router_replica_requests_routed{tag} "
+                f"{r['requests_routed']}")
+            lines.append(f"router_replica_probe_errors{tag} "
+                         f"{r['probe_errors']}")
+            lines.append(
+                f"router_replica_breaker_state{tag} "
+                f"{BREAKER_STATE_CODES.get(r['breaker_state'], -1)}")
+            lines.append(f"router_replica_breaker_opens{tag} "
+                         f"{r['breaker_opens']}")
+        fleet = self.fleet_snapshot()
+        for k, v in fleet.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                lines.append(f"# TYPE {k} counter")
+                lines.append(f"{k} {v}")
+        lines.append("# TYPE fleet_ledger_cause_ms_total counter")
+        for cause, ms in sorted(fleet["fleet_cause_ms"].items()):
+            lines.append(
+                f'fleet_ledger_cause_ms_total{{cause="{cause}"}} {ms:g}')
+        return lines
+
+    def _fleet_scrape(self, path: str) -> dict[str, Any]:
+        """Fan one read-only GET out to every replica. Breaker-open
+        replicas are NOT contacted — a federated scrape must never
+        block on (or re-probe) a replica the proxy path already
+        declared dead; they come back as ``{"stale": True}``, the
+        deterministic staleness marker. Scrape errors also mark stale —
+        and deliberately do NOT call ``note_replica_failure``: a scrape
+        observes the fleet, it never trips a breaker (lint-enforced
+        from the do_GET roots)."""
+        out: dict[str, Any] = {}
+        for i, rep in enumerate(self.router.replicas):
+            if self.router.breaker_state(i) == "open":
+                out[rep.name] = {"stale": True, "reason": "breaker_open"}
+                continue
+            try:
+                out[rep.name] = {"stale": False,
+                                 "body": rep.scrape_text(path)}
+            except (urllib.error.URLError, OSError, ValueError) as e:
+                out[rep.name] = {"stale": True,
+                                 "reason": f"unreachable: {e}"}
+        return out
+
+    def _fleet_metrics_text(self, snap: dict) -> str:
+        """The federated exposition: door families + supervisor gauges
+        + every reachable replica's /metrics relabeled with
+        ``replica="<name>"`` (TYPE/HELP once per family), + the
+        per-replica staleness marker."""
+        from distributed_training_tpu.observability.prometheus import (
+            merge_labeled_expositions,
+        )
+
+        lines = self._metrics_lines(snap)
+        sup = (self._supervisor_snapshot()
+               if self._supervisor_snapshot is not None else None)
+        if sup:
+            for k in ("replica_restarts", "deaths_detected",
+                      "wedged_kills", "kills_injected"):
+                if k in sup:
+                    lines.append(f"# TYPE supervisor_{k} counter")
+                    lines.append(f"supervisor_{k} {sup[k]}")
+        scraped = self._fleet_scrape("/metrics")
+        lines.append("# TYPE fleet_replica_stale gauge")
+        for name in sorted(scraped):
+            stale = 1 if scraped[name]["stale"] else 0
+            lines.append(f'fleet_replica_stale{{replica="{name}"}} '
+                         f"{stale}")
+        lines.extend(merge_labeled_expositions(
+            [(f'replica="{name}"', entry["body"])
+             for name, entry in sorted(scraped.items())
+             if not entry["stale"]]))
+        return "\n".join(lines) + "\n"
+
+    def _fleet_vars(self, snap: dict) -> dict[str, Any]:
+        """The JSON twin of /fleet/metrics: one document holding the
+        door's router + fleet-ledger snapshots, the supervisor counter
+        view, and each replica's /vars (stale marker when skipped)."""
+        replicas: dict[str, Any] = {}
+        for name, entry in self._fleet_scrape("/vars").items():
+            if entry["stale"]:
+                replicas[name] = {"stale": True,
+                                  "reason": entry["reason"]}
+            else:
+                try:
+                    replicas[name] = json.loads(entry["body"])
+                except ValueError:
+                    replicas[name] = {"stale": True,
+                                      "reason": "unparseable /vars"}
+        return {
+            "router": snap,
+            "fleet": self.fleet_snapshot(),
+            "supervisor": (self._supervisor_snapshot()
+                           if self._supervisor_snapshot is not None
+                           else None),
+            "replicas": replicas,
+        }
+
+    def _fleet_replicas(self, snap: dict) -> dict[str, Any]:
+        """One row per replica: the router's rotation/breaker/routing
+        view joined with the supervisor's restart accounting."""
+        sup = (self._supervisor_snapshot()
+               if self._supervisor_snapshot is not None else None)
+        rows = []
+        for i, r in enumerate(snap["replicas"]):
+            row = dict(r)
+            row["breaker_state_code"] = BREAKER_STATE_CODES.get(
+                r["breaker_state"], -1)
+            if sup is not None:
+                restarts = sup.get("restarts_by_replica", [])
+                gave_up = sup.get("gave_up", [])
+                row["restarts"] = (restarts[i]
+                                   if i < len(restarts) else None)
+                row["gave_up"] = (gave_up[i]
+                                  if i < len(gave_up) else None)
+            rows.append(row)
+        return {"replicas": rows}
 
     def _handle_post(self, req: BaseHTTPRequestHandler) -> None:
         path = req.path.split("?", 1)[0]
@@ -543,21 +814,48 @@ class RouterFrontDoor:
         briefly before giving up. A relay that dies MID-STREAM (the
         replica was SIGKILLed under it) re-issues against the next
         healthy replica with a resume cursor — the client keeps one
-        socket and one seamless stream."""
+        socket and one seamless stream.
+
+        Fleet observability rides the same loop: the request's trace
+        id (client ``X-Graft-Trace`` or the minted ``req-<seq>`` —
+        deterministic, the door's own request sequence, never wall
+        clock) tags every door span and travels to each replica as a
+        request header, with a monotonically increasing ``X-Graft-Hop``
+        so the merge tool pairs each door-side ``hop.send`` with the
+        replica-side ``hop.recv``. In parallel the door stamps its own
+        conserved :class:`LatencyLedger` — ``route``, ``relay``
+        (which CONTAINS the replica's lifetime), ``retry_backoff``,
+        ``failover_resume`` — audited cross-hop in _fleet_account."""
         with self._seq_lock:
             self._gen_seq += 1
             seq = self._gen_seq
+        client_trace = req.headers.get("X-Graft-Trace")
+        tid = client_trace if client_trace else f"req-{seq:06d}"
         # Mutable relay state, shared across failover attempts: the
         # client headers go out once, the delivered-token cursor and
-        # upstream uid survive a dead upstream.
+        # upstream uid survive a dead upstream. ``trace`` rides along
+        # so _relay can echo the id on the client response headers and
+        # capture the replica ledger off the terminal done frame.
         state = {"seq": seq, "uid": None, "delivered": 0,
                  "headers_sent": False, "done": False,
-                 "client_gone": False}
-        t0 = time.monotonic()
+                 "client_gone": False, "trace": tid, "ledger": None}
+        t0 = time.perf_counter()
+        led = LatencyLedger(t0)
+        trace = self._trace
         attempt = 0
+        hops = 0
         resumed = False
         while True:
-            order = self.router.route(prompt)
+            r0 = time.perf_counter()
+            order = self.router.route(prompt, trace_id=tid)
+            r1 = time.perf_counter()
+            # Post-death route passes bill to failover_resume — the
+            # tail the dead replica's SIGKILL added to this request.
+            cause = CAUSE_FAILOVER_RESUME if resumed else CAUSE_ROUTE
+            led.stamp(cause, r1)
+            if trace is not None:
+                trace.complete(cause, r0, r1, track=cause, trace=tid,
+                               seq=seq, candidates=len(order))
             for idx, by_prefix in order:
                 rep = self.router.replicas[idx]
                 send_raw = raw
@@ -568,8 +866,19 @@ class RouterFrontDoor:
                         "delivered": state["delivered"]}
                     send_raw = json.dumps(
                         resume_body, allow_nan=False).encode()
+                hops += 1
+                h0 = time.perf_counter()
+                if trace is not None:
+                    # One half of the hop handshake: the replica stamps
+                    # the matching ``hop.recv`` with the SAME
+                    # (trace, hop) args — tools/fleet_trace.py pairs
+                    # them to bound cross-file clock offsets.
+                    trace.instant("hop.send", track="relay", t=h0,
+                                  trace=tid, hop=hops, replica=rep.name,
+                                  resume=resumed)
                 try:
-                    resp = rep.generate_raw(send_raw)
+                    resp = rep.generate_raw(send_raw, headers={
+                        "X-Graft-Trace": tid, "X-Graft-Hop": str(hops)})
                 except urllib.error.HTTPError as e:
                     if e.code in (503, 429):
                         attempt += 1
@@ -591,10 +900,24 @@ class RouterFrontDoor:
                                         retried=attempt > 0)
                 state["replica"] = idx
                 upstream_died = self._relay(req, resp, state)
+                rel1 = time.perf_counter()
+                # The relay span opens at h0 (the connect): the replica
+                # admits the request while generate_raw blocks on the
+                # response headers, so "relay CONTAINS the replica's
+                # lifetime" holds and the cross-hop slack check in
+                # _fleet_account is one-sided.
+                led.stamp(CAUSE_RELAY, rel1)
+                if trace is not None:
+                    trace.complete("relay", h0, rel1, track="relay",
+                                   trace=tid, hop=hops,
+                                   replica=rep.name,
+                                   died=bool(upstream_died))
                 if state["client_gone"]:
                     return  # the replica's cancel/ack gate handles it
                 if not upstream_died:
                     self.router.note_replica_success(idx)
+                    led.seal(CAUSE_RELAY)
+                    self._fleet_account(led, state)
                     return
                 # Upstream died mid-stream: penalize its breaker and
                 # re-issue with the resume cursor. The route pass is
@@ -604,17 +927,84 @@ class RouterFrontDoor:
                 if not resumed:
                     resumed = True
                     self.router.note_failover_resume()
+                    if trace is not None:
+                        trace.instant("failover_resume",
+                                      track="failover_resume",
+                                      trace=tid, replica=rep.name,
+                                      delivered=state["delivered"])
                 break  # back to the outer loop for a fresh route
             wait = (self._failover_wait_s if resumed
                     else self._route_wait_s)
-            if time.monotonic() - t0 > wait:
+            if time.perf_counter() - t0 > wait:
                 self.proxy_errors += 1
                 if not state["headers_sent"]:
                     self._send(req, 502, "application/json", json.dumps(
                         {"error": "no replica accepted the request"})
                         + "\n")
                 return
+            b0 = time.perf_counter()
             time.sleep(0.02)
+            b1 = time.perf_counter()
+            led.stamp(CAUSE_RETRY_BACKOFF, b1)
+            if trace is not None:
+                trace.complete("retry_backoff", b0, b1,
+                               track="retry", trace=tid, seq=seq)
+
+    def _fleet_account(self, led: LatencyLedger, state: dict) -> None:
+        """The cross-hop conservation audit, run once per COMPLETED
+        proxied request: the door's own intervals must tile the client
+        wall time exactly (LatencyLedger.violations — EPSILON-exact by
+        the telescoping-cursor construction), and the replica ledger
+        joined from the done frame must fit inside the relay span(s)
+        up to FLEET_SKEW_SLACK_MS (both are perf_counter DURATIONS on
+        one host; the slack covers scheduling between the door's
+        connect and the replica's admission stamp). Requests
+        redelivered verbatim from a journal carry ``ledger: null`` —
+        the replica-side check is skipped, total conservation still
+        applies. Zero-tolerance: any violation bumps the CI-gated
+        counter."""
+        problems = led.violations()
+        rep_led = state.get("ledger")
+        if isinstance(rep_led, dict):
+            relay_ms = led.total_s(CAUSE_RELAY) * 1e3
+            rep_ms = float(rep_led.get("lifetime_ms", 0.0))
+            if rep_ms > relay_ms + FLEET_SKEW_SLACK_MS:
+                problems.append(
+                    f"replica lifetime {rep_ms:.3f}ms exceeds relay "
+                    f"total {relay_ms:.3f}ms + "
+                    f"{FLEET_SKEW_SLACK_MS:.0f}ms slack")
+            if not rep_led.get("conserved", True):
+                problems.append("replica-side ledger not conserved")
+        totals = led.totals_ms()
+        entry = {
+            "trace_id": state["trace"], "seq": state["seq"],
+            "uid": state["uid"], "lifetime_ms": led.lifetime_ms,
+            "causes_ms": totals,
+            "replica_lifetime_ms": (rep_led.get("lifetime_ms")
+                                    if isinstance(rep_led, dict)
+                                    else None),
+            "conserved": not problems,
+        }
+        with self._fleet_lock:
+            self.fleet_ledger_requests += 1
+            if isinstance(rep_led, dict):
+                self.fleet_replica_ledger_joined += 1
+            else:
+                self.fleet_replica_ledger_absent += 1
+            if problems:
+                self.fleet_ledger_conservation_violations += 1
+                self.fleet_ledger_violation_last = problems[0]
+            for cause, ms in totals.items():
+                self._fleet_cause_ms[cause] = \
+                    self._fleet_cause_ms.get(cause, 0.0) + ms
+            self._fleet_top.append(entry)
+            self._fleet_top.sort(
+                key=lambda e: (-e["lifetime_ms"], str(e["trace_id"])))
+            del self._fleet_top[FLEET_TOP_N:]
+        if self._trace is not None:
+            self._trace.instant("fleet.audit", track="route",
+                                trace=state["trace"],
+                                conserved=not problems)
 
     def _relay(self, req: BaseHTTPRequestHandler, resp,
                state: dict) -> bool:
@@ -635,6 +1025,12 @@ class RouterFrontDoor:
                 if not state["headers_sent"]:
                     req.send_response(resp.status)
                     req.send_header("Content-Type", ctype)
+                    if state.get("trace") is not None:
+                        # The fleet trace id the door minted (or passed
+                        # through), echoed so the client can join its
+                        # own logs to the merged timeline.
+                        req.send_header("X-Graft-Trace",
+                                        str(state["trace"]))
                     clen = resp.headers.get("Content-Length")
                     if clen is not None and not streaming:
                         req.send_header("Content-Length", clen)
@@ -683,6 +1079,11 @@ class RouterFrontDoor:
                         if state["uid"] is None:
                             state["uid"] = payload.get("uid")
                         state["done"] = True
+                        # The replica's conserved interval detail rides
+                        # the terminal frame (null when the result was
+                        # journal-redelivered) — _fleet_account joins
+                        # it with the door's own ledger.
+                        state["ledger"] = payload.get("ledger")
                     try:
                         req.wfile.write(frame)
                     except (BrokenPipeError, ConnectionResetError):
@@ -749,17 +1150,25 @@ def sse_events(resp):
 
 
 def generate_over_http(url: str, payload: dict, *,
-                       timeout_s: float = 60.0) -> dict:
+                       timeout_s: float = 60.0,
+                       trace_id: str | None = None) -> dict:
     """One streamed /generate round-trip: POST, consume the SSE stream,
     return the terminal ``done`` payload with the streamed-token
     concatenation under ``streamed_tokens`` (the bitwise pin compares
-    both against the batch engine's output)."""
+    both against the batch engine's output). ``trace_id`` rides out as
+    ``X-Graft-Trace``; whatever the server echoed back on its response
+    header comes back under ``trace_header`` — the client half of the
+    trace round-trip check (tools/traffic.py client mode)."""
+    headers = {"Content-Type": "application/json"}
+    if trace_id is not None:
+        headers["X-Graft-Trace"] = trace_id
     req = urllib.request.Request(
         url, data=json.dumps(payload, allow_nan=False).encode(),
-        headers={"Content-Type": "application/json"})
+        headers=headers)
     streamed: list[int] = []
     done: dict | None = None
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        trace_header = resp.headers.get("X-Graft-Trace")
         ctype = resp.headers.get("Content-Type", "")
         if not ctype.startswith("text/event-stream"):
             done = json.loads(resp.read())
@@ -773,4 +1182,5 @@ def generate_over_http(url: str, payload: dict, *,
         raise RuntimeError(f"stream from {url} ended without a "
                            f"'done' event")
     done["streamed_tokens"] = streamed
+    done["trace_header"] = trace_header
     return done

@@ -72,9 +72,16 @@ class ReplicaSupervisor:
                  backoff_base_s: float = 0.25,
                  backoff_max_s: float = 5.0,
                  wedge_timeout_s: float | None = None,
-                 on_restart: Callable[[int, Any], None] | None = None):
+                 on_restart: Callable[[int, Any], None] | None = None,
+                 trace=None):
         if count < 1:
             raise ValueError("supervisor needs at least one replica")
+        # Optional TraceSession: death/wedge detections and restart
+        # completions land as instants on the supervisor's own lane of
+        # the door-process trace, so the merged fleet timeline shows
+        # the supervision cause between a victim's last span and its
+        # successor's first. None (default) keeps the monitor span-free.
+        self.trace = trace
         self._spawn_fn = spawn_fn
         self._count = int(count)
         self._probe_interval_s = float(probe_interval_s)
@@ -165,6 +172,11 @@ class ReplicaSupervisor:
                 if self.handles[i].proc.poll() is not None:
                     with self._lock:
                         self.deaths_detected += 1
+                    if self.trace is not None:
+                        self.trace.instant(
+                            "replica.death", track="supervisor",
+                            replica=i,
+                            pid=int(self.handles[i].proc.pid))
                     self._restart(i)
                     continue
                 self._probe(i)
@@ -201,6 +213,10 @@ class ReplicaSupervisor:
             # (a wedged serve loop won't run atexit anyway) + restart.
             with self._lock:
                 self.wedged_kills += 1
+            if self.trace is not None:
+                self.trace.instant("replica.wedged", track="supervisor",
+                                   replica=i, pid=int(h.proc.pid),
+                                   frozen_beat=beat)
             h.proc.kill()
             h.proc.wait()
             self._restart(i)
@@ -227,6 +243,10 @@ class ReplicaSupervisor:
         with self._lock:
             self.restarts_by_replica[i] += 1
             self.replica_restarts += 1
+        if self.trace is not None:
+            self.trace.instant("replica.restarted", track="supervisor",
+                               replica=i, pid=int(handle.proc.pid),
+                               restarts=self.restarts_by_replica[i])
         self._probe_failures[i] = 0
         self._beat[i] = -1
         self._beat_t[i] = time.monotonic()

@@ -1,0 +1,482 @@
+"""Fleet-wide distributed tracing + federated telemetry plane.
+
+Three layers, no jax compute and no subprocesses (the full drill —
+serve_net with ``--trace-dir`` and a mid-stream SIGKILL — is the CI
+"Fleet trace drill" and the slow leg in tests/test_router.py):
+
+- **tools/fleet_trace.py on synthetic files** — wall-origin rebase,
+  pid-collision remap, hop-handshake clock refinement, the slack and
+  failover checks, merged-output validity (``load_trace`` round-trip)
+  and bitwise determinism across two identical runs.
+- **merge_labeled_expositions** — the /fleet/metrics relabeling: one
+  TYPE header per family, every sample tagged ``replica="..."``,
+  histogram suffixes grouped under their parent family.
+- **RouterFrontDoor federated plane over scripted HTTP replicas** —
+  trace-id mint/propagation/echo (header + done frame), the door's
+  conserved fleet ledger joined with the replica ledger off the
+  terminal frame, /fleet/metrics//fleet/vars//fleet/replicas fan-out,
+  and the breaker-open → deterministic ``stale`` marker contract
+  (an open replica is never even contacted by a scrape).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_training_tpu.observability.prometheus import (
+    merge_labeled_expositions,
+)
+from distributed_training_tpu.observability.trace import (
+    TraceSession,
+    load_trace,
+)
+from distributed_training_tpu.serving.ledger import (
+    CAUSE_RELAY,
+    CAUSE_ROUTE,
+    LatencyLedger,
+)
+from distributed_training_tpu.serving.router import (
+    HttpReplica,
+    Router,
+    RouterFrontDoor,
+    generate_over_http,
+)
+from tools import fleet_trace
+
+
+# -- synthetic trace files ----------------------------------------------------
+def _span(name, ts, dur, pid, tid=1, **args):
+    return {"name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+            "pid": pid, "tid": tid, "args": args}
+
+
+def _instant(name, ts, pid, tid=1, **args):
+    return {"name": name, "ph": "i", "s": "t", "ts": float(ts),
+            "pid": pid, "tid": tid, "args": args}
+
+
+def _write_trace(path, *, pid, pname, origin, events):
+    meta = {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "ts": 0.0, "args": {"name": pname}}
+    obj = {"traceEvents": [meta] + events, "displayTimeUnit": "ms",
+           "otherData": {"format": "chrome-trace-events",
+                         "wall_time_origin": origin,
+                         "dropped_events": 0}}
+    path.write_text(json.dumps(obj))
+    return str(path)
+
+
+def _failover_fleet(tmp_path, *, r1_origin=1000.25, r1_recv_ts=10_000.0):
+    """A coherent 3-process failover: the door relays hop 1 to
+    replica-r0 (killed mid-stream), then hop 2 of the SAME trace id to
+    replica-r1, which came up 250 ms later. All timestamps are
+    microseconds relative to each file's own origin; wall-consistent
+    by construction (hop 2's recv lands ~10 ms into r1's life =
+    wall 1000.26, right after the door's send at wall 1000.255)."""
+    tid = "req-000003"
+    door = _write_trace(
+        tmp_path / "door_pid100_trace.json", pid=100, pname="door",
+        origin=1000.0, events=[
+            _span("route", 500, 300, 100, trace=tid, seq=3),
+            _instant("hop.send", 1000, 100, trace=tid, hop=1,
+                     replica="r0"),
+            _span("relay", 1000, 150_000, 100, trace=tid, hop=1,
+                  died=True),
+            _instant("failover_resume", 151_000, 100, trace=tid,
+                     replica="r0"),
+            _instant("hop.send", 255_000, 100, trace=tid, hop=2,
+                     replica="r1"),
+            _span("relay", 255_000, 80_000, 100, trace=tid, hop=2,
+                  died=False),
+        ])
+    r0 = _write_trace(
+        tmp_path / "replica-r0_pid200_trace.json", pid=200,
+        pname="replica-r0", origin=1000.0, events=[
+            _instant("hop.recv", 2000, 200, trace=tid, hop=1),
+            _span("serve.decode", 2000, 120_000, 200, trace=tid),
+        ])
+    r1 = _write_trace(
+        tmp_path / "replica-r1_pid300_trace.json", pid=300,
+        pname="replica-r1", origin=r1_origin, events=[
+            _instant("hop.recv", r1_recv_ts, 300, trace=tid, hop=2),
+            _span("serve.decode", r1_recv_ts, 60_000, 300, trace=tid),
+        ])
+    return tid, [door, r0, r1]
+
+
+class TestFleetTraceMerge:
+    def test_merged_file_is_valid_and_bitwise_deterministic(
+            self, tmp_path):
+        _, paths = _failover_fleet(tmp_path)
+        out1, out2 = tmp_path / "m1.json", tmp_path / "m2.json"
+        assert fleet_trace.main([*paths, "-o", str(out1)]) == 0
+        assert fleet_trace.main([*paths, "-o", str(out2)]) == 0
+        assert out1.read_bytes() == out2.read_bytes()
+        merged = load_trace(str(out1))  # structural validity
+        other = merged["otherData"]
+        assert other["merged_from"] == [
+            "door_pid100_trace.json", "replica-r0_pid200_trace.json",
+            "replica-r1_pid300_trace.json"]
+        # Non-meta events are globally time-sorted after alignment.
+        ts = [ev["ts"] for ev in merged["traceEvents"]
+              if ev["ph"] != "M"]
+        assert ts == sorted(ts)
+
+    def test_dir_glob_matches_explicit_paths(self, tmp_path, capsys):
+        _, paths = _failover_fleet(tmp_path)
+        assert fleet_trace.main(
+            ["--dir", str(tmp_path), "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["files"] == [
+            "door_pid100_trace.json", "replica-r0_pid200_trace.json",
+            "replica-r1_pid300_trace.json"]
+        assert summary["events"] == 10
+
+    def test_wall_origin_rebase_aligns_sessions(self, tmp_path, capsys):
+        # replica-r1's session opened 0.25 s after the door's: its
+        # events must shift by exactly that in the merged timeline.
+        _, paths = _failover_fleet(tmp_path)
+        out = tmp_path / "merged.json"
+        assert fleet_trace.main([*paths, "--json",
+                                 "-o", str(out)]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        shift = load_trace(str(out))["otherData"]["shift_us"]
+        assert shift["door_pid100_trace.json"] == 0.0
+        assert shift["replica-r1_pid300_trace.json"] == \
+            pytest.approx(250_000.0)
+        # Hop 2: send at door-ts 255000, recv at r1-ts 10000 + 250000
+        # shift = 260000 → 5 ms residual, causal and well under slack.
+        assert summary["max_residual_ms"] == pytest.approx(5.0)
+        assert summary["clock_skew_ms"] == {
+            "door_pid100_trace.json": 0.0,
+            "replica-r0_pid200_trace.json": 0.0,
+            "replica-r1_pid300_trace.json": 0.0}
+
+    def test_hop_refinement_repairs_backdated_clock(self, tmp_path,
+                                                    capsys):
+        # r1's recorded wall origin is 30 ms EARLY (clock skew): after
+        # the coarse rebase its hop-2 recv lands before the door's
+        # send. The causality pass shifts the file forward by exactly
+        # the negative residual and reports it as clock skew.
+        _, paths = _failover_fleet(tmp_path, r1_origin=1000.22)
+        assert fleet_trace.main([*paths, "--json",
+                                 "--slack-ms", "50"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        skew = summary["clock_skew_ms"]["replica-r1_pid300_trace.json"]
+        assert skew == pytest.approx(25.0)  # 255ms send - 230ms recv
+        # Hop 2's residual is repaired to exactly zero; hop 1 (r0,
+        # honest clock) keeps its real 1 ms queueing delay.
+        assert summary["max_residual_ms"] == pytest.approx(1.0)
+
+    def test_slack_check_fails_on_excess_residual(self, tmp_path,
+                                                  capsys):
+        # Recv 105 ms after send (r1 origin pushed 100 ms later):
+        # positive residuals are real queueing, never "repaired" — the
+        # slack bound is how a drill catches a broken handshake.
+        _, paths = _failover_fleet(tmp_path, r1_origin=1000.35)
+        assert fleet_trace.main([*paths, "--slack-ms", "50"]) == 1
+        assert "residual" in capsys.readouterr().err
+
+    def test_pid_collision_gets_distinct_tracks(self, tmp_path):
+        # OS pid reuse: the restarted replica came back with the SAME
+        # pid. The merge must keep the incarnations on separate tracks.
+        a = _write_trace(tmp_path / "replica-a_pid77_trace.json",
+                         pid=77, pname="replica-a", origin=1.0,
+                         events=[_span("s", 0, 10, 77, trace="t")])
+        b = _write_trace(tmp_path / "replica-b_pid77_trace.json",
+                         pid=77, pname="replica-b", origin=2.0,
+                         events=[_span("s", 0, 10, 77, trace="t")])
+        files = fleet_trace._load_files([a, b])
+        fleet_trace._remap_pids(files)
+        assert files[0]["pids"] == [77]
+        assert files[1]["pids"] == [78]
+        merged = fleet_trace.merge(files)
+        assert {ev["pid"] for ev in merged["traceEvents"]} == {77, 78}
+
+    def test_check_failover_demands_two_replica_pids(self, tmp_path,
+                                                     capsys):
+        tid, paths = _failover_fleet(tmp_path)
+        assert fleet_trace.main([*paths, "--check-failover",
+                                 "--json"]) == 0
+        summary = json.loads(capsys.readouterr().out)
+        assert summary["failover_traces"] == [
+            {"trace": tid, "replica_pids": [200, 300]}]
+        # Door + ONE replica only: no id spans two replica pids.
+        assert fleet_trace.main([paths[0], paths[1],
+                                 "--check-failover"]) == 1
+        assert "failover" in capsys.readouterr().err
+
+    def test_no_inputs_and_malformed_input_exit_2(self, tmp_path,
+                                                  capsys):
+        assert fleet_trace.main(["--dir", str(tmp_path / "empty")]) == 2
+        bad = tmp_path / "bad_trace.json"
+        bad.write_text(json.dumps({"events": []}))
+        assert fleet_trace.main([str(bad)]) == 2
+        err = capsys.readouterr().err
+        assert "no trace files" in err and "traceEvents" in err
+
+    def test_real_sessions_round_trip_through_merge(self, tmp_path):
+        # End-to-end with REAL TraceSession files (the exact producer
+        # the tool consumes): spans survive, ids attribute correctly.
+        door = TraceSession(pid=1, process_name="door")
+        t0 = door._t0
+        door.instant("hop.send", track="relay", t=t0 + 0.001,
+                     trace="req-000001", hop=1)
+        rep = TraceSession(pid=2, process_name="replica-r0")
+        rep.instant("hop.recv", track="serve", t=rep._t0 + 0.001,
+                    trace="req-000001", hop=1)
+        p1 = door.save(str(tmp_path / "door_pid1_trace.json"))
+        p2 = rep.save(str(tmp_path / "replica-r0_pid2_trace.json"))
+        assert fleet_trace.main(
+            [p1, p2, "-o", str(tmp_path / "m.json"),
+             "--slack-ms", "1000"]) == 0
+        merged = load_trace(str(tmp_path / "m.json"))
+        recv = [ev for ev in merged["traceEvents"]
+                if ev["name"] == "hop.recv"]
+        assert len(recv) == 1 and recv[0]["args"]["trace"] == \
+            "req-000001"
+
+
+# -- /fleet/metrics relabeling ------------------------------------------------
+class TestMergeLabeledExpositions:
+    def test_relabels_and_groups_families(self):
+        a = ("# TYPE engine_tokens_total counter\n"
+             "engine_tokens_total 7\n"
+             "# TYPE queue_wait_ms histogram\n"
+             'queue_wait_ms_bucket{le="1"} 2\n'
+             "queue_wait_ms_sum 1.5\n"
+             "queue_wait_ms_count 2\n")
+        b = ("# TYPE engine_tokens_total counter\n"
+             "engine_tokens_total 9\n")
+        lines = merge_labeled_expositions(
+            [('replica="r0"', a), ('replica="r1"', b)])
+        # One TYPE header per family, both samples labeled under it.
+        assert lines.count("# TYPE engine_tokens_total counter") == 1
+        i0 = lines.index('engine_tokens_total{replica="r0"} 7')
+        i1 = lines.index('engine_tokens_total{replica="r1"} 9')
+        assert lines.index("# TYPE engine_tokens_total counter") \
+            < i0 < i1
+        # Histogram suffixes group under the parent family, and the
+        # replica label lands FIRST, ahead of existing labels.
+        assert 'queue_wait_ms_bucket{replica="r0",le="1"} 2' in lines
+        assert 'queue_wait_ms_sum{replica="r0"} 1.5' in lines
+
+    def test_ledger_seal_is_close(self):
+        led = LatencyLedger(0.0)
+        led.stamp(CAUSE_ROUTE, 0.010)
+        led.stamp(CAUSE_RELAY, 0.050)
+        led.seal(CAUSE_RELAY)
+        assert led.closed and led.violations() == []
+        assert led.lifetime_ms == pytest.approx(50.0)
+
+
+# -- federated plane over scripted HTTP replicas ------------------------------
+class _FakeReplicaServer:
+    """A replica's HTTP surface with no engine behind it: scripted
+    probe/healthz, an SSE /generate that echoes the fleet trace
+    headers and ships a conserved ledger on the done frame, and
+    static /metrics//vars bodies. Counts every scrape per path so the
+    breaker-stale test can pin "an open replica is never contacted"."""
+
+    def __init__(self):
+        self.seen: list[dict] = []
+        self.scrapes: dict[str, int] = {}
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                if self.path == "/probe":
+                    self._json({"hit_tokens": 0,
+                                "queue_wait_p95_ms": 0.0,
+                                "queue_depth": 0, "active_slots": 0,
+                                "draining": False, "phase": "serving"})
+                    return
+                tid = self.headers.get("X-Graft-Trace")
+                outer.seen.append(
+                    {"trace": tid,
+                     "hop": self.headers.get("X-Graft-Hop")})
+                uid = f"uid-{len(outer.seen) - 1}"
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                if tid is not None:
+                    self.send_header("X-Graft-Trace", tid)
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def frame(event, payload):
+                    return (f"event: {event}\n"
+                            f"data: {json.dumps(payload)}\n\n").encode()
+                self.wfile.write(frame(
+                    "tokens", {"uid": uid, "tokens": [7, 8, 9]}))
+                self.wfile.write(frame("done", {
+                    "uid": uid, "tokens": [7, 8, 9], "trace_id": tid,
+                    "ledger": {"lifetime_ms": 0.5,
+                               "causes_ms": {"decode": 0.5},
+                               "conserved": True}}))
+
+            def do_GET(self):
+                outer.scrapes[self.path] = \
+                    outer.scrapes.get(self.path, 0) + 1
+                if self.path == "/healthz":
+                    self._json({"phase": "serving",
+                                "serve_loop_heartbeat": 1})
+                elif self.path == "/metrics":
+                    body = ("# TYPE engine_tokens_total counter\n"
+                            "engine_tokens_total 7\n").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/vars":
+                    self._json({"engine_tokens_total": 7})
+                else:
+                    self._json({"error": "not found"})
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+        self.url = f"http://127.0.0.1:{self._srv.server_address[1]}"
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    reps = [_FakeReplicaServer() for _ in range(2)]
+    router = Router(
+        [HttpReplica(r.url, name=f"r{i}") for i, r in enumerate(reps)],
+        breaker_threshold=1, breaker_cooldown_s=600.0)
+    trace = TraceSession(pid=0, process_name="door")
+    trace_path = str(tmp_path / "door_pid0_trace.json")
+    door = RouterFrontDoor(router, port=0, trace=trace,
+                           trace_path=trace_path).start()
+    try:
+        yield reps, router, door, trace_path
+    finally:
+        door.stop()
+        for r in reps:
+            r.stop()
+
+
+def _get(url, timeout=10.0):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+class TestFederatedDoor:
+    def test_trace_id_minted_propagated_and_echoed(self, fleet):
+        reps, router, door, trace_path = fleet
+        # No client id: the door mints req-<seq> from its own request
+        # sequence (deterministic — never wall clock).
+        out = generate_over_http(door.url("/generate"),
+                                 {"prompt": [1, 2, 3], "stream": True})
+        assert out["trace_id"] == "req-000001"
+        assert out["trace_header"] == "req-000001"
+        # Client-supplied id passes through untouched.
+        out2 = generate_over_http(
+            door.url("/generate"), {"prompt": [4, 5], "stream": True},
+            trace_id="cli-0007")
+        assert out2["trace_id"] == "cli-0007"
+        assert out2["trace_header"] == "cli-0007"
+        # Each replica hop carried the id + a hop ordinal.
+        hops = [s for r in reps for s in r.seen]
+        assert sorted(h["trace"] for h in hops) == \
+            ["cli-0007", "req-000001"]
+        assert all(h["hop"] == "1" for h in hops)
+
+    def test_fleet_ledger_joins_and_conserves(self, fleet):
+        reps, router, door, trace_path = fleet
+        for i in range(3):
+            generate_over_http(door.url("/generate"),
+                               {"prompt": [1, 2, i], "stream": True})
+        fs = door.fleet_snapshot()
+        assert fs["fleet_ledger_requests"] == 3
+        assert fs["fleet_ledger_conservation_violations"] == 0
+        assert fs["fleet_replica_ledger_joined"] == 3
+        assert fs["fleet_replica_ledger_absent"] == 0
+        assert fs["fleet_cause_ms"][CAUSE_RELAY] > 0.0
+        top = fs["fleet_ledger_top"]
+        assert len(top) == 3 and all(e["conserved"] for e in top)
+        assert top[0]["replica_lifetime_ms"] == pytest.approx(0.5)
+
+    def test_fleet_endpoints_fan_out(self, fleet):
+        reps, router, door, trace_path = fleet
+        generate_over_http(door.url("/generate"),
+                           {"prompt": [1], "stream": True})
+        text = _get(door.url("/fleet/metrics")).decode()
+        assert "fleet_ledger_requests 1" in text
+        assert "fleet_ledger_conservation_violations 0" in text
+        assert 'fleet_replica_stale{replica="r0"} 0' in text
+        assert 'fleet_replica_stale{replica="r1"} 0' in text
+        assert 'engine_tokens_total{replica="r0"} 7' in text
+        assert 'engine_tokens_total{replica="r1"} 7' in text
+        assert text.count("# TYPE engine_tokens_total counter") == 1
+        assert 'router_replica_breaker_state{replica="r0"} 0' in text
+        fv = json.loads(_get(door.url("/fleet/vars")))
+        assert fv["replicas"]["r0"]["engine_tokens_total"] == 7
+        assert fv["fleet"]["fleet_ledger_requests"] == 1
+        assert fv["router"]["router_requests_routed"] == 1
+        fr = json.loads(_get(door.url("/fleet/replicas")))
+        assert [r["name"] for r in fr["replicas"]] == ["r0", "r1"]
+        assert all(r["breaker_state_code"] == 0
+                   for r in fr["replicas"])
+
+    def test_breaker_open_replica_is_stale_not_contacted(self, fleet):
+        reps, router, door, trace_path = fleet
+        router.note_replica_failure(1)  # threshold 1 → open, 600s cool
+        assert router.breaker_state(1) == "open"
+        before = dict(reps[1].scrapes)
+        fv = json.loads(_get(door.url("/fleet/vars")))
+        assert fv["replicas"]["r1"] == {"stale": True,
+                                       "reason": "breaker_open"}
+        assert fv["replicas"]["r0"]["engine_tokens_total"] == 7
+        text = _get(door.url("/fleet/metrics")).decode()
+        assert 'fleet_replica_stale{replica="r1"} 1' in text
+        assert 'engine_tokens_total{replica="r1"}' not in text
+        assert 'router_replica_breaker_state{replica="r1"} 2' in text
+        # The scrape never reached the open replica — the stale marker
+        # is a ROUTER-SIDE fact (lint-pinned: no breaker mutation and
+        # no probe from the do_GET fan-out either).
+        assert reps[1].scrapes == before
+
+    def test_door_trace_has_fleet_spans(self, fleet):
+        reps, router, door, trace_path = fleet
+        generate_over_http(door.url("/generate"),
+                           {"prompt": [1, 2], "stream": True})
+        door.stop()  # checkpoints the door trace
+        obj = load_trace(trace_path)
+        by_name = {}
+        for ev in obj["traceEvents"]:
+            by_name.setdefault(ev["name"], []).append(ev)
+        (send,) = by_name["hop.send"]
+        assert send["args"] == {"trace": "req-000001", "hop": 1,
+                                "replica": send["args"]["replica"],
+                                "resume": False}
+        (relay,) = by_name["relay"]
+        assert relay["args"]["trace"] == "req-000001"
+        assert relay["args"]["died"] is False
+        (route,) = by_name["route"]
+        assert route["args"]["seq"] == 1
+        (audit,) = by_name["fleet.audit"]
+        assert audit["args"]["conserved"] is True

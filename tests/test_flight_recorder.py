@@ -226,3 +226,41 @@ class TestFlightReportTool:
         with contextlib.redirect_stdout(buf):
             assert mod.main([path, "--json"]) == 0
         assert json.loads(buf.getvalue())["steps_in_ring"] == 4
+
+    def test_fleet_section_renders_and_tolerates_absence(self):
+        """The fleet-ledger section (router door dumps) renders with
+        .get-tolerant access; a pre-fleet dump without the key — and a
+        partial section from an older door — must not crash."""
+        from conftest import load_cli_module
+
+        mod = load_cli_module("tools/flight_report.py")
+        snap = {"reason": "test", "steps": [], "steps_recorded_total": 0,
+                "fleet": {
+                    "fleet_ledger_requests": 3,
+                    "fleet_ledger_conservation_violations": 1,
+                    "fleet_ledger_violation_last": "req-000002: drift",
+                    "fleet_replica_ledger_joined": 2,
+                    "fleet_replica_ledger_absent": 1,
+                    "fleet_cause_ms": {"relay": 4.0, "route": 1.0},
+                    "fleet_ledger_top": [
+                        {"trace_id": "req-000002", "uid": 7,
+                         "lifetime_ms": 5.0,
+                         "replica_lifetime_ms": 4.5,
+                         "causes_ms": {"relay": 4.0, "route": 1.0},
+                         "conserved": False}]}}
+        text = mod.render(mod.summarize(snap))
+        assert "fleet ledger: 3 request(s) audited" in text
+        assert "2 joined / 1 absent" in text
+        assert "LAST VIOLATION: req-000002: drift" in text
+        assert "req-000002 (uid 7): 5.0 ms door-side" in text
+        assert "[NOT CONSERVED]" in text
+        # Absent section: no fleet line at all, no crash.
+        no_fleet = mod.render(mod.summarize(
+            {"reason": "old", "steps": [], "steps_recorded_total": 0}))
+        assert "fleet ledger" not in no_fleet
+        # Partial section (older door, fewer counters): defaults render.
+        partial = mod.render(mod.summarize(
+            {"reason": "partial", "steps": [], "steps_recorded_total": 0,
+             "fleet": {"fleet_ledger_requests": 1}}))
+        assert ("fleet ledger: 1 request(s) audited cross-hop, "
+                "0 conservation") in partial

@@ -286,6 +286,91 @@ class TestScrapeSafety:
                         return {"routed": self.requests_routed}
         """, "scrape-safety")
 
+    def test_positive_fleet_get_tripping_breaker_exits_1(
+            self, tmp_path, capsys):
+        # The federated-telemetry-plane bug class: a /fleet/metrics
+        # fan-out that treats an unreachable replica as a FAILURE and
+        # trips the breaker from the GET handler thread turns the
+        # monitoring plane into a fault injector — a dashboard refresh
+        # that opens a breaker IS an outage. Unreachable replicas get a
+        # deterministic stale marker instead.
+        assert _exit_code(tmp_path, """
+            class Door:
+                def do_GET(self):
+                    self._respond(self._fleet_scrape())
+
+                def _fleet_scrape(self):
+                    out = {}
+                    for i, rep in enumerate(self.replicas):
+                        try:
+                            out[rep.name] = rep.scrape_text("/metrics")
+                        except OSError:
+                            self.router.note_replica_failure(i)
+                    return out
+        """, "scrape-safety") == 1
+        out = capsys.readouterr().out
+        assert "GET scrape path" in out and "stale" in out
+
+    def test_positive_fleet_get_restarting_replica_exits_1(
+            self, tmp_path, capsys):
+        # Same clause, supervision flavor: a GET that force-restarts a
+        # stale replica races the supervisor's monitor thread (double
+        # restart, double count) — and does so once per scraper.
+        assert _exit_code(tmp_path, """
+            class Door:
+                def do_GET(self):
+                    rows = []
+                    for i, rep in enumerate(self.replicas):
+                        if self._stale(rep):
+                            self.supervisor.force_restart(i)
+                        rows.append({"replica": rep.name})
+                    self._respond(rows)
+        """, "scrape-safety") == 1
+        assert "force_restart" in capsys.readouterr().out
+
+    def test_negative_fleet_scrape_with_stale_markers_is_clean(
+            self, tmp_path):
+        # The shipped design: fleet_snapshot is a counter view; the
+        # /fleet fan-out marks breaker-open and unreachable replicas
+        # stale and never touches breaker or supervision state. The
+        # do_POST proxy keeps its legitimate note_* ownership alongside.
+        assert not _lint(tmp_path, """
+            class Door:
+                def do_GET(self):
+                    self._respond({
+                        "fleet": self.fleet_snapshot(),
+                        "replicas": self._fleet_scrape(),
+                    })
+
+                def fleet_snapshot(self):
+                    with self._fleet_lock:
+                        return {
+                            "fleet_ledger_requests": self._led_requests,
+                        }
+
+                def _fleet_scrape(self):
+                    out = {}
+                    for i, rep in enumerate(self.replicas):
+                        if self.router.breaker_open(i):
+                            out[rep.name] = {"stale": True,
+                                             "reason": "breaker_open"}
+                            continue
+                        try:
+                            out[rep.name] = rep.scrape_json("/vars")
+                        except OSError:
+                            out[rep.name] = {"stale": True,
+                                             "reason": "unreachable"}
+                    return out
+
+                def do_POST(self):
+                    idx = self._route_one()
+                    try:
+                        self._relay(idx)
+                        self.router.note_replica_success(idx)
+                    except OSError:
+                        self.router.note_replica_failure(idx)
+        """, "scrape-safety")
+
 
 class TestLockSignalSafety:
     # The pre-fix round-13 hot-swap pattern, minimized: serve()'s

@@ -192,6 +192,17 @@ DEFAULT_METRICS: dict[str, tuple[str, float]] = {
     "breaker_opens": ("both", 0.0),
     "requests_cancelled": ("both", 0.0),
     "failover_resumes": ("both", 0.0),
+    # Federated telemetry plane (serving/router.py fleet ledger;
+    # docs/OBSERVABILITY.md "Fleet tracing & federated metrics"): the
+    # door's per-request fleet ledger is conserved by the same
+    # telescoping-cursor construction as the engine ledger, and the
+    # cross-hop audit (door intervals tile the client wall time;
+    # replica lifetime fits inside the relay span) is structural — ONE
+    # violating request is an attribution bug, zero-tolerance from any
+    # baseline. The request count is workload-deterministic on
+    # network rows and exactly zero on single-process rows.
+    "fleet_ledger_requests": ("both", 0.0),
+    "fleet_ledger_conservation_violations": ("both", 0.0),
 }
 
 

@@ -83,6 +83,12 @@ def summarize(snap: dict) -> dict:
         out["alerts"] = snap["alerts"]
     if snap.get("timeseries"):
         out["timeseries"] = snap["timeseries"]
+    # Fleet ledger (serving/router.py::fleet_snapshot, the "fleet" key
+    # of the door's /fleet/vars payload): only dumps captured behind
+    # the router door carry it — every pre-fleet bundle and every
+    # single-process dump lacks the section and must render unchanged.
+    if snap.get("fleet"):
+        out["fleet"] = snap["fleet"]
     return out
 
 
@@ -210,6 +216,35 @@ def render(summary: dict) -> str:
                 f" (expired {degraded['requests_preempt_timed_out']}, "
                 f"recompute "
                 f"{srv.get('preempted_token_recompute', 0):.0f} tok)")
+    fl = summary.get("fleet")
+    if fl:
+        # Every access tolerant (.get with a zero default): the section
+        # shape may grow counter-by-counter across rounds and an older
+        # door's bundle must keep rendering.
+        causes = "  ".join(f"{c} {ms:.0f}" for c, ms in sorted(
+            (fl.get("fleet_cause_ms") or {}).items(),
+            key=lambda kv: -kv[1]))
+        viol = fl.get("fleet_ledger_conservation_violations", 0)
+        add(f"  fleet ledger: {fl.get('fleet_ledger_requests', 0)} "
+            f"request(s) audited cross-hop, {viol} conservation "
+            f"violation(s)  |  replica ledgers "
+            f"{fl.get('fleet_replica_ledger_joined', 0)} joined / "
+            f"{fl.get('fleet_replica_ledger_absent', 0)} absent"
+            + (f"  |  {causes} ms" if causes else ""))
+        if viol and fl.get("fleet_ledger_violation_last"):
+            add(f"    LAST VIOLATION: {fl['fleet_ledger_violation_last']}")
+        for e in fl.get("fleet_ledger_top") or []:
+            ecauses = "  ".join(f"{c} {ms:.1f}" for c, ms in sorted(
+                (e.get("causes_ms") or {}).items(),
+                key=lambda kv: -kv[1]))
+            rep = e.get("replica_lifetime_ms")
+            add(f"    {e.get('trace_id', '?')} (uid {e.get('uid', '?')}"
+                f"): {e.get('lifetime_ms', 0.0):.1f} ms door-side"
+                + (f" / {rep:.1f} ms replica-side"
+                   if isinstance(rep, (int, float)) else "")
+                + (f" = {ecauses}" if ecauses else "")
+                + ("" if e.get("conserved", True)
+                   else "  [NOT CONSERVED]"))
     al = summary.get("alerts")
     if al:
         active = ", ".join(al.get("active") or []) or "none"

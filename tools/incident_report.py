@@ -67,6 +67,11 @@ def render(bundle: dict) -> str:
     summary = summarize_flight(bundle["flight"])
     summary["alerts"] = bundle["alerts"]
     summary["timeseries"] = bundle["timeseries"]
+    # Fleet ledger section (serving/router.py::fleet_snapshot): only
+    # bundles captured behind the router door carry it — every older
+    # bundle lacks the key and must render exactly as before.
+    if bundle.get("fleet"):
+        summary["fleet"] = bundle["fleet"]
     lines.append(render_flight(summary))
     return "\n".join(lines)
 
@@ -102,6 +107,8 @@ def main(argv=None) -> int:
                 summary["alert"] = bundle["alert"]
                 summary["alerts"] = bundle["alerts"]
                 summary["timeseries"] = bundle["timeseries"]
+                if bundle.get("fleet"):
+                    summary["fleet"] = bundle["fleet"]
                 out.append(json.dumps(summary))
             else:
                 out.append(render(bundle))

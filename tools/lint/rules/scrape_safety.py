@@ -45,6 +45,17 @@ the monitor's restart ladder. The proxy handler itself (``do_POST``)
 legitimately reaches the ``note_*`` hooks, so this clause applies only
 to the snapshot-provider roots, not the HTTP handler roots.
 
+The federated telemetry plane (``/fleet/metrics``/``/fleet/vars``/
+``/fleet/replicas`` on the router front door) adds a *GET-is-a-view*
+clause: a fleet scrape fans read-only GETs out to every replica and
+must degrade to a deterministic ``stale`` marker when one is
+unreachable — it must never trip a breaker (``note_replica_failure``)
+or kill/restart a replica from the scrape thread, or the monitoring
+plane becomes a fault injector (a dashboard refresh that opens a
+breaker IS an outage). The proxy path (``do_POST``) legitimately
+reaches the ``note_*`` hooks, so this clause checks ``do_GET`` roots
+only.
+
 Roots: HTTP ``do_GET``/``do_POST`` methods (and everything they reach,
 including ``MetricsExporter._handle``, the frontend's request handlers
 and the router's probe/proxy endpoints — their nested ``Handler``
@@ -74,7 +85,11 @@ PROVIDER_NAMES = {"flight_snapshot", "scrape_snapshot", "health",
                   # Fleet fault tolerance (serving/supervisor.py): the
                   # supervisor's counter view is scraped by drills and
                   # the chaos harness while the monitor thread is hot.
-                  "supervisor_snapshot"}
+                  "supervisor_snapshot",
+                  # Federated telemetry plane (router front door): the
+                  # fleet-ledger counter view behind /fleet/* and the
+                  # serve_net SLA-row merge.
+                  "fleet_snapshot"}
 
 DEVICE_READS = {"device_get", "block_until_ready", "item", "tolist",
                 "memory_stats", "device_memory_metrics"}
@@ -173,3 +188,25 @@ def check(index: ProjectIndex) -> Iterator[Finding]:
                     f"trips and replica kill/restart belong to the "
                     f"proxy/monitor threads (docs/RESILIENCE.md, fleet "
                     f"fault tolerance)")
+    # GET-is-a-view clause (federated telemetry plane): a /fleet scrape
+    # fans read-only GETs across the fleet; an unreachable replica gets
+    # a deterministic ``stale`` marker, never a breaker trip or a
+    # kill/restart — checked from do_GET roots only (the do_POST proxy
+    # path owns the note_* hooks).
+    get_roots = [fn for fn in index.iter_functions()
+                 if fn.name == "do_GET"]
+    get_reach = index.reachable(get_roots)
+    for qualname in sorted(get_reach):
+        fn, chain = get_reach[qualname]
+        via = " -> ".join(q.split("::")[-1] for q in chain)
+        for cs in fn.calls:
+            if cs.name in FLEET_MUTATION:
+                yield Finding(
+                    NAME, fn.file.display_path, cs.line,
+                    f"GET scrape path ({via}) reaches a fleet-"
+                    f"supervision mutation '{cs.name}()' — a /fleet "
+                    f"scrape observes the fleet; breaker trips and "
+                    f"replica kill/restart must never run from a GET "
+                    f"handler thread (mark the replica stale instead — "
+                    f"docs/OBSERVABILITY.md, federated telemetry "
+                    f"plane)")

@@ -63,9 +63,14 @@ def add_engine_args(p: argparse.ArgumentParser) -> None:
                    default=False)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--journal-dir", type=str, default=None)
+    p.add_argument("--trace-dir", type=str, default=None,
+                   help="fleet tracing: every participant (door + each "
+                        "replica incarnation) writes one Chrome trace "
+                        "here, named by its REAL pid; merge with "
+                        "tools/fleet_trace.py")
 
 
-def build_engine(args: argparse.Namespace):
+def build_engine(args: argparse.Namespace, trace=None):
     import jax
     import numpy as np
 
@@ -87,15 +92,24 @@ def build_engine(args: argparse.Namespace):
         kv_page_size=args.kv_page_size or None, kv_pages=args.kv_pages,
         prefix_cache=not args.no_prefix_cache,
         journal_dir=args.journal_dir, seed=args.seed)
-    return Engine(model, params, cfg)
+    return Engine(model, params, cfg, trace=trace)
 
 
 def run_replica(args: argparse.Namespace) -> int:
+    from distributed_training_tpu.observability.trace import fleet_session
     from distributed_training_tpu.serving.frontend import ServingFrontend
 
-    engine = build_engine(args)
+    # Fleet tracing: the replica's session pid is os.getpid() and the
+    # file carries the pid in its name, so a SIGKILLed incarnation's
+    # trace survives its successor (tools/fleet_trace.py merges them
+    # onto distinct Perfetto tracks). The component prefix "replica"
+    # is what fleet_trace --check-failover keys on.
+    trace, trace_path = fleet_session(f"replica-{args.name}",
+                                      args.trace_dir)
+    engine = build_engine(args, trace=trace)
     engine.recover()
-    frontend = ServingFrontend(engine, port=args.port).start()
+    frontend = ServingFrontend(engine, port=args.port, trace=trace,
+                               trace_path=trace_path).start()
     print(json.dumps({"replica": args.name, "port": frontend.port}),
           flush=True)
     stop = threading.Event()
@@ -136,6 +150,8 @@ class ReplicaProc:
         if args.journal_dir:
             cmd += ["--journal-dir",
                     os.path.join(args.journal_dir, f"r{index}")]
+        if getattr(args, "trace_dir", None):
+            cmd += ["--trace-dir", args.trace_dir]
         self.name = f"r{index}"
         self.proc = subprocess.Popen(
             cmd, cwd=REPO_ROOT, stdin=subprocess.PIPE,
@@ -223,11 +239,17 @@ def _settle_and_audit(sup, timeout_s: float = 60.0):
 
 
 def run_front_door(args: argparse.Namespace) -> int:
+    from distributed_training_tpu.observability.trace import fleet_session
     from distributed_training_tpu.serving.router import (
         HttpReplica, Router, RouterFrontDoor)
     from distributed_training_tpu.serving.supervisor import (
         ReplicaSupervisor)
     from tools.traffic import make_scenario, replay_over_http
+
+    # One trace session for the door process; the router (breaker-skip
+    # instants) and the supervisor (death/restart instants) share it —
+    # their lanes interleave with route/relay on the door's pid.
+    trace, trace_path = fleet_session("door", args.trace_dir)
 
     # The supervisor owns the replica processes: spawn, death/wedge
     # detection, restart-with-journal. A restart rebinds the router's
@@ -244,7 +266,7 @@ def run_front_door(args: argparse.Namespace) -> int:
     sup = ReplicaSupervisor(
         lambda i: ReplicaProc(i, args), args.replicas,
         wedge_timeout_s=args.wedge_timeout_s or None,
-        on_restart=_on_restart).start()
+        on_restart=_on_restart, trace=trace).start()
     replicas = sup.handles
     router = Router([HttpReplica(r.url, name=r.name) for r in replicas],
                     policy=args.policy,
@@ -270,7 +292,9 @@ def run_front_door(args: argparse.Namespace) -> int:
     door = RouterFrontDoor(
         router, port=args.port,
         chaos_hook=(_chaos_hook if args.kill_replica_at_request > 0
-                    else None)).start()
+                    else None),
+        trace=trace, trace_path=trace_path,
+        supervisor_snapshot=sup.supervisor_snapshot).start()
     print(json.dumps({"port": door.port, "policy": args.policy,
                       "replicas": [{"name": r.name, "port": r.port}
                                    for r in replicas]}), flush=True)
@@ -348,6 +372,27 @@ def run_front_door(args: argparse.Namespace) -> int:
             sup, timeout_s=120.0 if chaos else 20.0)
         snap = router.router_snapshot()
         sup_snap = sup.supervisor_snapshot()
+        fleet = door.fleet_snapshot()
+        from tools.traffic import trace_roundtrip_mismatches
+        trace_bad = trace_roundtrip_mismatches(results)
+        if args.fleet_out:
+            # Self-scrape the federated plane AFTER the replay settled
+            # — the artifact CI asserts family presence and staleness
+            # markers on without re-standing the fleet up.
+            import urllib.request
+            fleet_doc = {}
+            for key, path in (("metrics_text", "/fleet/metrics"),
+                              ("vars", "/fleet/vars"),
+                              ("replicas", "/fleet/replicas")):
+                with urllib.request.urlopen(door.url(path),
+                                            timeout=30.0) as resp:
+                    body = resp.read().decode("utf-8", "replace")
+                fleet_doc[key] = (body if key == "metrics_text"
+                                  else json.loads(body))
+            with open(args.fleet_out, "w") as fh:
+                json.dump(fleet_doc, fh)
+            print(f"[serve_net] fleet scrape: {args.fleet_out}",
+                  file=sys.stderr)
         row = {
             "scenario": args.scenario,
             "requests": len(reqs),
@@ -373,6 +418,20 @@ def run_front_door(args: argparse.Namespace) -> int:
             "replica_restarts": sup_snap["replica_restarts"],
             "breaker_opens": snap["router_breaker_opens"],
             "failover_resumes": snap["router_failover_resumes"],
+            # Fleet ledger (zero-tolerance conservation gate): every
+            # completed proxied request audited cross-hop; the joined/
+            # absent split separates live replica ledgers from
+            # journal-redelivered results whose wall detail died with
+            # the old process. Trace round-trip: the id on the done
+            # payload must equal the response-header echo.
+            "fleet_ledger_requests": fleet["fleet_ledger_requests"],
+            "fleet_ledger_conservation_violations":
+                fleet["fleet_ledger_conservation_violations"],
+            "fleet_replica_ledger_joined":
+                fleet["fleet_replica_ledger_joined"],
+            "fleet_replica_ledger_absent":
+                fleet["fleet_replica_ledger_absent"],
+            "trace_roundtrip_mismatches": trace_bad,
             "requests_cancelled": sum(
                 int(s.get("requests_cancelled", 0))
                 for s in per_replica),
@@ -390,12 +449,22 @@ def run_front_door(args: argparse.Namespace) -> int:
             "wall_s": round(wall_s, 3),
         }
         print(json.dumps(row, allow_nan=False))
+        if fleet["fleet_ledger_conservation_violations"]:
+            print(f"[serve_net] FLEET LEDGER VIOLATION: "
+                  f"{fleet['fleet_ledger_violation_last']}",
+                  file=sys.stderr)
         return 0 if (not row["requests_failed"] and not mismatched
                      and not row["router_deploy_errors"]
-                     and not balance_violations) else 1
+                     and not balance_violations
+                     and not row["fleet_ledger_conservation_violations"]
+                     and not trace_bad) else 1
     finally:
         door.stop()
         sup.stop()
+        if trace is not None and trace_path:
+            trace.save(trace_path)
+            print(f"[serve_net] trace: {trace_path} "
+                  f"({len(trace)} events)", file=sys.stderr)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -426,6 +495,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--unary", action="store_true", default=False)
     p.add_argument("--timeout-s", type=float, default=180.0)
     p.add_argument("--completions-out", type=str, default=None)
+    p.add_argument("--fleet-out", type=str, default=None,
+                   help="after the replay settles, self-scrape "
+                        "/fleet/metrics + /fleet/vars + /fleet/replicas "
+                        "from the door into this JSON file (the CI "
+                        "fleet-drill artifact)")
     p.add_argument("--rolling-deploy-at", type=int, default=0,
                    help="chaos drill: >0 starts a rolling deploy from a "
                         "side thread while the replay is in flight")

@@ -444,6 +444,7 @@ def replay_over_http(url: str, reqs: list[TrafficRequest], *,
                      stream: bool = True, concurrency: int = 1,
                      timeout_s: float = 120.0,
                      drop_at: dict[int, int] | None = None,
+                     trace_prefix: str | None = None,
                      ) -> list[dict | None]:
     """Replay ``reqs`` against a front door's ``/generate``; returns
     one ``done`` payload (with ``streamed_tokens``) per request, in
@@ -460,10 +461,22 @@ def replay_over_http(url: str, reqs: list[TrafficRequest], *,
     are sent by the chaos client, which hangs up after K streamed
     tokens (their result slots stay ``None`` — injected faults, for
     the caller to account separately from real failures).
+
+    ``trace_prefix`` arms the client half of the fleet-trace
+    round-trip: request ``i`` goes out with ``X-Graft-Trace:
+    <prefix><i>`` (deterministic — the request's submission index,
+    never a clock), and :func:`trace_roundtrip_mismatches` can then
+    verify the server echoed the SAME id on both the response header
+    and the ``done`` payload.
     """
     from distributed_training_tpu.serving.router import generate_over_http
 
     drop_at = drop_at or {}
+
+    def _tid(i: int) -> str | None:
+        return (f"{trace_prefix}{i:04d}"
+                if trace_prefix is not None else None)
+
     results: list[dict | None] = [None] * len(reqs)
     if concurrency <= 1:
         for i, r in enumerate(reqs):
@@ -473,7 +486,7 @@ def replay_over_http(url: str, reqs: list[TrafficRequest], *,
                 continue
             results[i] = generate_over_http(
                 url, request_payload(r, stream=stream),
-                timeout_s=timeout_s)
+                timeout_s=timeout_s, trace_id=_tid(i))
         return results
 
     import queue as _queue
@@ -498,7 +511,7 @@ def replay_over_http(url: str, reqs: list[TrafficRequest], *,
                 else:
                     results[i] = generate_over_http(
                         url, request_payload(r, stream=stream),
-                        timeout_s=timeout_s)
+                        timeout_s=timeout_s, trace_id=_tid(i))
             except Exception as e:  # collected, not raised: the drill
                 with err_lock:      # counts failures itself
                     errors.append((i, e))
@@ -516,6 +529,32 @@ def replay_over_http(url: str, reqs: list[TrafficRequest], *,
             f"{len(errors)}/{len(reqs)} requests failed; first: "
             f"request {i}: {e}") from e
     return results
+
+
+def trace_roundtrip_mismatches(results: list,
+                               trace_prefix: str | None = None) -> int:
+    """Count requests whose fleet trace id failed the round-trip: the
+    ``done`` payload's ``trace_id`` must equal the ``X-Graft-Trace``
+    response header (both set by the server from one source), and —
+    when the client supplied ids via ``trace_prefix`` — both must
+    equal what request ``i`` sent. Requests that failed outright
+    (``None``) are not counted here; the caller's failure gate owns
+    them."""
+    bad = 0
+    for i, r in enumerate(results):
+        if r is None:
+            continue
+        body_id = r.get("trace_id")
+        header_id = r.get("trace_header")
+        if body_id is None or header_id is None:
+            bad += 1
+            continue
+        if body_id != header_id:
+            bad += 1
+            continue
+        if trace_prefix is not None and body_id != f"{trace_prefix}{i:04d}":
+            bad += 1
+    return bad
 
 
 def _client_main(argv: list[str] | None = None) -> int:
@@ -549,6 +588,11 @@ def _client_main(argv: list[str] | None = None) -> int:
                    help="write delivered completions as one JSON list "
                         "(submission order) — the artifact the bitwise "
                         "pin diffs against the batch CLI's")
+    p.add_argument("--trace-prefix", type=str, default=None,
+                   help="send X-Graft-Trace: <prefix><i> on request i "
+                        "and verify the server echoed it back on both "
+                        "the response header and the done payload "
+                        "(the fleet-trace round-trip check)")
     args = p.parse_args(argv)
 
     reqs = make_scenario(
@@ -561,7 +605,8 @@ def _client_main(argv: list[str] | None = None) -> int:
     try:
         results = replay_over_http(
             base + "/generate", reqs, stream=not args.unary,
-            concurrency=args.concurrency, timeout_s=args.timeout_s)
+            concurrency=args.concurrency, timeout_s=args.timeout_s,
+            trace_prefix=args.trace_prefix)
     except RuntimeError as e:
         print(f"traffic: error: {e}", file=sys.stderr)
         return 1
@@ -571,6 +616,8 @@ def _client_main(argv: list[str] | None = None) -> int:
     mismatched = sum(1 for r in done
                      if r.get("streamed_tokens") is not None
                      and r["streamed_tokens"] != r["tokens"])
+    trace_bad = trace_roundtrip_mismatches(
+        results, trace_prefix=args.trace_prefix)
     if args.completions_out:
         with open(args.completions_out, "w") as fh:
             json.dump([{"uid": int(r["uid"]),
@@ -585,9 +632,11 @@ def _client_main(argv: list[str] | None = None) -> int:
         "failed": len(reqs) - len(done),
         "tokens_received": tokens,
         "stream_vs_done_mismatches": mismatched,
+        "trace_roundtrip_mismatches": trace_bad,
         "concurrency": args.concurrency,
     }, allow_nan=False))
-    return 0 if len(done) == len(reqs) and mismatched == 0 else 1
+    return 0 if (len(done) == len(reqs) and mismatched == 0
+                 and trace_bad == 0) else 1
 
 
 if __name__ == "__main__":
